@@ -1,0 +1,21 @@
+"""BLS12-381 signatures, backend-generic — analog of the reference `bls` crate
+(reference: crypto/bls/src/lib.rs)."""
+
+from .api import (  # noqa: F401
+    AggregatePublicKey,
+    AggregateSignature,
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_verify,
+    eth_fast_aggregate_verify,
+    fast_aggregate_verify,
+    get_backend,
+    register_backend,
+    set_backend,
+    verify,
+    verify_signature_sets,
+)
+from . import params  # noqa: F401

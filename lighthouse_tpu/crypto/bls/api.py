@@ -1,0 +1,380 @@
+"""Backend-generic BLS API — the analog of the reference's `bls` crate.
+
+The reference exposes generic `TPublicKey`/`TSignature`/`TAggregateSignature`
+traits instantiated per backend (blst, fake_crypto) at compile time
+(reference: crypto/bls/src/lib.rs:84-139).  Here the same shape is a runtime
+registry: `set_backend("python" | "fake" | "jax")`.  The host-side containers
+(compressed bytes + decoded points) are shared; only the *verification engine*
+differs — which is exactly the boundary the TPU backend needs (it consumes
+marshaled signature sets, reference: consensus/state_processing/src/
+per_block_processing/signature_sets.rs).
+
+Semantics mirrored from the reference:
+  * PublicKey deserialization rejects the point at infinity and runs
+    key_validate (crypto/bls/src/generic_public_key.rs:14-15,70).
+  * `verify_signature_sets` draws nonzero 64-bit random weights per set,
+    subgroup-checks signatures, rejects empty sets, aggregates each set's
+    pubkeys, and performs one multi-pairing check
+    (crypto/bls/src/impls/blst.rs:35-117).
+  * `eth_fast_aggregate_verify` accepts the infinity signature with an empty
+    pubkey list (the G2_POINT_AT_INFINITY special case in the spec).
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+
+from . import params
+from .curve import (
+    Fp,
+    Fp2,
+    G1_GENERATOR,
+    affine_add,
+    affine_mul,
+    affine_neg,
+    from_jacobian,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_subgroup_check,
+    g2_to_bytes,
+    jac_add,
+    jac_mul,
+    to_jacobian,
+)
+from .hash_to_curve import hash_to_g2
+from .pairing import pairing_check
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+
+class BlsError(ValueError):
+    pass
+
+
+class SecretKey:
+    __slots__ = ("_sk",)
+
+    def __init__(self, sk: int):
+        if not 1 <= sk < params.R:
+            raise BlsError("secret key out of range")
+        self._sk = sk
+
+    @classmethod
+    def random(cls) -> "SecretKey":
+        return cls(1 + secrets.randbelow(params.R - 1))
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != params.SCALAR_BYTES:
+            raise BlsError("secret key must be 32 bytes")
+        v = int.from_bytes(data, "big")
+        return cls(v)
+
+    def to_bytes(self) -> bytes:
+        return self._sk.to_bytes(params.SCALAR_BYTES, "big")
+
+    def public_key(self) -> "PublicKey":
+        return PublicKey(affine_mul(G1_GENERATOR, self._sk, Fp))
+
+    def sign(self, msg: bytes) -> "Signature":
+        h = hash_to_g2(msg)
+        return Signature(affine_mul(h, self._sk, Fp2))
+
+    @property
+    def int_value(self) -> int:
+        return self._sk
+
+
+class PublicKey:
+    """A validated, non-infinity G1 point (decompressed)."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point):
+        if point is None:
+            # Reference rejects infinity pubkeys at deserialize
+            # (generic_public_key.rs:14-15).
+            raise BlsError("public key cannot be the point at infinity")
+        self.point = point
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PublicKey":
+        pt = g1_from_bytes(data, subgroup_check=True)
+        return cls(pt)
+
+    def to_bytes(self) -> bytes:
+        return g1_to_bytes(self.point)
+
+    def __eq__(self, other):
+        return isinstance(other, PublicKey) and self.point == other.point
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+    def __repr__(self):
+        return f"PublicKey({self.to_bytes().hex()[:16]}…)"
+
+
+class AggregatePublicKey:
+    """Sum of pubkeys; may be infinity (matches TAggregatePublicKey)."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point):
+        self.point = point
+
+    @classmethod
+    def aggregate(cls, pubkeys: list[PublicKey]) -> "AggregatePublicKey":
+        if not pubkeys:
+            raise BlsError("cannot aggregate an empty pubkey list")
+        acc = to_jacobian(None, Fp)
+        for pk in pubkeys:
+            acc = jac_add(acc, to_jacobian(pk.point, Fp), Fp)
+        return cls(from_jacobian(acc, Fp))
+
+
+class Signature:
+    """A G2 point or infinity.  Subgroup checking is deferred to verification
+    time, as in the reference (blst.rs:71-81)."""
+
+    __slots__ = ("point", "_subgroup_checked")
+
+    def __init__(self, point, subgroup_checked: bool = True):
+        self.point = point
+        self._subgroup_checked = subgroup_checked
+
+    @classmethod
+    def infinity(cls) -> "Signature":
+        return cls(None)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Signature":
+        # Decode without subgroup check (deferred), matching lazy signature
+        # validation in the reference.
+        pt = g2_from_bytes(data, subgroup_check=False)
+        return cls(pt, subgroup_checked=False)
+
+    def to_bytes(self) -> bytes:
+        return g2_to_bytes(self.point)
+
+    def is_infinity(self) -> bool:
+        return self.point is None
+
+    def subgroup_check(self) -> bool:
+        if self._subgroup_checked:
+            return True
+        ok = self.point is None or g2_subgroup_check(self.point)
+        if ok:
+            self._subgroup_checked = True
+        return ok
+
+    def __eq__(self, other):
+        return isinstance(other, Signature) and self.point == other.point
+
+    def __repr__(self):
+        return f"Signature({self.to_bytes().hex()[:16]}…)"
+
+
+class AggregateSignature:
+    __slots__ = ("signature",)
+
+    def __init__(self, signature: Signature | None = None):
+        self.signature = signature if signature is not None else Signature.infinity()
+
+    @classmethod
+    def aggregate(cls, signatures: list[Signature]) -> "AggregateSignature":
+        acc = to_jacobian(None, Fp2)
+        checked = True
+        for s in signatures:
+            acc = jac_add(acc, to_jacobian(s.point, Fp2), Fp2)
+            checked = checked and s._subgroup_checked
+        # Subgroup-checkedness propagates only if every input was checked
+        # (G2 is a subgroup, so sums of checked points stay inside it);
+        # otherwise the deferred check must still run at verify time.
+        return cls(Signature(from_jacobian(acc, Fp2), subgroup_checked=checked))
+
+    def add_assign(self, sig: Signature) -> None:
+        pt = affine_add(self.signature.point, sig.point, Fp2)
+        checked = self.signature._subgroup_checked and sig._subgroup_checked
+        self.signature = Signature(pt, subgroup_checked=checked)
+
+    def to_bytes(self) -> bytes:
+        return self.signature.to_bytes()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "AggregateSignature":
+        return cls(Signature.from_bytes(data))
+
+
+@dataclass
+class SignatureSet:
+    """One unit of batch verification: (signature, pubkeys, message).
+
+    Mirrors GenericSignatureSet (reference: crypto/bls/src/
+    generic_signature_set.rs:61-121): the signature is valid iff it verifies
+    against the aggregate of `signing_keys` over `message`.
+    """
+
+    signature: Signature
+    signing_keys: list[PublicKey]
+    message: bytes  # raw message (for Ethereum: a 32-byte signing root)
+
+    def verify(self) -> bool:
+        return get_backend().verify_signature_sets([self])
+
+
+# ---------------------------------------------------------------------------
+# Core verification engines
+# ---------------------------------------------------------------------------
+
+
+class PythonBackend:
+    """CPU reference backend (pairing-based, pure Python)."""
+
+    name = "python"
+
+    def verify(self, pubkey: PublicKey, msg: bytes, sig: Signature) -> bool:
+        if sig.point is None:
+            return False
+        if not sig.subgroup_check():
+            return False
+        h = hash_to_g2(msg)
+        return pairing_check(
+            [(affine_neg(G1_GENERATOR), sig.point), (pubkey.point, h)]
+        )
+
+    def aggregate_verify(
+        self, pubkeys: list[PublicKey], msgs: list[bytes], sig: Signature
+    ) -> bool:
+        """Distinct-message aggregate verification (blst.rs:244-255)."""
+        if not pubkeys or len(pubkeys) != len(msgs):
+            return False
+        if sig.point is None or not sig.subgroup_check():
+            return False
+        pairs = [(affine_neg(G1_GENERATOR), sig.point)]
+        for pk, m in zip(pubkeys, msgs):
+            pairs.append((pk.point, hash_to_g2(m)))
+        return pairing_check(pairs)
+
+    def fast_aggregate_verify(
+        self, pubkeys: list[PublicKey], msg: bytes, sig: Signature
+    ) -> bool:
+        """Same-message aggregate verification (blst.rs:231-242)."""
+        if not pubkeys:
+            return False
+        if sig.point is None or not sig.subgroup_check():
+            return False
+        agg = AggregatePublicKey.aggregate(pubkeys)
+        if agg.point is None:
+            return False
+        h = hash_to_g2(msg)
+        return pairing_check(
+            [(affine_neg(G1_GENERATOR), sig.point), (agg.point, h)]
+        )
+
+    def verify_signature_sets(self, sets: list[SignatureSet]) -> bool:
+        """Random-linear-combination multi-set verification
+        (blst.rs:35-117; SURVEY.md §3.5)."""
+        if not sets:
+            return False
+        pairs = []
+        sig_acc = to_jacobian(None, Fp2)  # Σ r_i · sig_i
+        for s in sets:
+            # Nonzero 64-bit random weight (blst.rs:52-66).
+            r = 0
+            while r == 0:
+                r = secrets.randbits(params.RAND_BITS)
+            if s.signature.point is None:
+                return False
+            if not s.signature.subgroup_check():
+                return False
+            if not s.signing_keys:
+                return False
+            agg = AggregatePublicKey.aggregate(s.signing_keys)
+            if agg.point is None:
+                return False
+            sig_acc = jac_add(
+                sig_acc,
+                jac_mul(to_jacobian(s.signature.point, Fp2), r, Fp2),
+                Fp2,
+            )
+            pairs.append(
+                (affine_mul(agg.point, r, Fp), hash_to_g2(s.message))
+            )
+        pairs.append((affine_neg(G1_GENERATOR), from_jacobian(sig_acc, Fp2)))
+        return pairing_check(pairs)
+
+
+class FakeBackend:
+    """Always-valid backend for crypto-independent logic tests — the analog of
+    fake_crypto (reference: crypto/bls/src/impls/fake_crypto.rs)."""
+
+    name = "fake"
+
+    def verify(self, pubkey, msg, sig) -> bool:
+        return True
+
+    def aggregate_verify(self, pubkeys, msgs, sig) -> bool:
+        return True
+
+    def fast_aggregate_verify(self, pubkeys, msg, sig) -> bool:
+        return True
+
+    def verify_signature_sets(self, sets) -> bool:
+        return True
+
+
+_BACKENDS: dict[str, object] = {}
+_ACTIVE: list[object] = []
+
+
+def register_backend(backend) -> None:
+    _BACKENDS[backend.name] = backend
+
+
+def set_backend(name: str) -> None:
+    if name not in _BACKENDS:
+        raise KeyError(f"unknown BLS backend {name!r}; have {sorted(_BACKENDS)}")
+    _ACTIVE[0] = _BACKENDS[name]
+
+
+def get_backend():
+    return _ACTIVE[0]
+
+
+register_backend(PythonBackend())
+register_backend(FakeBackend())
+_ACTIVE.append(_BACKENDS["python"])
+
+
+# ---------------------------------------------------------------------------
+# Module-level convenience API (the `bls::` free functions of the reference)
+# ---------------------------------------------------------------------------
+
+
+def verify(pubkey: PublicKey, msg: bytes, sig: Signature) -> bool:
+    return get_backend().verify(pubkey, msg, sig)
+
+
+def aggregate_verify(pubkeys, msgs, sig) -> bool:
+    return get_backend().aggregate_verify(pubkeys, msgs, sig)
+
+
+def fast_aggregate_verify(pubkeys, msg, sig) -> bool:
+    return get_backend().fast_aggregate_verify(pubkeys, msg, sig)
+
+
+def eth_fast_aggregate_verify(pubkeys, msg, sig) -> bool:
+    """Spec variant: infinity signature over zero pubkeys is valid
+    (used by sync-committee verification)."""
+    if not pubkeys and sig.is_infinity():
+        return True
+    return fast_aggregate_verify(pubkeys, msg, sig)
+
+
+def verify_signature_sets(sets: list[SignatureSet]) -> bool:
+    return get_backend().verify_signature_sets(sets)

@@ -1,0 +1,116 @@
+"""Pure-Python optimal-ate pairing for BLS12-381.
+
+Reference parity: this is the semantic model of what blst's
+`verify_multiple_aggregate_signatures` computes per pair — N Miller loops plus
+one shared final exponentiation (reference: crypto/bls/src/impls/blst.rs:107-117
+and SURVEY.md §3.5).  The JAX/TPU backend reimplements the same math with
+limb-vectorized kernels; this module is the differential-test oracle.
+
+Implementation choice: G2 points are *untwisted* into E(Fp12) and the Miller
+loop runs generically over Fp12 with affine line evaluations.  That is slow
+(Python bignums) but transparently correct: vertical-line denominators lie in
+Fp6 (the untwisted x-coordinates have no w-component), so they are erased by
+the final exponentiation and can be omitted — the classical denominator
+elimination that makes the M-twist convenient.
+"""
+
+from __future__ import annotations
+
+from . import params
+from .fields import Fp, Fp2, Fp6, Fp12, XI
+
+# Loop count: |x|, MSB-first bit string.
+_X_ABS = abs(params.X)
+_X_BITS = bin(_X_ABS)[2:]
+
+_XI_INV = XI.inv()
+
+
+def untwist(q):
+    """Map an affine point of E'(Fp2) (the M-twist) to E(Fp12).
+
+    (x', y') -> (x' / w^2, y' / w^3)  with  1/w^2 = xi^{-1} v^2  and
+    1/w^3 = xi^{-1} v w  in the tower basis.
+    """
+    if q is None:
+        return None
+    x2, y2 = q
+    x12 = Fp12(Fp6(Fp2.zero(), Fp2.zero(), x2 * _XI_INV), Fp6.zero())
+    y12 = Fp12(Fp6.zero(), Fp6(Fp2.zero(), y2 * _XI_INV, Fp2.zero()))
+    return (x12, y12)
+
+
+def embed_g1(p):
+    """Embed an affine G1 point (Fp coords) into E(Fp12)."""
+    if p is None:
+        return None
+    x, y = p
+    return (
+        Fp12(Fp6(Fp2(x.v, 0), Fp2.zero(), Fp2.zero()), Fp6.zero()),
+        Fp12(Fp6(Fp2(y.v, 0), Fp2.zero(), Fp2.zero()), Fp6.zero()),
+    )
+
+
+def miller_loop(p_g1, q_g2) -> Fp12:
+    """f_{|x|,Q}(P) (conjugated for the negative BLS parameter), without the
+    final exponentiation.  `p_g1` is an affine G1 point, `q_g2` an affine G2
+    (twist) point; either may be None (infinity), yielding 1."""
+    if p_g1 is None or q_g2 is None:
+        return Fp12.one()
+    xp, yp = embed_g1(p_g1)
+    Q = untwist(q_g2)
+    xq, yq = Q
+    f = Fp12.one()
+    xt, yt = xq, yq
+    for bit in _X_BITS[1:]:
+        # Tangent line at T, evaluated at P.
+        slope = (xt.square() * 3) * (yt * 2).inv()
+        line = yp - yt - slope * (xp - xt)
+        f = f.square() * line
+        # T = 2T (affine doubling via the same slope).
+        x_new = slope.square() - xt * 2
+        y_new = slope * (xt - x_new) - yt
+        xt, yt = x_new, y_new
+        if bit == "1":
+            # Chord line through T and Q, evaluated at P.
+            slope = (yq - yt) * (xq - xt).inv()
+            line = yp - yt - slope * (xp - xt)
+            f = f * line
+            x_new = slope.square() - xt - xq
+            y_new = slope * (xt - x_new) - yt
+            xt, yt = x_new, y_new
+    # x < 0: f_{-n} ≡ conj(f_n) modulo the final exponentiation.
+    return f.conjugate()
+
+
+# Hard-part exponent (p^4 - p^2 + 1) / r, computed once.
+_P = params.P
+_HARD_EXP, _hard_rem = divmod(_P**4 - _P**2 + 1, params.R)
+assert _hard_rem == 0
+
+
+def final_exponentiation(f: Fp12) -> Fp12:
+    """f^((p^12-1)/r): easy part via Frobenius/conjugation, hard part as a
+    plain square-and-multiply (reference oracle; the JAX backend uses the
+    cyclotomic x-chain, differentially tested against this)."""
+    # Easy part: f^(p^6-1) then ^(p^2+1).
+    f = f.conjugate() * f.inv()
+    f = f.frobenius_n(2) * f
+    # Hard part.
+    return f.pow(_HARD_EXP)
+
+
+def multi_miller_loop(pairs) -> Fp12:
+    f = Fp12.one()
+    for p, q in pairs:
+        f = f * miller_loop(p, q)
+    return f
+
+
+def pairing(p, q) -> Fp12:
+    return final_exponentiation(miller_loop(p, q))
+
+
+def pairing_check(pairs) -> bool:
+    """True iff prod e(P_i, Q_i) == 1."""
+    return final_exponentiation(multi_miller_loop(pairs)) == Fp12.one()

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Sharding/mesh tests run on a virtual 8-device CPU mesh: set XLA_FLAGS and
+JAX_PLATFORMS *before* jax initializes (tests must not require real TPU
+hardware; the driver separately compile-checks the TPU path).
+"""
+
+import os
+import sys
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

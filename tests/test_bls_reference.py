@@ -1,0 +1,363 @@
+"""Tests for the pure-Python BLS12-381 reference backend.
+
+Test strategy mirrors the reference's tier-1 unit tests plus the semantics of
+the EF BLS conformance cases (reference: testing/ef_tests/src/cases/
+bls_batch_verify.rs, bls_fast_aggregate_verify.rs) — the canonical vectors are
+not available offline, so these tests assert the algebraic properties the
+vectors encode (bilinearity, roundtrips, subgroup rejection, batch semantics).
+"""
+
+import secrets
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import (
+    AggregateSignature,
+    BlsError,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureSet,
+    aggregate_verify,
+    eth_fast_aggregate_verify,
+    fast_aggregate_verify,
+    params,
+    verify,
+    verify_signature_sets,
+)
+from lighthouse_tpu.crypto.bls import curve, pairing
+from lighthouse_tpu.crypto.bls.fields import Fp, Fp2, Fp6, Fp12, XI
+from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+
+
+# ---------------------------------------------------------------------------
+# Field tower
+# ---------------------------------------------------------------------------
+
+
+def rand_fp2():
+    return Fp2(secrets.randbelow(params.P), secrets.randbelow(params.P))
+
+
+def rand_fp6():
+    return Fp6(rand_fp2(), rand_fp2(), rand_fp2())
+
+
+def rand_fp12():
+    return Fp12(rand_fp6(), rand_fp6())
+
+
+class TestFields:
+    def test_fp2_inverse(self):
+        for _ in range(10):
+            a = rand_fp2()
+            assert a * a.inv() == Fp2.one()
+
+    def test_fp2_sqrt_roundtrip(self):
+        for _ in range(10):
+            a = rand_fp2()
+            sq = a.square()
+            s = sq.sqrt()
+            assert s is not None and s.square() == sq
+
+    def test_fp6_inverse(self):
+        for _ in range(5):
+            a = rand_fp6()
+            assert a * a.inv() == Fp6.one()
+
+    def test_fp12_inverse(self):
+        for _ in range(5):
+            a = rand_fp12()
+            assert a * a.inv() == Fp12.one()
+
+    def test_fp12_square_matches_mul(self):
+        for _ in range(5):
+            a = rand_fp12()
+            assert a.square() == a * a
+
+    def test_frobenius_is_p_power(self):
+        a = rand_fp2()
+        assert a.conjugate() == a.pow(params.P)
+
+    def test_fp12_frobenius_order(self):
+        a = rand_fp12()
+        assert a.frobenius_n(12) == a
+
+    def test_fp12_frobenius_is_hom(self):
+        a, b = rand_fp12(), rand_fp12()
+        assert (a * b).frobenius() == a.frobenius() * b.frobenius()
+
+    def test_xi_nonresidue(self):
+        # xi must not be a cube or square in Fp2 for the tower to be a field
+        # (verified indirectly: Fp6/Fp12 inverses above would fail otherwise).
+        assert XI == Fp2(1, 1)
+
+
+# ---------------------------------------------------------------------------
+# Curve groups
+# ---------------------------------------------------------------------------
+
+
+class TestCurve:
+    def test_generators_on_curve_and_in_subgroup(self):
+        assert curve.is_on_curve(curve.G1_GENERATOR, curve.B1, Fp)
+        assert curve.is_on_curve(curve.G2_GENERATOR, curve.B2, Fp2)
+        assert curve.g1_subgroup_check(curve.G1_GENERATOR)
+        assert curve.g2_subgroup_check(curve.G2_GENERATOR)
+
+    def test_scalar_mul_matches_repeated_add(self):
+        g = curve.G1_GENERATOR
+        acc = None
+        for k in range(1, 6):
+            acc = curve.affine_add(acc, g, Fp)
+            assert curve.affine_mul(g, k, Fp) == acc
+
+    def test_g1_serialization_roundtrip(self):
+        for k in (1, 2, 12345, params.R - 1):
+            pt = curve.affine_mul(curve.G1_GENERATOR, k, Fp)
+            data = curve.g1_to_bytes(pt)
+            assert len(data) == 48
+            assert curve.g1_from_bytes(data) == pt
+
+    def test_g2_serialization_roundtrip(self):
+        for k in (1, 7, 99999):
+            pt = curve.affine_mul(curve.G2_GENERATOR, k, Fp2)
+            data = curve.g2_to_bytes(pt)
+            assert len(data) == 96
+            assert curve.g2_from_bytes(data) == pt
+
+    def test_infinity_serialization(self):
+        assert curve.g1_to_bytes(None)[0] == 0xC0
+        assert curve.g1_from_bytes(curve.g1_to_bytes(None)) is None
+        assert curve.g2_from_bytes(curve.g2_to_bytes(None)) is None
+
+    def test_g1_generator_known_bytes(self):
+        # The standard compressed G1 generator encoding (public constant).
+        expected = bytes.fromhex(
+            "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+            "6c55e83ff97a1aeffb3af00adb22c6bb"
+        )
+        assert curve.g1_to_bytes(curve.G1_GENERATOR) == expected
+
+    def test_non_subgroup_point_rejected(self):
+        # A point on E but (overwhelmingly likely) outside G1: multiply the
+        # generator by the cofactor inverse trick — instead craft via cofactor:
+        # take any curve point with small x and clear nothing.
+        x = Fp(1)
+        while True:
+            rhs = x.square() * x + curve.B1
+            y = rhs.sqrt()
+            if y is not None:
+                pt = (x, y)
+                break
+            x = x + Fp(1)
+        if curve.g1_subgroup_check(pt):
+            pytest.skip("found subgroup point by chance")
+        data = curve.g1_to_bytes(pt)
+        with pytest.raises(ValueError):
+            curve.g1_from_bytes(data)
+
+
+# ---------------------------------------------------------------------------
+# Pairing
+# ---------------------------------------------------------------------------
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        g1, g2 = curve.G1_GENERATOR, curve.G2_GENERATOR
+        e = pairing.pairing(g1, g2)
+        a = pairing.pairing(curve.affine_mul(g1, 3, Fp), g2)
+        b = pairing.pairing(g1, curve.affine_mul(g2, 3, Fp2))
+        assert a == b == e * e * e
+
+    def test_pairing_order(self):
+        e = pairing.pairing(curve.G1_GENERATOR, curve.G2_GENERATOR)
+        assert e.pow(params.R) == Fp12.one()
+        assert e != Fp12.one()  # non-degeneracy
+
+    def test_pairing_check_cancellation(self):
+        g1, g2 = curve.G1_GENERATOR, curve.G2_GENERATOR
+        assert pairing.pairing_check(
+            [(g1, g2), (curve.affine_neg(g1), g2)]
+        )
+        assert not pairing.pairing_check([(g1, g2)])
+
+
+# ---------------------------------------------------------------------------
+# Hash to curve
+# ---------------------------------------------------------------------------
+
+
+class TestHashToCurve:
+    def test_output_in_subgroup(self):
+        for msg in (b"", b"abc", secrets.token_bytes(32)):
+            pt = hash_to_g2(msg)
+            assert pt is not None
+            assert curve.is_on_curve(pt, curve.B2, Fp2)
+            assert curve.g2_subgroup_check(pt)
+
+    def test_deterministic_and_distinct(self):
+        a = hash_to_g2(b"message one")
+        b = hash_to_g2(b"message one")
+        c = hash_to_g2(b"message two")
+        assert a == b
+        assert a != c
+
+    def test_expand_message_xmd_length(self):
+        from lighthouse_tpu.crypto.bls.hash_to_curve import expand_message_xmd
+
+        out = expand_message_xmd(b"msg", params.DST, 256)
+        assert len(out) == 256
+        # deterministic
+        assert out == expand_message_xmd(b"msg", params.DST, 256)
+
+
+# ---------------------------------------------------------------------------
+# Signature API semantics (reference parity)
+# ---------------------------------------------------------------------------
+
+
+SK1 = SecretKey(12345)
+SK2 = SecretKey(67890)
+SK3 = SecretKey(424242)
+MSG1 = b"\x11" * 32
+MSG2 = b"\x22" * 32
+
+
+class TestSignatures:
+    def test_sign_verify_roundtrip(self):
+        sig = SK1.sign(MSG1)
+        assert verify(SK1.public_key(), MSG1, sig)
+
+    def test_verify_wrong_message_fails(self):
+        sig = SK1.sign(MSG1)
+        assert not verify(SK1.public_key(), MSG2, sig)
+
+    def test_verify_wrong_key_fails(self):
+        sig = SK1.sign(MSG1)
+        assert not verify(SK2.public_key(), MSG1, sig)
+
+    def test_pubkey_roundtrip(self):
+        pk = SK1.public_key()
+        assert PublicKey.from_bytes(pk.to_bytes()) == pk
+
+    def test_signature_roundtrip(self):
+        sig = SK1.sign(MSG1)
+        assert Signature.from_bytes(sig.to_bytes()) == sig
+
+    def test_infinity_pubkey_rejected(self):
+        inf = bytes([0xC0]) + bytes(47)
+        with pytest.raises((BlsError, ValueError)):
+            PublicKey.from_bytes(inf)
+
+    def test_infinity_signature_never_verifies(self):
+        assert not verify(SK1.public_key(), MSG1, Signature.infinity())
+
+    def test_fast_aggregate_verify(self):
+        sks = [SK1, SK2, SK3]
+        sigs = [sk.sign(MSG1) for sk in sks]
+        agg = AggregateSignature.aggregate(sigs)
+        pks = [sk.public_key() for sk in sks]
+        assert fast_aggregate_verify(pks, MSG1, agg.signature)
+        assert not fast_aggregate_verify(pks, MSG2, agg.signature)
+        assert not fast_aggregate_verify(pks[:2], MSG1, agg.signature)
+
+    def test_aggregate_verify_distinct_messages(self):
+        sig1 = SK1.sign(MSG1)
+        sig2 = SK2.sign(MSG2)
+        agg = AggregateSignature.aggregate([sig1, sig2])
+        assert aggregate_verify(
+            [SK1.public_key(), SK2.public_key()], [MSG1, MSG2], agg.signature
+        )
+        assert not aggregate_verify(
+            [SK1.public_key(), SK2.public_key()], [MSG2, MSG1], agg.signature
+        )
+
+    def test_eth_fast_aggregate_verify_infinity_special_case(self):
+        assert eth_fast_aggregate_verify([], MSG1, Signature.infinity())
+        assert not fast_aggregate_verify([], MSG1, Signature.infinity())
+
+
+class TestSignatureSets:
+    def test_batch_verify_all_valid(self):
+        sets = [
+            SignatureSet(SK1.sign(MSG1), [SK1.public_key()], MSG1),
+            SignatureSet(SK2.sign(MSG2), [SK2.public_key()], MSG2),
+            SignatureSet(SK3.sign(MSG1), [SK3.public_key()], MSG1),
+        ]
+        assert verify_signature_sets(sets)
+
+    def test_batch_verify_one_bad_poisons_batch(self):
+        sets = [
+            SignatureSet(SK1.sign(MSG1), [SK1.public_key()], MSG1),
+            SignatureSet(SK2.sign(MSG2), [SK1.public_key()], MSG2),  # wrong key
+        ]
+        assert not verify_signature_sets(sets)
+
+    def test_batch_verify_empty_input_false(self):
+        # Reference: empty sets => false (blst.rs:35-47 semantics).
+        assert not verify_signature_sets([])
+
+    def test_batch_verify_multi_key_set(self):
+        # A set whose message is signed by an aggregate of several keys —
+        # the aggregated-attestation shape (3-set aggregates in the
+        # reference's attestation pipeline).
+        sigs = [sk.sign(MSG1) for sk in (SK1, SK2, SK3)]
+        agg = AggregateSignature.aggregate(sigs)
+        s = SignatureSet(
+            agg.signature,
+            [sk.public_key() for sk in (SK1, SK2, SK3)],
+            MSG1,
+        )
+        assert verify_signature_sets([s])
+
+    def test_batch_verify_infinity_signature_false(self):
+        s = SignatureSet(Signature.infinity(), [SK1.public_key()], MSG1)
+        assert not verify_signature_sets([s])
+
+    def test_fake_backend(self):
+        from lighthouse_tpu.crypto.bls import set_backend
+
+        set_backend("fake")
+        try:
+            s = SignatureSet(Signature.infinity(), [SK1.public_key()], MSG1)
+            assert verify_signature_sets([s])
+        finally:
+            set_backend("python")
+
+
+class TestHashToG2KnownAnswers:
+    """Frozen known-answer anchors for hash_to_g2 with the Ethereum DST.
+
+    These bytes were generated by this implementation after its SSWU isogeny
+    sign convention and effective cofactor were verified against the RFC 9380
+    J.10.1 vectors (see hash_to_curve.py comments). They lock the hash output
+    against silent regressions in field/curve/isogeny code.
+    """
+
+    def test_empty_message(self):
+        out = curve.g2_to_bytes(hash_to_g2(b""))
+        assert out.hex() == (
+            "83b633b06dd88b63ee6180a849fb16f7d4a5823ec8a27294bfe57656c0f319a8"
+            "21478ccf453bacdc94ad1b79d95a00e4102504549e1cbd3e95173eefe75a36aa"
+            "fcc6427d7f16ddc36daba4fc0ea32b7183d052de00a929950bd9f78c290b3686"
+        )
+
+    def test_abc_message(self):
+        out = curve.g2_to_bytes(hash_to_g2(b"abc"))
+        assert out.hex() == (
+            "94b38e10fd6d2d63dfe704c3f0b1741474dfeaef88d6cdca4334413320701c74"
+            "e5df8c7859947f6901c0a3c30dba23c91400ddb63494b2f3717d8706a834f928"
+            "323cef590dd1f2bc8edaf857889e82c9b4cf242324526c9045bc8fec05f98fe9"
+        )
+
+    def test_h_eff_lands_in_subgroup(self):
+        # H_EFF differs from the naive cofactor by a unit mod r; both must
+        # land arbitrary curve points inside G2.
+        from lighthouse_tpu.crypto.bls.hash_to_curve import H_EFF_G2, sswu, iso_map
+        from lighthouse_tpu.crypto.bls.fields import Fp2 as F2
+
+        pt = iso_map(sswu(F2(123, 456)))
+        cleared = curve.affine_mul(pt, H_EFF_G2, F2)
+        assert curve.g2_subgroup_check(cleared)
