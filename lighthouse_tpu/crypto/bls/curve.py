@@ -171,10 +171,24 @@ assert affine_mul(G2_GENERATOR, params.R, Fp2) is None
 
 
 def g1_subgroup_check(pt) -> bool:
-    return affine_mul(pt, params.R, Fp) is None
+    """Fast endomorphism-based membership test (endo.py), asserted there to
+    equal the defining [r]P == inf check on random points."""
+    from .endo import g1_subgroup_check_fast
+
+    return g1_subgroup_check_fast(pt)
 
 
 def g2_subgroup_check(pt) -> bool:
+    from .endo import g2_subgroup_check_fast
+
+    return g2_subgroup_check_fast(pt)
+
+
+def g1_subgroup_check_slow(pt) -> bool:
+    return affine_mul(pt, params.R, Fp) is None
+
+
+def g2_subgroup_check_slow(pt) -> bool:
     return affine_mul(pt, params.R, Fp2) is None
 
 

@@ -378,6 +378,37 @@ class Fp12:
         cyclotomic subgroup (unit-norm elements after the easy part)."""
         return Fp12(self.c0, -self.c1)
 
+    def mul_by_023(self, l0: Fp2, l2: Fp2, l3: Fp2) -> "Fp12":
+        """Multiply by the sparse element l0 + l2*w^2 + l3*w^3 (a Miller-loop
+        line function in the basis Fp12 = Fp2[w]/(w^6 - xi)).  In the tower
+        that element is (b0, b1) with b0 = (l0, l2, 0), b1 = (0, l3, 0);
+        exploiting the zeros costs ~15 Fp2 muls vs 18+ for the dense mul."""
+        a0, a1 = self.c0, self.c1
+        # t0 = a0 * b0, b0 = (l0, l2, 0):
+        #   z0 = x0*l0 + xi*(x2*l2); z1 = x0*l2 + x1*l0; z2 = x1*l2 + x2*l0
+        t0 = Fp6(
+            a0.c0 * l0 + (a0.c2 * l2).mul_by_nonresidue(),
+            a0.c0 * l2 + a0.c1 * l0,
+            a0.c1 * l2 + a0.c2 * l0,
+        )
+        # t1 = a1 * b1, b1 = (0, l3, 0):  (x0,x1,x2)*(l3 v) =
+        #   xi*(x2*l3) + x0*l3 v + x1*l3 v^2
+        t1 = Fp6(
+            (a1.c2 * l3).mul_by_nonresidue(),
+            a1.c0 * l3,
+            a1.c1 * l3,
+        )
+        # c0 = t0 + t1*v ; c1 = (a0+a1)(b0+b1) - t0 - t1 with
+        # b0+b1 = (l0, l2+l3, 0).
+        s = a0 + a1
+        l23 = l2 + l3
+        t2 = Fp6(
+            s.c0 * l0 + (s.c2 * l23).mul_by_nonresidue(),
+            s.c0 * l23 + s.c1 * l0,
+            s.c1 * l23 + s.c2 * l0,
+        )
+        return Fp12(t0 + t1.mul_by_v(), t2 - t0 - t1)
+
     def frobenius(self) -> "Fp12":
         c0 = _fp6_frobenius(self.c0)
         c1 = _fp6_frobenius(self.c1)

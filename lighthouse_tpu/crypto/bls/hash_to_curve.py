@@ -131,7 +131,23 @@ def iso_map(pt):
 
 
 def hash_to_g2(msg: bytes, dst: bytes = params.DST):
-    """Full hash_to_curve; returns an affine G2 point."""
+    """Full hash_to_curve; returns an affine G2 point.
+
+    Cofactor clearing uses the endomorphism-based fast path (endo.py), which
+    is asserted at import time to equal multiplication by H_EFF_G2 on random
+    twist points; `hash_to_g2_slow` keeps the literal RFC scalar mul as the
+    differential anchor.
+    """
+    from .endo import clear_cofactor_fast
+
+    u0, u1 = hash_to_field_fp2(msg, 2, dst)
+    q0 = iso_map(sswu(u0))
+    q1 = iso_map(sswu(u1))
+    return clear_cofactor_fast(affine_add(q0, q1, Fp2))
+
+
+def hash_to_g2_slow(msg: bytes, dst: bytes = params.DST):
+    """Literal RFC 9380 pipeline with scalar-mul cofactor clearing."""
     u0, u1 = hash_to_field_fp2(msg, 2, dst)
     q0 = iso_map(sswu(u0))
     q1 = iso_map(sswu(u1))

@@ -6,18 +6,23 @@ one shared final exponentiation (reference: crypto/bls/src/impls/blst.rs:107-117
 and SURVEY.md §3.5).  The JAX/TPU backend reimplements the same math with
 limb-vectorized kernels; this module is the differential-test oracle.
 
-Implementation choice: G2 points are *untwisted* into E(Fp12) and the Miller
-loop runs generically over Fp12 with affine line evaluations.  That is slow
-(Python bignums) but transparently correct: vertical-line denominators lie in
-Fp6 (the untwisted x-coordinates have no w-component), so they are erased by
-the final exponentiation and can be omitted — the classical denominator
-elimination that makes the M-twist convenient.
+Two Miller loops are provided:
+
+* `miller_loop` (the default) — the fast, twist-based loop: the G2 point stays
+  on E'(Fp2) in Jacobian coordinates, line functions are evaluated directly in
+  the sparse basis Fp12 = Fp2[w]/(w^6 - xi) at positions (w^0, w^2, w^3), and
+  inversion-free formulas absorb all denominators into Fp2/Fp4 factors that
+  the final exponentiation erases.  This is the structure the JAX/TPU kernels
+  mirror step for step.
+* `miller_loop_untwisted` — the original transparent implementation that
+  untwists G2 into E(Fp12) and runs affine formulas generically.  It is the
+  oracle's oracle: tests assert the two agree after final exponentiation.
 """
 
 from __future__ import annotations
 
 from . import params
-from .fields import Fp, Fp2, Fp6, Fp12, XI
+from .fields import Fp, Fp2, Fp6, Fp12, XI, fp12_from_fp2_coeffs
 
 # Loop count: |x|, MSB-first bit string.
 _X_ABS = abs(params.X)
@@ -51,7 +56,86 @@ def embed_g1(p):
     )
 
 
+def _line_dbl(T, xp_v: int, yp_v: int):
+    """Tangent line at Jacobian twist point T, evaluated at P = (xp, yp),
+    scaled by 2*Y*Z^3 (an Fp2 factor, erased by the final exponentiation) and
+    by w^3 (an Fp4 factor, likewise erased).  Returns the sparse coefficients
+    (l0, l2, l3) at w^0/w^2/w^3 and the doubled point.
+
+    Derivation (slope lam = 3x^2/2y, x = X/Z^2, y = Y/Z^3):
+      l*w^3 = yp*w^3 - lam*xp*w^2 + (lam*x - y)*w^0 ; multiply by 2YZ^3:
+      l0 = 3X^3 - 2Y^2,  l2 = -3X^2Z^2*xp,  l3 = 2YZ^3*yp.
+    """
+    X1, Y1, Z1 = T
+    X_sq = X1.square()
+    Y_sq = Y1.square()
+    Z_sq = Z1.square()
+    Z_cu = Z_sq * Z1
+    l0 = X_sq * X1 * 3 - Y_sq * 2
+    l2 = -(X_sq * Z_sq * 3) * xp_v
+    l3 = (Y1 * Z_cu * 2) * yp_v
+    # Jacobian doubling (a = 0), reusing X_sq / Y_sq.
+    C = Y_sq.square()
+    D = ((X1 + Y_sq).square() - X_sq - C) * 2
+    E = X_sq * 3
+    F = E.square()
+    X3 = F - D * 2
+    Y3 = E * (D - X3) - C * 8
+    Z3 = (Y1 * Z1) * 2
+    return (l0, l2, l3), (X3, Y3, Z3)
+
+
+def _line_add(T, Q, xp_v: int, yp_v: int):
+    """Chord line through Jacobian T and affine twist Q, evaluated at P,
+    scaled by Z*H (Fp2, erased) and w^3.  Returns ((l0, l2, l3), T + Q).
+
+    With U2 = x2 Z^2, S2 = y2 Z^3, H = U2 - X, r = S2 - Y, lam = r/(Z*H):
+      l0 = r*x2 - y2*Z*H,  l2 = -r*xp,  l3 = Z*H*yp.
+    """
+    X1, Y1, Z1 = T
+    x2, y2 = Q
+    Z_sq = Z1.square()
+    Z_cu = Z_sq * Z1
+    H = x2 * Z_sq - X1
+    rr = y2 * Z_cu - Y1
+    ZH = Z1 * H
+    l0 = rr * x2 - y2 * ZH
+    l2 = -rr * xp_v
+    l3 = ZH * yp_v
+    # Mixed Jacobian + affine addition via the same H / rr.
+    H_sq = H.square()
+    H_cu = H * H_sq
+    V = X1 * H_sq
+    X3 = rr.square() - H_cu - V * 2
+    Y3 = rr * (V - X3) - Y1 * H_cu
+    Z3 = ZH
+    return (l0, l2, l3), (X3, Y3, Z3)
+
+
+def _sparse_to_fp12(l0: Fp2, l2: Fp2, l3: Fp2) -> Fp12:
+    return fp12_from_fp2_coeffs([l0, Fp2.zero(), l2, l3, Fp2.zero(), Fp2.zero()])
+
+
 def miller_loop(p_g1, q_g2) -> Fp12:
+    """Twist-based Miller loop: f_{|x|,Q}(P) conjugated for the negative BLS
+    parameter, up to Fp2/Fp4 scalings erased by the final exponentiation.
+    `p_g1` is an affine G1 point, `q_g2` an affine G2 (twist) point; either
+    may be None (infinity), yielding 1."""
+    if p_g1 is None or q_g2 is None:
+        return Fp12.one()
+    xp_v, yp_v = p_g1[0].v, p_g1[1].v
+    T = (q_g2[0], q_g2[1], Fp2.one())
+    f = Fp12.one()
+    for bit in _X_BITS[1:]:
+        line, T = _line_dbl(T, xp_v, yp_v)
+        f = f.square().mul_by_023(*line)
+        if bit == "1":
+            line, T = _line_add(T, q_g2, xp_v, yp_v)
+            f = f.mul_by_023(*line)
+    return f.conjugate()
+
+
+def miller_loop_untwisted(p_g1, q_g2) -> Fp12:
     """f_{|x|,Q}(P) (conjugated for the negative BLS parameter), without the
     final exponentiation.  `p_g1` is an affine G1 point, `q_g2` an affine G2
     (twist) point; either may be None (infinity), yielding 1."""
@@ -100,6 +184,34 @@ def final_exponentiation(f: Fp12) -> Fp12:
     return f.pow(_HARD_EXP)
 
 
+def final_exp_is_one(f: Fp12) -> bool:
+    """Fast check  f^((p^12-1)/r) == 1  via the cubed hard part.
+
+    Uses the BLS12 identity  3*hard = (x-1)^2 (x+p) (x^2+p^2-1) + 3
+    (asserted below): since gcd(3, r) = 1, f^(easy*3*hard) == 1 iff
+    f^(easy*hard) == 1.  Exponentiations by x are 64-bit, so this is ~2x
+    cheaper than the generic 381-bit hard-part pow — and it is the exact
+    structure the JAX backend's final exponentiation mirrors.
+    """
+    x = params.X
+    # Easy part: f^((p^6-1)(p^2+1)).
+    m = f.conjugate() * f.inv()
+    m = m.frobenius_n(2) * m
+    # Cubed hard part.
+    a = m.pow(x - 1)
+    a = a.pow(x - 1)
+    b = a.frobenius() * a.pow(x)  # a^(x+p)
+    # b is in the cyclotomic subgroup (it is a power of m, which satisfies
+    # m^(p^6+1) = 1), so conjugation is inversion.
+    c = b.pow(x).pow(x) * b.frobenius_n(2) * b.conjugate()  # b^(x^2+p^2-1)
+    return c * m.square() * m == Fp12.one()
+
+
+assert 3 * _HARD_EXP == (params.X - 1) ** 2 * (params.X + _P) * (
+    params.X**2 + _P**2 - 1
+) + 3
+
+
 def multi_miller_loop(pairs) -> Fp12:
     f = Fp12.one()
     for p, q in pairs:
@@ -108,9 +220,11 @@ def multi_miller_loop(pairs) -> Fp12:
 
 
 def pairing(p, q) -> Fp12:
-    return final_exponentiation(miller_loop(p, q))
+    """Exact pairing value (uses the transparent untwisted loop so that the
+    result is the canonical e(P, Q), free of the twist-loop's scalings)."""
+    return final_exponentiation(miller_loop_untwisted(p, q))
 
 
 def pairing_check(pairs) -> bool:
     """True iff prod e(P_i, Q_i) == 1."""
-    return final_exponentiation(multi_miller_loop(pairs)) == Fp12.one()
+    return final_exp_is_one(multi_miller_loop(pairs))

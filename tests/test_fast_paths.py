@@ -1,0 +1,137 @@
+"""Differential tests: fast endomorphism/twist paths vs their slow anchors.
+
+The production verify path now runs the twist-based Miller loop, the
+endomorphism subgroup checks, and the Budroni-Pintore cofactor clearing.
+Each is pinned here against the transparent slow definition it replaced
+(reference semantics: crypto/bls/src/impls/blst.rs subgroup checks and
+hash-to-curve via blst).
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import endo, params
+from lighthouse_tpu.crypto.bls import pairing as pr
+from lighthouse_tpu.crypto.bls.curve import (
+    B1,
+    B2,
+    Fp,
+    Fp2,
+    G1_GENERATOR,
+    G2_GENERATOR,
+    affine_add,
+    affine_mul,
+    affine_neg,
+    g1_subgroup_check,
+    g1_subgroup_check_slow,
+    g2_subgroup_check,
+    g2_subgroup_check_slow,
+)
+from lighthouse_tpu.crypto.bls.fields import Fp2 as F2, Fp12
+from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2, hash_to_g2_slow
+
+rng = random.Random(0xFA57)
+
+
+def random_g1():
+    return affine_mul(G1_GENERATOR, rng.randrange(1, params.R), Fp)
+
+
+def random_g2():
+    return affine_mul(G2_GENERATOR, rng.randrange(1, params.R), Fp2)
+
+
+def random_e1_point():
+    """Random point of E(Fp) — almost surely NOT in G1."""
+    while True:
+        x = Fp(rng.randrange(params.P))
+        y = (x.square() * x + B1).sqrt()
+        if y is not None:
+            return (x, y)
+
+
+def random_e2_point():
+    while True:
+        x = Fp2(rng.randrange(params.P), rng.randrange(params.P))
+        y = (x.square() * x + B2).sqrt()
+        if y is not None:
+            return (x, y)
+
+
+def test_twist_miller_matches_untwisted():
+    for _ in range(2):
+        P, Q = random_g1(), random_g2()
+        fast = pr.final_exponentiation(pr.miller_loop(P, Q))
+        slow = pr.final_exponentiation(pr.miller_loop_untwisted(P, Q))
+        assert fast == slow
+
+
+def test_twist_miller_infinity_pairs():
+    assert pr.miller_loop(None, random_g2()) == Fp12.one()
+    assert pr.miller_loop(random_g1(), None) == Fp12.one()
+
+
+def test_final_exp_is_one_matches_exact():
+    P, Q = random_g1(), random_g2()
+    f = pr.miller_loop_untwisted(P, Q)
+    assert pr.final_exp_is_one(f) == (pr.final_exponentiation(f) == Fp12.one())
+    # A value that IS one after final exp: e(aP, Q) * e(-P, aQ).
+    a = rng.randrange(2, 2**64)
+    good = pr.multi_miller_loop(
+        [
+            (affine_mul(P, a, Fp), Q),
+            (affine_neg(P), affine_mul(Q, a, Fp2)),
+        ]
+    )
+    assert pr.final_exp_is_one(good)
+    assert pr.final_exponentiation(good) == Fp12.one()
+
+
+def test_mul_by_023_matches_dense():
+    for _ in range(3):
+        coeffs = [
+            F2(rng.randrange(params.P), rng.randrange(params.P)) for _ in range(3)
+        ]
+        f_coeffs = [
+            F2(rng.randrange(params.P), rng.randrange(params.P)) for _ in range(6)
+        ]
+        from lighthouse_tpu.crypto.bls.fields import fp12_from_fp2_coeffs
+
+        f = fp12_from_fp2_coeffs(f_coeffs)
+        dense = f * pr._sparse_to_fp12(*coeffs)
+        sparse = f.mul_by_023(*coeffs)
+        assert dense == sparse
+
+
+def test_g1_subgroup_check_fast_vs_slow():
+    for _ in range(3):
+        pt = random_e1_point()
+        assert g1_subgroup_check(pt) == g1_subgroup_check_slow(pt)
+        cleared = affine_mul(pt, params.H1, Fp)
+        assert g1_subgroup_check(cleared) and g1_subgroup_check_slow(cleared)
+    assert g1_subgroup_check(random_g1())
+
+
+def test_g2_subgroup_check_fast_vs_slow():
+    for _ in range(2):
+        pt = random_e2_point()
+        assert g2_subgroup_check(pt) == g2_subgroup_check_slow(pt)
+        cleared = endo.clear_cofactor_fast(pt)
+        assert g2_subgroup_check(cleared) and g2_subgroup_check_slow(cleared)
+    assert g2_subgroup_check(random_g2())
+
+
+def test_hash_to_g2_fast_equals_slow():
+    for msg in (b"", b"abc", bytes(32)):
+        assert hash_to_g2(msg) == hash_to_g2_slow(msg)
+
+
+def test_psi_acts_as_x_on_g2():
+    Q = random_g2()
+    assert endo.psi(Q) == affine_mul(Q, params.X, Fp2)
+
+
+def test_phi_acts_as_lambda_on_g1():
+    P = random_g1()
+    assert endo.phi(P) == affine_mul(P, endo.LAMBDA, Fp)
