@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Benchmark: batched BLS12-381 signature-set verification on the TPU.
+
+Measures the device verification kernel (the north-star workload,
+BASELINE.md: >= 50,000 signature-sets/s on one TPU v5e chip) and prints ONE
+JSON line:
+
+    {"metric": "tpu_batch_verify", "value": <sets/s>, "unit": "sets/s",
+     "vs_baseline": <value / 50000>}
+
+The timed section is the jitted device kernel — subgroup checks, weight
+scalar muls, Miller loops, GT reduction, final exponentiation — on a
+pre-marshaled batch, matching what blst's verify_multiple_aggregate_signatures
+timing covers (hashing excluded there too, it happens at gossip decode).
+Host-side hash/marshal cost is reported separately on stderr.
+
+Env knobs: BENCH_BATCH (default 512), BENCH_ITERS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    B = int(os.environ.get("BENCH_BATCH", "512"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+
+    import jax
+
+    from __graft_entry__ import _example_batch
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+
+    t0 = time.time()
+    args = _example_batch(B)
+    t_marshal = time.time() - t0
+    print(
+        f"host build+hash+marshal for B={B}: {t_marshal:.1f}s "
+        f"({B / t_marshal:.0f} sets/s host-side)",
+        file=sys.stderr,
+    )
+
+    args = jax.device_put(args, dev)
+    fn = jax.jit(_verify_kernel)
+
+    t0 = time.time()
+    ok = fn(*args)
+    ok.block_until_ready()
+    t_compile = time.time() - t0
+    print(f"compile+first run: {t_compile:.1f}s, result={bool(ok)}", file=sys.stderr)
+    assert bool(ok) is True, "benchmark batch must verify"
+
+    times = []
+    for _ in range(iters):
+        t0 = time.time()
+        fn(*args).block_until_ready()
+        times.append(time.time() - t0)
+    t_best = min(times)
+    sets_per_s = B / t_best
+    print(
+        f"kernel: best {t_best*1000:.1f}ms over {iters} iters -> "
+        f"{sets_per_s:.1f} sets/s",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "tpu_batch_verify",
+                "value": round(sets_per_s, 1),
+                "unit": "sets/s",
+                "vs_baseline": round(sets_per_s / 50000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

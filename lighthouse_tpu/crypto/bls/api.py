@@ -337,6 +337,11 @@ def register_backend(backend) -> None:
 
 
 def set_backend(name: str) -> None:
+    if name == "jax" and name not in _BACKENDS:
+        # Lazy registration so importing the api never pulls in jax.
+        from .jax_backend.backend import register as _register_jax
+
+        _register_jax()
     if name not in _BACKENDS:
         raise KeyError(f"unknown BLS backend {name!r}; have {sorted(_BACKENDS)}")
     _ACTIVE[0] = _BACKENDS[name]

@@ -9,9 +9,10 @@ reference crypto/bls/src/impls/blst.rs:13).  Pipeline:
         --isogeny--> two points on E2 (the twist), added
         --clear_cofactor--> G2
 
-SHA-256 runs host-side (hashlib); the curve legs are pure field arithmetic and
-have JAX twins in jax_backend/.  The isogeny constants are derived, not
-transcribed — see tools/derive_g2_isogeny.py and g2_isogeny.py.
+SHA-256 runs host-side (hashlib).  The field/curve/pairing layers this feeds
+have JAX twins in jax_backend/ (fp.py, tower.py, points.py, pairing.py); the
+SSWU map itself currently runs host-side.  The isogeny constants are derived,
+not transcribed — see tools/derive_g2_isogeny.py and g2_isogeny.py.
 """
 
 from __future__ import annotations

@@ -1,0 +1,10 @@
+"""JAX/TPU BLS12-381 backend: limb-vectorized field, curve, and pairing
+kernels plus the "jax" verification backend (backend.py).
+
+Importing this package requires jax; the api registry loads it lazily via
+``set_backend("jax")``.
+"""
+
+from .backend import JaxBackend, register
+
+__all__ = ["JaxBackend", "register"]

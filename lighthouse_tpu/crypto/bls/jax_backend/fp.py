@@ -1,0 +1,288 @@
+"""Batched BLS12-381 base-field arithmetic in JAX — the TPU limb kernel core.
+
+This is the device analog of blst's C/assembly fp arithmetic (the reference
+consumes it via crypto/bls/src/impls/blst.rs); every higher layer of the TPU
+backend (Fp2/Fp6/Fp12 tower, curve ops, pairing) is built on the ops here and
+is differentially tested against the pure-Python oracle (fields.py).
+
+Representation
+--------------
+An Fp element is 24 x 16-bit limbs, little-endian, each stored in a uint32
+lane: shape ``(24, *batch)`` — the limb axis LEADS so that the trailing batch
+axis lands on the TPU's 128-wide vector lanes and every limb op is a full-width
+VPU instruction over the batch.  Values are kept canonical (limbs < 2^16,
+value < P) in Montgomery form (R = 2^384).
+
+Multiplication is schoolbook over limbs via a Horner scan (MSB-first:
+acc = acc * 2^16 + a_i * b), with each 32-bit partial product split into
+16-bit halves before accumulation so column sums stay < 2^22 (no overflow in
+uint32).  Montgomery reduction is the standard  m = T * P' mod R;
+T' = (T + m*P) / R  with one conditional subtraction.
+
+All loops over limbs are ``lax.scan``s so the traced graph stays compact
+enough to nest inside the Miller-loop scan.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import params
+
+# ---------------------------------------------------------------------------
+# Constants
+# ---------------------------------------------------------------------------
+
+BITS = 16
+N = 24  # 24 * 16 = 384 bits >= 381
+MASK = (1 << BITS) - 1
+BASE = 1 << BITS
+U32 = jnp.uint32
+
+P_INT = params.P
+R_INT = 1 << (BITS * N)  # Montgomery radix 2^384
+assert R_INT > P_INT
+R1_INT = R_INT % P_INT  # 1 in Montgomery form
+R2_INT = R_INT * R_INT % P_INT  # for to-Montgomery conversion
+PPRIME_INT = (-pow(P_INT, -1, R_INT)) % R_INT  # -P^-1 mod R
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host codec: non-negative int < 2^384 -> (N,) uint32 limb vector."""
+    assert 0 <= x < R_INT
+    return np.array([(x >> (BITS * i)) & MASK for i in range(N)], dtype=np.uint32)
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(v) << (BITS * i) for i, v in enumerate(arr))
+
+
+def ints_to_limbs(xs) -> np.ndarray:
+    """Host codec for a batch: list of ints -> (N, len(xs)) uint32."""
+    out = np.zeros((N, len(xs)), dtype=np.uint32)
+    for j, x in enumerate(xs):
+        out[:, j] = int_to_limbs(x)
+    return out
+
+
+def limbs_to_ints(limbs) -> list[int]:
+    arr = np.asarray(limbs)
+    flat = arr.reshape(N, -1)
+    return [limbs_to_int(flat[:, j]) for j in range(flat.shape[1])]
+
+
+P_LIMBS = jnp.asarray(int_to_limbs(P_INT))
+PPRIME_LIMBS = jnp.asarray(int_to_limbs(PPRIME_INT))
+ONE_MONT = jnp.asarray(int_to_limbs(R1_INT))
+R2_LIMBS = jnp.asarray(int_to_limbs(R2_INT))
+ZERO = jnp.zeros((N,), dtype=U32)
+
+
+def bcast(const, batch_shape) -> jnp.ndarray:
+    """Broadcast an (N,) constant to (N, *batch_shape)."""
+    return jnp.broadcast_to(
+        const.reshape((N,) + (1,) * len(batch_shape)), (N,) + tuple(batch_shape)
+    )
+
+
+def zero_like(a):
+    return jnp.zeros_like(a)
+
+
+def one_like(a):
+    return bcast(ONE_MONT, a.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Carry / borrow chains (scans over the leading limb axis)
+# ---------------------------------------------------------------------------
+
+
+def carry_chain(cols):
+    """Normalize column sums (< 2^31) into canonical limbs; returns
+    (limbs, carry_out)."""
+    init = jnp.zeros(cols.shape[1:], dtype=U32)
+
+    def step(c, col):
+        t = col + c
+        return t >> BITS, t & MASK
+
+    carry, limbs = lax.scan(step, init, cols)
+    return limbs, carry
+
+
+def sub_chain(x, y):
+    """Limb-wise x - y with borrow; returns (diff mod 2^384, borrow_out)
+    where borrow_out is 1 iff x < y."""
+    init = jnp.zeros(x.shape[1:], dtype=U32)
+
+    def step(bor, xy):
+        x_k, y_k = xy
+        t = x_k + U32(BASE) - y_k - bor
+        return U32(1) - (t >> BITS), t & MASK
+
+    borrow, limbs = lax.scan(step, init, (x, y))
+    return limbs, borrow
+
+
+def _p_like(a):
+    return bcast(P_LIMBS, a.shape[1:])
+
+
+def cond_sub_p(x):
+    """x - P if x >= P else x  (x < 2P)."""
+    d, borrow = sub_chain(x, _p_like(x))
+    return jnp.where((borrow == 0)[None], d, x)
+
+
+# ---------------------------------------------------------------------------
+# Core field ops
+# ---------------------------------------------------------------------------
+
+
+def fp_add(a, b):
+    limbs, carry = carry_chain(a + b)
+    del carry  # a + b < 2P < 2^384: no carry out
+    return cond_sub_p(limbs)
+
+
+def fp_sub(a, b):
+    d, borrow = sub_chain(a, b)
+    # If a < b, add P back (drop the carry: d already wrapped mod 2^384).
+    dp, _ = carry_chain(d + _p_like(a))
+    return jnp.where((borrow == 1)[None], dp, d)
+
+
+def fp_neg(a):
+    d, _ = sub_chain(_p_like(a), a)
+    return jnp.where(fp_is_zero(a)[None], a, d)
+
+
+def fp_is_zero(a):
+    return jnp.all(a == 0, axis=0)
+
+
+def fp_eq(a, b):
+    return jnp.all(a == b, axis=0)
+
+
+def fp_select(mask, a, b):
+    """mask over batch shape: a where mask else b."""
+    return jnp.where(mask[None], a, b)
+
+
+def mul_wide(a, b):
+    """Full 48-limb product of two canonical 24-limb numbers (normalized)."""
+    nb = a.shape[1:]
+    acc0 = jnp.zeros((2 * N,) + nb, dtype=U32)
+
+    def step(acc, a_i):
+        p = a_i[None] * b
+        plo = p & MASK
+        phi = p >> BITS
+        acc = jnp.concatenate([jnp.zeros_like(acc[:1]), acc[:-1]], axis=0)
+        acc = acc.at[:N].add(plo)
+        acc = acc.at[1 : N + 1].add(phi)
+        return acc, None
+
+    acc, _ = lax.scan(step, acc0, jnp.flip(a, 0))
+    limbs, carry = carry_chain(acc)
+    del carry  # product < 2^768
+    return limbs
+
+
+def mul_low(a, b):
+    """Low 24 limbs of a*b, i.e. a*b mod 2^384 (normalized)."""
+    nb = a.shape[1:]
+    acc0 = jnp.zeros((N,) + nb, dtype=U32)
+
+    def step(acc, a_i):
+        p = a_i[None] * b
+        plo = p & MASK
+        phi = p >> BITS
+        acc = jnp.concatenate([jnp.zeros_like(acc[:1]), acc[:-1]], axis=0)
+        acc = acc + plo
+        acc = acc.at[1:].add(phi[:-1])
+        return acc, None
+
+    acc, _ = lax.scan(step, acc0, jnp.flip(a, 0))
+    limbs, _ = carry_chain(acc)  # carries out of limb 23 are dropped (mod R)
+    return limbs
+
+
+def mont_mul(a, b):
+    """Montgomery product  a * b * R^-1 mod P  (canonical in, canonical out)."""
+    t = mul_wide(a, b)
+    m = mul_low(t[:N], bcast(PPRIME_LIMBS, a.shape[1:]))
+    u = mul_wide(m, _p_like(a))
+    s, carry = carry_chain(t + u)
+    del carry  # t + u < 2^768 for canonical inputs
+    return cond_sub_p(s[N:])
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def fp_dbl(a):
+    return fp_add(a, a)
+
+
+def to_mont(a):
+    """Standard-domain limbs -> Montgomery domain (device)."""
+    return mont_mul(a, bcast(R2_LIMBS, a.shape[1:]))
+
+
+def from_mont(a):
+    """Montgomery -> standard domain: mont_mul(a, 1)."""
+    return mont_mul(a, one_std_like(a))
+
+
+def one_std_like(a):
+    one = np.zeros((N,), dtype=np.uint32)
+    one[0] = 1
+    return bcast(jnp.asarray(one), a.shape[1:])
+
+
+def fp_pow(a, e: int):
+    """a^e for a static exponent (square-and-multiply scan over e's bits)."""
+    assert e >= 0
+    if e == 0:
+        return one_like(a)
+    bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=U32)
+
+    def step(acc, bit):
+        acc = mont_sqr(acc)
+        withmul = mont_mul(acc, a)
+        return jnp.where((bit == 1), withmul, acc), None
+
+    # MSB-first from acc = 1: first iteration yields a itself.
+    acc, _ = lax.scan(step, one_like(a), bits)
+    return acc
+
+
+def fp_inv(a):
+    """Inverse by Fermat: a^(P-2).  a == 0 maps to 0."""
+    return fp_pow(a, P_INT - 2)
+
+
+# ---------------------------------------------------------------------------
+# Host helpers: Montgomery-domain codecs
+# ---------------------------------------------------------------------------
+
+
+def encode_mont(xs) -> np.ndarray:
+    """Host: list of ints (standard domain) -> (N, B) Montgomery limbs."""
+    return ints_to_limbs([x * R_INT % P_INT for x in xs])
+
+
+def decode_mont(limbs) -> list[int]:
+    """Host: (N, ...) Montgomery limbs -> standard-domain ints."""
+    rinv = pow(R_INT, -1, P_INT)
+    return [x * rinv % P_INT for x in limbs_to_ints(limbs)]

@@ -1,0 +1,79 @@
+"""Multi-chip batch verification: data-parallel sharding over a device mesh.
+
+The reference scales batch BLS verification across CPU cores with rayon
+chunking (consensus/state_processing/src/per_block_processing/
+block_signature_verifier.rs:396-405: sets/threads chunks, AND-reduce).  The
+TPU analog shards the signature-set batch across the mesh's data axis with
+``shard_map``: every device runs subgroup checks, weight scalar muls, and
+Miller loops for its local shard; the tiny combine — the GT partial products
+(one Fp12 per device) and the local signature accumulators (one G2 point per
+device) — crosses ICI via all_gather, and the single final exponentiation is
+computed replicated.  The GT accumulation is associative, exactly the
+property SURVEY.md §2.8 calls out for mesh reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as PS
+
+from . import fp as F
+from . import pairing as PR
+from . import points as P
+from . import tower as T
+from .backend import _neg_gen_const, _tree_reduce_g2
+
+
+def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
+    """Build a jitted, mesh-sharded verify kernel.
+
+    Returns fn(pk_aff, sig_aff, h_aff, wbits) -> bool where all inputs carry
+    the global batch on the trailing axis (divisible by the mesh size).
+    """
+    from jax import shard_map
+
+    batch_spec = PS(None, axis)  # (limbs, B) arrays split on B
+
+    def local_part(pk_aff, sig_aff, h_aff, wbits):
+        # --- per-device heavy compute on the local shard ---
+        ok_sub = jnp.all(P.g2_subgroup_check(sig_aff))
+        wpk = P.scalar_mul_bits(P.FP_OPS, P.from_affine(P.FP_OPS, pk_aff), wbits)
+        wsig = P.scalar_mul_bits(
+            P.FP2_OPS, P.from_affine(P.FP2_OPS, sig_aff), wbits
+        )
+        S_local = _tree_reduce_g2(wsig)  # batch-1 G2 jacobian
+        wpk_aff = P.to_affine(P.FP_OPS, wpk, F.fp_inv)
+        f_local = PR.miller_loop(wpk_aff, h_aff)
+        g_local = PR.gt_product(f_local)  # batch-1 fp12
+        # --- tiny cross-device combine over ICI ---
+        g_all = jax.tree.map(
+            lambda a: jax.lax.all_gather(a, axis, axis=a.ndim - 1, tiled=True),
+            g_local,
+        )
+        S_all = jax.tree.map(
+            lambda a: jax.lax.all_gather(a, axis, axis=a.ndim - 1, tiled=True),
+            S_local,
+        )
+        ok_all = jnp.all(jax.lax.all_gather(ok_sub, axis))
+        # --- replicated epilogue: fold in (-G1, S) and final-exponentiate ---
+        g = PR.gt_product(g_all)
+        S = _tree_reduce_g2(S_all)
+        s_inf = P.pt_is_infinity(P.FP2_OPS, S)
+        S_aff = P.to_affine(P.FP2_OPS, S, T.fp2_inv)
+        neg_gen = _neg_gen_const()
+        f_last = PR.miller_loop(neg_gen, S_aff)
+        one = PR._fp12_one_like_from_fp2(S_aff[0])
+        f_last = T.fp12_select(jnp.broadcast_to(s_inf, (1,)), one, f_last)
+        total = T.fp12_mul(g, f_last)
+        ok_pair = PR.final_exp_is_one(total)
+        return jnp.reshape(ok_pair & ok_all, ())
+
+    sharded = shard_map(
+        local_part,
+        mesh=mesh,
+        in_specs=(batch_spec, batch_spec, batch_spec, batch_spec),
+        out_specs=PS(),
+        check_rep=False,
+    )
+    return jax.jit(sharded)

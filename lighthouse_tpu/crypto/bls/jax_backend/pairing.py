@@ -1,0 +1,169 @@
+"""Batched optimal-ate pairing in JAX — the TPU Miller loop.
+
+Mirrors the oracle's twist-based loop (bls/pairing.py: _line_dbl, _line_add,
+final_exp_is_one) step for step: Jacobian line formulas on the M-twist,
+sparse (w^0, w^2, w^3) line multiplication, conjugation for the negative BLS
+parameter, and the cubed-hard-part final exponentiation via the identity
+3*hard = (x-1)^2 (x+p) (x^2+p^2-1) + 3 (asserted in the oracle at import).
+
+Reference semantics: one Miller loop per (pubkey, message) pair plus one for
+the weighted signature aggregate, a single shared final exponentiation —
+blst's verify_multiple_aggregate_signatures (crypto/bls/src/impls/blst.rs:
+107-117, SURVEY.md §3.5).  Here the per-pair loops run vmapped-by-layout
+(batch = trailing axis), the GT product is a log-depth tree reduction over
+the batch axis, and the final exponentiation runs once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import params
+from . import fp as F
+from . import points as P
+from . import tower as T
+
+_X_BITS = [int(c) for c in bin(abs(params.X))[2:]]
+
+
+def _line_dbl(Tpt, xp, yp):
+    """Tangent line at Jacobian twist point, evaluated at P = (xp, yp) in
+    Montgomery limb form.  Returns ((l0, l2, l3), 2T) — the JAX twin of the
+    oracle's _line_dbl."""
+    X1, Y1, Z1 = Tpt
+    X_sq = T.fp2_sqr(X1)
+    Y_sq = T.fp2_sqr(Y1)
+    Z_sq = T.fp2_sqr(Z1)
+    Z_cu = T.fp2_mul(Z_sq, Z1)
+    l0 = T.fp2_sub(T.fp2_mul_small(T.fp2_mul(X_sq, X1), 3), T.fp2_dbl(Y_sq))
+    l2 = T.fp2_neg(T.fp2_mul_fp(T.fp2_mul_small(T.fp2_mul(X_sq, Z_sq), 3), xp))
+    l3 = T.fp2_mul_fp(T.fp2_dbl(T.fp2_mul(Y1, Z_cu)), yp)
+    # Jacobian doubling reusing X_sq / Y_sq.
+    C = T.fp2_sqr(Y_sq)
+    D = T.fp2_dbl(
+        T.fp2_sub(T.fp2_sub(T.fp2_sqr(T.fp2_add(X1, Y_sq)), X_sq), C)
+    )
+    E = T.fp2_mul_small(X_sq, 3)
+    Fv = T.fp2_sqr(E)
+    X3 = T.fp2_sub(Fv, T.fp2_dbl(D))
+    Y3 = T.fp2_sub(T.fp2_mul(E, T.fp2_sub(D, X3)), T.fp2_mul_small(C, 8))
+    Z3 = T.fp2_dbl(T.fp2_mul(Y1, Z1))
+    return (l0, l2, l3), (X3, Y3, Z3)
+
+
+def _line_add(Tpt, Q, xp, yp):
+    """Chord line through Jacobian T and affine twist Q, evaluated at P.
+    Returns ((l0, l2, l3), T + Q) — the JAX twin of the oracle's _line_add."""
+    X1, Y1, Z1 = Tpt
+    x2, y2 = Q
+    Z_sq = T.fp2_sqr(Z1)
+    Z_cu = T.fp2_mul(Z_sq, Z1)
+    H = T.fp2_sub(T.fp2_mul(x2, Z_sq), X1)
+    rr = T.fp2_sub(T.fp2_mul(y2, Z_cu), Y1)
+    ZH = T.fp2_mul(Z1, H)
+    l0 = T.fp2_sub(T.fp2_mul(rr, x2), T.fp2_mul(y2, ZH))
+    l2 = T.fp2_neg(T.fp2_mul_fp(rr, xp))
+    l3 = T.fp2_mul_fp(ZH, yp)
+    H_sq = T.fp2_sqr(H)
+    H_cu = T.fp2_mul(H, H_sq)
+    V = T.fp2_mul(X1, H_sq)
+    X3 = T.fp2_sub(T.fp2_sub(T.fp2_sqr(rr), H_cu), T.fp2_dbl(V))
+    Y3 = T.fp2_sub(T.fp2_mul(rr, T.fp2_sub(V, X3)), T.fp2_mul(Y1, H_cu))
+    return (l0, l2, l3), (X3, Y3, ZH)
+
+
+def miller_loop(p_aff, q_aff):
+    """Batched Miller loop over affine G1 points (xp, yp) and affine twist
+    points ((x2c0,x2c1),(y2c0,y2c1)); trailing axes are the batch.  Neither
+    input may be infinity (callers enforce this host-side, as the reference
+    rejects infinity pubkeys/signatures before pairing)."""
+    xp, yp = p_aff
+    bits = jnp.array(_X_BITS[1:], dtype=jnp.uint32)
+    T0 = (q_aff[0], q_aff[1], T.fp2_one_like(q_aff[0]))
+
+    def step(carry, bit):
+        f, Tpt = carry
+        line, Tpt = _line_dbl(Tpt, xp, yp)
+        f = T.fp12_mul_by_023(T.fp12_sqr(f), *line)
+        line_a, T_add = _line_add(Tpt, q_aff, xp, yp)
+        f_a = T.fp12_mul_by_023(f, *line_a)
+        take = bit == 1
+        f = jax.tree.map(lambda m, n: jnp.where(take, m, n), f_a, f)
+        Tpt = P.pt_select(P.FP2_OPS, take, T_add, Tpt)
+        return (f, Tpt), None
+
+    f_init = _fp12_one_like_from_fp2(q_aff[0])
+    (f, _), _ = lax.scan(step, (f_init, T0), bits)
+    return T.fp12_conj(f)
+
+
+def _fp12_one_like_from_fp2(x2):
+    z = T.fp2_zero_like(x2)
+    o = T.fp2_one_like(x2)
+    return ((o, z, z), (z, z, z))
+
+
+def gt_product(f):
+    """Reduce the trailing batch axis of an fp12 pytree by multiplication
+    (log-depth tree).  Batch must be along the last axis."""
+    B = jax.tree.leaves(f)[0].shape[-1]
+    # pad to a power of two with ones
+    target = 1 << max(1, (B - 1).bit_length())
+    if target != B:
+        pad_one = _fp12_one_like_pad(f, target - B)
+        f = jax.tree.map(
+            lambda a, b: jnp.concatenate([a, b], axis=-1), f, pad_one
+        )
+    n = target
+    while n > 1:
+        half = n // 2
+        lo = jax.tree.map(lambda a: a[..., :half], f)
+        hi = jax.tree.map(lambda a: a[..., half : 2 * half], f)
+        f = T.fp12_mul(lo, hi)
+        n = half
+    return f
+
+
+def _fp12_one_like_pad(f, count: int):
+    ref = jax.tree.leaves(f)[0]
+    shape = ref.shape[:-1] + (count,)
+    zero = jnp.zeros(shape, dtype=ref.dtype)
+    one_limbs = F.bcast(F.ONE_MONT, shape[1:])
+    z2 = (zero, zero)
+    o2 = (one_limbs, zero)
+    return ((o2, z2, z2), (z2, z2, z2))
+
+
+def final_exp_is_one(f):
+    """Device twin of the oracle's final_exp_is_one: easy part, then the
+    cubed hard part with 64-bit |x| exponentiations.  Returns bool(s) over
+    the batch shape of f (normally scalar after gt_product)."""
+    x = params.X
+    # Easy part: f^(p^6-1) = conj(f) * f^-1, then ^(p^2+1).
+    m = T.fp12_mul(T.fp12_conj(f), T.fp12_inv(f))
+    m = T.fp12_mul(T.fp12_frobenius_n(m, 2), m)
+    # m is now unit-norm: conjugation is inversion.
+    a = _pow_signed(m, x - 1)
+    a = _pow_signed(a, x - 1)
+    b = T.fp12_mul(T.fp12_frobenius(a), _pow_signed(a, x))
+    c = T.fp12_mul(
+        T.fp12_mul(_pow_signed(_pow_signed(b, x), x), T.fp12_frobenius_n(b, 2)),
+        T.fp12_conj(b),
+    )
+    out = T.fp12_mul(c, T.fp12_mul(T.fp12_sqr(m), m))
+    return T.fp12_is_one(out)
+
+
+def _pow_signed(a, e: int):
+    """a^e on the cyclotomic subgroup (negative e via conjugation)."""
+    if e < 0:
+        return T.fp12_conj(T.fp12_pow(a, -e))
+    return T.fp12_pow(a, e)
+
+
+def pairing_check(p_aff, q_aff):
+    """True iff prod_i e(P_i, Q_i) == 1 over the trailing batch axis."""
+    f = miller_loop(p_aff, q_aff)
+    return final_exp_is_one(gt_product(f))

@@ -1,0 +1,284 @@
+"""Branchless Jacobian point arithmetic over Fp (G1) and Fp2 (G2) in JAX.
+
+Device analog of blst's point ops as used by verify_signature_sets
+(reference: crypto/bls/src/impls/blst.rs:71-117): doubling, complete-ish
+addition via select, batched 64-bit scalar multiplication (the random batch
+weights, RAND_BITS=64 at blst.rs:14), the psi endomorphism and Scott's fast
+G2 subgroup test (constants from endo.py, derived + self-checked there).
+
+A point is a pytree (X, Y, Z) of field elements (Jacobian; x = X/Z^2,
+y = Y/Z^3); infinity iff Z == 0.  All case splits (infinity operands,
+doubling) are jnp.where selects, so every op is jit/scan-safe with static
+shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import endo as _endo
+from .. import params
+from . import fp as F
+from . import tower as T
+
+# ---------------------------------------------------------------------------
+# Field-op namespaces so G1/G2 share one implementation
+# ---------------------------------------------------------------------------
+
+
+class _FpOps:
+    add = staticmethod(F.fp_add)
+    sub = staticmethod(F.fp_sub)
+    neg = staticmethod(F.fp_neg)
+    mul = staticmethod(F.mont_mul)
+    sqr = staticmethod(F.mont_sqr)
+    select = staticmethod(F.fp_select)
+    eq = staticmethod(F.fp_eq)
+    is_zero = staticmethod(F.fp_is_zero)
+    zero_like = staticmethod(F.zero_like)
+    one_like = staticmethod(F.one_like)
+
+    @staticmethod
+    def dbl(a):
+        return F.fp_add(a, a)
+
+
+class _Fp2Ops:
+    add = staticmethod(T.fp2_add)
+    sub = staticmethod(T.fp2_sub)
+    neg = staticmethod(T.fp2_neg)
+    mul = staticmethod(T.fp2_mul)
+    sqr = staticmethod(T.fp2_sqr)
+    select = staticmethod(T.fp2_select)
+    eq = staticmethod(T.fp2_eq)
+    is_zero = staticmethod(T.fp2_is_zero)
+    zero_like = staticmethod(T.fp2_zero_like)
+    one_like = staticmethod(T.fp2_one_like)
+    dbl = staticmethod(T.fp2_dbl)
+
+
+FP_OPS = _FpOps
+FP2_OPS = _Fp2Ops
+
+
+def pt_select(ops, mask, p, q):
+    return tuple(ops.select(mask, a, b) for a, b in zip(p, q))
+
+
+def pt_infinity_like(ops, p):
+    one = ops.one_like(p[0])
+    return (one, one, ops.zero_like(p[0]))
+
+
+def pt_is_infinity(ops, p):
+    return ops.is_zero(p[2])
+
+
+def from_affine(ops, xy):
+    x, y = xy
+    return (x, y, ops.one_like(x))
+
+
+def pt_neg(ops, p):
+    return (p[0], ops.neg(p[1]), p[2])
+
+
+def jac_double(ops, p):
+    """2P, a = 0 curve.  Infinity and Y=0 fall out naturally (Z3 = 2YZ)."""
+    X, Y, Z = p
+    A = ops.sqr(X)
+    B = ops.sqr(Y)
+    C = ops.sqr(B)
+    t = ops.sub(ops.sub(ops.sqr(ops.add(X, B)), A), C)
+    D = ops.dbl(t)
+    E = ops.add(ops.dbl(A), A)
+    Fv = ops.sqr(E)
+    X3 = ops.sub(Fv, ops.dbl(D))
+    C8 = ops.dbl(ops.dbl(ops.dbl(C)))
+    Y3 = ops.sub(ops.mul(E, ops.sub(D, X3)), C8)
+    Z3 = ops.dbl(ops.mul(Y, Z))
+    return (X3, Y3, Z3)
+
+
+def jac_add(ops, p1, p2):
+    """P1 + P2, complete via selects (handles infinity and doubling)."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = ops.sqr(Z1)
+    Z2Z2 = ops.sqr(Z2)
+    U1 = ops.mul(X1, Z2Z2)
+    U2 = ops.mul(X2, Z1Z1)
+    S1 = ops.mul(ops.mul(Y1, Z2), Z2Z2)
+    S2 = ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    H = ops.sub(U2, U1)
+    rr = ops.dbl(ops.sub(S2, S1))
+    I = ops.sqr(ops.dbl(H))
+    J = ops.mul(H, I)
+    V = ops.mul(U1, I)
+    X3 = ops.sub(ops.sub(ops.sqr(rr), J), ops.dbl(V))
+    Y3 = ops.sub(ops.mul(rr, ops.sub(V, X3)), ops.dbl(ops.mul(S1, J)))
+    Z3 = ops.mul(
+        ops.sub(ops.sub(ops.sqr(ops.add(Z1, Z2)), Z1Z1), Z2Z2), H
+    )
+    added = (X3, Y3, Z3)
+    # H == 0, rr != 0  => opposite points => Z3 = ...*H = 0: already infinity.
+    inf1 = pt_is_infinity(ops, p1)
+    inf2 = pt_is_infinity(ops, p2)
+    is_dbl = (
+        ops.eq(U1, U2) & ops.eq(S1, S2) & jnp.logical_not(inf1 | inf2)
+    )
+    out = pt_select(ops, is_dbl, jac_double(ops, p1), added)
+    out = pt_select(ops, inf2, p1, out)
+    out = pt_select(ops, inf1, p2, out)
+    return out
+
+
+def jac_eq(ops, p1, p2):
+    """Equality including infinity, via cross-multiplication."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = ops.sqr(Z1)
+    Z2Z2 = ops.sqr(Z2)
+    ex = ops.eq(ops.mul(X1, Z2Z2), ops.mul(X2, Z1Z1))
+    ey = ops.eq(
+        ops.mul(ops.mul(Y1, Z2), Z2Z2), ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    )
+    inf1 = pt_is_infinity(ops, p1)
+    inf2 = pt_is_infinity(ops, p2)
+    return (inf1 & inf2) | (jnp.logical_not(inf1 | inf2) & ex & ey)
+
+
+def scalar_mul_bits(ops, p, bits):
+    """[k]P with per-element scalars given as bits (nbits, *batch), MSB first.
+
+    Double-and-always-add with select — branchless, constant two field-mul
+    cost per bit; used for the 64-bit random batch weights.
+    """
+
+    def step(acc, bit):
+        acc = jac_double(ops, acc)
+        added = jac_add(ops, acc, p)
+        return pt_select(ops, bit == 1, added, acc), None
+
+    acc, _ = lax.scan(step, pt_infinity_like(ops, p), bits)
+    return acc
+
+
+def scalar_mul_const(ops, p, k: int):
+    """[k]P for a static scalar; negative k negates the point."""
+    if k < 0:
+        return scalar_mul_const(ops, pt_neg(ops, p), -k)
+    if k == 0:
+        return pt_infinity_like(ops, p)
+    bshape = p[2].shape[1:] if isinstance(p[2], jnp.ndarray) else p[2][0].shape[1:]
+    nbits = [int(c) for c in bin(k)[2:]]
+    bits = jnp.broadcast_to(
+        jnp.array(nbits, dtype=jnp.uint32).reshape((len(nbits),) + (1,) * len(bshape)),
+        (len(nbits),) + tuple(bshape),
+    )
+    return scalar_mul_bits(ops, p, bits)
+
+
+def to_affine(ops, p, inv_fn):
+    """Jacobian -> affine (x, y); infinity maps to (0, 0) — callers must
+    handle it via pt_is_infinity.  inv_fn is the field inversion."""
+    X, Y, Z = p
+    zinv = inv_fn(Z)
+    zinv2 = ops.sqr(zinv)
+    return (ops.mul(X, zinv2), ops.mul(ops.mul(Y, zinv2), zinv))
+
+
+# ---------------------------------------------------------------------------
+# G2 endomorphism + fast subgroup check (constants from endo.py)
+# ---------------------------------------------------------------------------
+
+
+def _psi_consts(bshape):
+    cx = T.fp2_const(_endo.PSI_CX, bshape)
+    cy = T.fp2_const(_endo.PSI_CY, bshape)
+    return cx, cy
+
+
+def psi_affine(xy):
+    """psi on an affine G2 point pytree ((xc0,xc1),(yc0,yc1))."""
+    x, y = xy
+    bshape = x[0].shape[1:]
+    cx, cy = _psi_consts(bshape)
+    return (T.fp2_mul(T.fp2_conj(x), cx), T.fp2_mul(T.fp2_conj(y), cy))
+
+
+_X_ABS_BITS = [int(c) for c in bin(abs(params.X))[2:]]
+
+
+def g2_subgroup_check(xy):
+    """Scott's test:  Q in G2  iff  psi(Q) == [x]Q  (x < 0: compare with
+    the negated |x| multiple).  Batched over trailing dims; returns bools."""
+    x, _y = xy
+    bshape = x[0].shape[1:]
+    Q = from_affine(FP2_OPS, xy)
+    bits = jnp.broadcast_to(
+        jnp.array(_X_ABS_BITS, dtype=jnp.uint32).reshape(
+            (len(_X_ABS_BITS),) + (1,) * len(bshape)
+        ),
+        (len(_X_ABS_BITS),) + tuple(bshape),
+    )
+    xQ = scalar_mul_bits(FP2_OPS, Q, bits)  # [|x|]Q
+    psiQ = from_affine(FP2_OPS, psi_affine(xy))
+    return jac_eq(FP2_OPS, psiQ, pt_neg(FP2_OPS, xQ))
+
+
+# ---------------------------------------------------------------------------
+# Host codecs: oracle affine points <-> device arrays
+# ---------------------------------------------------------------------------
+
+
+def g1_encode(points) -> tuple:
+    """Host: list of oracle affine G1 points (no infinities) -> device pytree."""
+    xs = [p[0].v for p in points]
+    ys = [p[1].v for p in points]
+    return (jnp.asarray(F.encode_mont(xs)), jnp.asarray(F.encode_mont(ys)))
+
+
+def g2_encode(points) -> tuple:
+    from .. import fields as O
+
+    x = T.fp2_encode([p[0] for p in points])
+    y = T.fp2_encode([p[1] for p in points])
+    return (x, y)
+
+
+def g1_decode_jac(p) -> list:
+    """Device Jacobian G1 -> oracle affine points (None for infinity)."""
+    from .. import curve as C
+    from .. import fields as O
+
+    X = F.decode_mont(np.asarray(p[0]))
+    Y = F.decode_mont(np.asarray(p[1]))
+    Z = F.decode_mont(np.asarray(p[2]))
+    out = []
+    for x, y, z in zip(X, Y, Z):
+        if z == 0:
+            out.append(None)
+        else:
+            jac = (O.Fp(x), O.Fp(y), O.Fp(z))
+            out.append(C.from_jacobian(jac, O.Fp))
+    return out
+
+
+def g2_decode_jac(p) -> list:
+    from .. import curve as C
+    from .. import fields as O
+
+    Xs = T.fp2_decode(p[0])
+    Ys = T.fp2_decode(p[1])
+    Zs = T.fp2_decode(p[2])
+    out = []
+    for x, y, z in zip(Xs, Ys, Zs):
+        if z.is_zero():
+            out.append(None)
+        else:
+            out.append(C.from_jacobian((x, y, z), O.Fp2))
+    return out

@@ -1,0 +1,378 @@
+"""Batched Fp2 / Fp6 / Fp12 tower in JAX, mirroring the oracle (fields.py).
+
+Tower construction (identical to the oracle and to blst):
+    Fp2  = Fp[u]  / (u^2 + 1)
+    Fp6  = Fp2[v] / (v^3 - xi),  xi = u + 1
+    Fp12 = Fp6[w] / (w^2 - v)
+
+Elements are pytrees of limb arrays: Fp2 = (c0, c1), Fp6 = (c0, c1, c2) of
+Fp2, Fp12 = (c0, c1) of Fp6 — so they thread through lax.scan carries and
+jnp.where selections transparently.  Frobenius coefficients are taken from
+the oracle's computed FROB_GAMMA table (never transcribed) and converted to
+Montgomery limb constants at import.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import fields as _oracle
+from .. import params
+from . import fp as F
+
+# ---------------------------------------------------------------------------
+# Fp2
+# ---------------------------------------------------------------------------
+
+
+def fp2(c0, c1):
+    return (c0, c1)
+
+
+def fp2_zero_like(x2):
+    return (F.zero_like(x2[0]), F.zero_like(x2[0]))
+
+
+def fp2_one_like(x2):
+    return (F.one_like(x2[0]), F.zero_like(x2[0]))
+
+
+def fp2_add(a, b):
+    return (F.fp_add(a[0], b[0]), F.fp_add(a[1], b[1]))
+
+
+def fp2_sub(a, b):
+    return (F.fp_sub(a[0], b[0]), F.fp_sub(a[1], b[1]))
+
+
+def fp2_neg(a):
+    return (F.fp_neg(a[0]), F.fp_neg(a[1]))
+
+
+def fp2_dbl(a):
+    return fp2_add(a, a)
+
+
+def fp2_mul(a, b):
+    """Karatsuba: 3 base muls."""
+    t0 = F.mont_mul(a[0], b[0])
+    t1 = F.mont_mul(a[1], b[1])
+    s = F.mont_mul(F.fp_add(a[0], a[1]), F.fp_add(b[0], b[1]))
+    return (F.fp_sub(t0, t1), F.fp_sub(F.fp_sub(s, t0), t1))
+
+
+def fp2_sqr(a):
+    """(a0+a1 u)^2 = (a0-a1)(a0+a1) + 2 a0 a1 u — 2 base muls."""
+    c0 = F.mont_mul(F.fp_sub(a[0], a[1]), F.fp_add(a[0], a[1]))
+    t = F.mont_mul(a[0], a[1])
+    return (c0, F.fp_add(t, t))
+
+
+def fp2_mul_fp(a, s):
+    """Multiply by an Fp element (limb array)."""
+    return (F.mont_mul(a[0], s), F.mont_mul(a[1], s))
+
+
+def fp2_mul_small(a, k: int):
+    """Multiply by a small positive integer via doubling chains."""
+    assert k >= 1
+    out = a
+    for bit in bin(k)[3:]:
+        out = fp2_dbl(out)
+        if bit == "1":
+            out = fp2_add(out, a)
+    return out
+
+
+def fp2_conj(a):
+    return (a[0], F.fp_neg(a[1]))
+
+
+def fp2_mul_by_nonresidue(a):
+    """Multiply by xi = 1 + u."""
+    return (F.fp_sub(a[0], a[1]), F.fp_add(a[0], a[1]))
+
+
+def fp2_inv(a):
+    norm = F.fp_add(F.mont_sqr(a[0]), F.mont_sqr(a[1]))
+    ninv = F.fp_inv(norm)
+    return (F.mont_mul(a[0], ninv), F.fp_neg(F.mont_mul(a[1], ninv)))
+
+
+def fp2_is_zero(a):
+    return F.fp_is_zero(a[0]) & F.fp_is_zero(a[1])
+
+
+def fp2_eq(a, b):
+    return F.fp_eq(a[0], b[0]) & F.fp_eq(a[1], b[1])
+
+
+def fp2_select(mask, a, b):
+    return (F.fp_select(mask, a[0], b[0]), F.fp_select(mask, a[1], b[1]))
+
+
+def fp2_const(oracle_fp2: "_oracle.Fp2", batch_shape):
+    """Oracle Fp2 constant -> broadcast Montgomery limb pytree."""
+    c0 = jnp.asarray(F.int_to_limbs(oracle_fp2.c0 * F.R_INT % F.P_INT))
+    c1 = jnp.asarray(F.int_to_limbs(oracle_fp2.c1 * F.R_INT % F.P_INT))
+    return (F.bcast(c0, batch_shape), F.bcast(c1, batch_shape))
+
+
+# ---------------------------------------------------------------------------
+# Fp6
+# ---------------------------------------------------------------------------
+
+
+def fp6_add(a, b):
+    return tuple(fp2_add(x, y) for x, y in zip(a, b))
+
+
+def fp6_sub(a, b):
+    return tuple(fp2_sub(x, y) for x, y in zip(a, b))
+
+
+def fp6_neg(a):
+    return tuple(fp2_neg(x) for x in a)
+
+
+def fp6_zero_like(a):
+    z = fp2_zero_like(a[0])
+    return (z, z, z)
+
+
+def fp6_one_like(a):
+    return (fp2_one_like(a[0]), fp2_zero_like(a[0]), fp2_zero_like(a[0]))
+
+
+def fp6_mul(a, b):
+    """Toom/Karatsuba interpolation, as the oracle (fields.py Fp6.__mul__)."""
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_mul(a1, b1)
+    t2 = fp2_mul(a2, b2)
+    c0 = fp2_add(
+        fp2_mul_by_nonresidue(
+            fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)
+        ),
+        t0,
+    )
+    c1 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
+        fp2_mul_by_nonresidue(t2),
+    )
+    c2 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1
+    )
+    return (c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    return (fp2_mul_by_nonresidue(a[2]), a[0], a[1])
+
+
+def fp6_mul_fp2(a, s):
+    return tuple(fp2_mul(x, s) for x in a)
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a
+    t0 = fp2_sub(fp2_sqr(a0), fp2_mul_by_nonresidue(fp2_mul(a1, a2)))
+    t1 = fp2_sub(fp2_mul_by_nonresidue(fp2_sqr(a2)), fp2_mul(a0, a1))
+    t2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    denom = fp2_add(
+        fp2_mul(a0, t0),
+        fp2_add(
+            fp2_mul_by_nonresidue(fp2_mul(a2, t1)),
+            fp2_mul_by_nonresidue(fp2_mul(a1, t2)),
+        ),
+    )
+    dinv = fp2_inv(denom)
+    return (fp2_mul(t0, dinv), fp2_mul(t1, dinv), fp2_mul(t2, dinv))
+
+
+def fp6_select(mask, a, b):
+    return tuple(fp2_select(mask, x, y) for x, y in zip(a, b))
+
+
+def fp6_eq(a, b):
+    return fp2_eq(a[0], b[0]) & fp2_eq(a[1], b[1]) & fp2_eq(a[2], b[2])
+
+
+# ---------------------------------------------------------------------------
+# Fp12
+# ---------------------------------------------------------------------------
+
+
+def fp12_add(a, b):
+    return (fp6_add(a[0], b[0]), fp6_add(a[1], b[1]))
+
+
+def fp12_one_like(a):
+    return (fp6_one_like(a[0]), fp6_zero_like(a[0]))
+
+
+def fp12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fp12_sqr(a):
+    a0, a1 = a
+    t = fp6_mul(a0, a1)
+    c0 = fp6_sub(
+        fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1))), t),
+        fp6_mul_by_v(t),
+    )
+    return (c0, fp6_add(t, t))
+
+
+def fp12_conj(a):
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a):
+    a0, a1 = a
+    denom = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
+    dinv = fp6_inv(denom)
+    return (fp6_mul(a0, dinv), fp6_neg(fp6_mul(a1, dinv)))
+
+
+def fp12_select(mask, a, b):
+    return (fp6_select(mask, a[0], b[0]), fp6_select(mask, a[1], b[1]))
+
+
+def fp12_eq(a, b):
+    return fp6_eq(a[0], b[0]) & fp6_eq(a[1], b[1])
+
+
+def fp12_is_one(a):
+    return fp12_eq(a, fp12_one_like(a))
+
+
+def fp12_mul_by_023(f, l0, l2, l3):
+    """Sparse line multiplication, mirroring the oracle's Fp12.mul_by_023."""
+    a0, a1 = f
+    t0 = (
+        fp2_add(fp2_mul(a0[0], l0), fp2_mul_by_nonresidue(fp2_mul(a0[2], l2))),
+        fp2_add(fp2_mul(a0[0], l2), fp2_mul(a0[1], l0)),
+        fp2_add(fp2_mul(a0[1], l2), fp2_mul(a0[2], l0)),
+    )
+    t1 = (
+        fp2_mul_by_nonresidue(fp2_mul(a1[2], l3)),
+        fp2_mul(a1[0], l3),
+        fp2_mul(a1[1], l3),
+    )
+    s = fp6_add(a0, a1)
+    l23 = fp2_add(l2, l3)
+    t2 = (
+        fp2_add(fp2_mul(s[0], l0), fp2_mul_by_nonresidue(fp2_mul(s[2], l23))),
+        fp2_add(fp2_mul(s[0], l23), fp2_mul(s[1], l0)),
+        fp2_add(fp2_mul(s[1], l23), fp2_mul(s[2], l0)),
+    )
+    return (fp6_add(t0, fp6_mul_by_v(t1)), fp6_sub(fp6_sub(t2, t0), t1))
+
+
+# Frobenius: coefficients from the oracle's computed table.
+
+
+def _gamma(i: int, batch_shape):
+    return fp2_const(_oracle.FROB_GAMMA[i], batch_shape)
+
+
+def fp12_frobenius(a):
+    bs = a[0][0][0].shape[1:]
+    c0, c1 = a
+    f0 = (
+        fp2_conj(c0[0]),
+        fp2_mul(fp2_conj(c0[1]), _gamma(2, bs)),
+        fp2_mul(fp2_conj(c0[2]), _gamma(4, bs)),
+    )
+    g1 = _gamma(1, bs)
+    f1 = (
+        fp2_mul(fp2_conj(c1[0]), g1),
+        fp2_mul(fp2_mul(fp2_conj(c1[1]), _gamma(2, bs)), g1),
+        fp2_mul(fp2_mul(fp2_conj(c1[2]), _gamma(4, bs)), g1),
+    )
+    return (f0, f1)
+
+
+def fp12_frobenius_n(a, n: int):
+    for _ in range(n % 12):
+        a = fp12_frobenius(a)
+    return a
+
+
+def fp12_pow(a, e: int):
+    """a^e for a static non-negative exponent; scan over bits."""
+    import jax
+    from jax import lax
+
+    assert e >= 0
+    if e == 0:
+        return fp12_one_like(a)
+    bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=jnp.uint32)
+
+    def step(acc, bit):
+        acc = fp12_sqr(acc)
+        withmul = fp12_mul(acc, a)
+        take = bit == 1
+        return jax.tree.map(lambda m, n: jnp.where(take, m, n), withmul, acc), None
+
+    acc, _ = lax.scan(step, fp12_one_like(a), bits)
+    return acc
+
+
+def fp12_pow_signed(a, e: int, cyclotomic: bool = False):
+    """a^e allowing negative e when a is unit-norm (conjugate == inverse)."""
+    if e < 0:
+        return fp12_conj(fp12_pow(a, -e))
+    return fp12_pow(a, e)
+
+
+# ---------------------------------------------------------------------------
+# Host codecs (oracle <-> device)
+# ---------------------------------------------------------------------------
+
+
+def fp2_encode(vals: list["_oracle.Fp2"]) -> tuple:
+    """Host: list of oracle Fp2 -> device Montgomery pytree, batch = len."""
+    c0 = jnp.asarray(F.encode_mont([v.c0 for v in vals]))
+    c1 = jnp.asarray(F.encode_mont([v.c1 for v in vals]))
+    return (c0, c1)
+
+
+def fp2_decode(x2) -> list["_oracle.Fp2"]:
+    c0s = F.decode_mont(np.asarray(x2[0]))
+    c1s = F.decode_mont(np.asarray(x2[1]))
+    return [_oracle.Fp2(a, b) for a, b in zip(c0s, c1s)]
+
+
+def fp12_encode(vals: list["_oracle.Fp12"]) -> tuple:
+    c0 = tuple(fp2_encode([getattr(v.c0, c) for v in vals]) for c in ("c0", "c1", "c2"))
+    c1 = tuple(fp2_encode([getattr(v.c1, c) for v in vals]) for c in ("c0", "c1", "c2"))
+    return (c0, c1)
+
+
+def fp12_decode(x12) -> list["_oracle.Fp12"]:
+    c0 = [fp2_decode(x12[0][i]) for i in range(3)]
+    c1 = [fp2_decode(x12[1][i]) for i in range(3)]
+    out = []
+    for j in range(len(c0[0])):
+        out.append(
+            _oracle.Fp12(
+                _oracle.Fp6(c0[0][j], c0[1][j], c0[2][j]),
+                _oracle.Fp6(c1[0][j], c1[1][j], c1[2][j]),
+            )
+        )
+    return out

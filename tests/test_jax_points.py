@@ -1,0 +1,147 @@
+"""Differential tests: JAX branchless point ops vs the oracle curve module."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import params
+from lighthouse_tpu.crypto.bls.curve import (
+    Fp,
+    Fp2,
+    G1_GENERATOR,
+    G2_GENERATOR,
+    affine_add,
+    affine_mul,
+    g2_subgroup_check as oracle_g2_check,
+)
+from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+from lighthouse_tpu.crypto.bls.jax_backend import points as P
+
+rng = random.Random(0x90111)
+B = 4
+
+from functools import partial
+
+_JIT_CACHE = {}
+
+
+def J(fn, *static):
+    key = (fn, static)
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = jax.jit(fn, static_argnums=static)
+    return _JIT_CACHE[key]
+
+
+
+def rand_g1_points(n):
+    return [affine_mul(G1_GENERATOR, rng.randrange(1, params.R), Fp) for _ in range(n)]
+
+
+def rand_g2_points(n):
+    return [affine_mul(G2_GENERATOR, rng.randrange(1, params.R), Fp2) for _ in range(n)]
+
+
+def bits_of(ks, nbits):
+    out = np.zeros((nbits, len(ks)), dtype=np.uint32)
+    for j, k in enumerate(ks):
+        for i, c in enumerate(bin(k)[2:].zfill(nbits)):
+            out[i, j] = int(c)
+    return jnp.asarray(out)
+
+
+def test_g1_add_double_scalar_mul():
+    pts = rand_g1_points(B)
+    qts = rand_g1_points(B)
+    dp = P.from_affine(P.FP_OPS, P.g1_encode(pts))
+    dq = P.from_affine(P.FP_OPS, P.g1_encode(qts))
+    got = P.g1_decode_jac(J(P.jac_add, 0)(P.FP_OPS, dp, dq))
+    assert got == [affine_add(a, b, Fp) for a, b in zip(pts, qts)]
+    got_dbl = P.g1_decode_jac(J(P.jac_double, 0)(P.FP_OPS, dp))
+    assert got_dbl == [affine_add(a, a, Fp) for a in pts]
+    # doubling through jac_add (P + P branch)
+    got_dbl2 = P.g1_decode_jac(J(P.jac_add, 0)(P.FP_OPS, dp, dp))
+    assert got_dbl2 == got_dbl
+    # P + (-P) = infinity
+    dneg = P.pt_neg(P.FP_OPS, dp)
+    got_inf = P.g1_decode_jac(J(P.jac_add, 0)(P.FP_OPS, dp, dneg))
+    assert got_inf == [None] * B
+    # 64-bit scalar mul
+    ks = [rng.randrange(1, 2**64) for _ in range(B)]
+    got_mul = P.g1_decode_jac(J(P.scalar_mul_bits, 0)(P.FP_OPS, dp, bits_of(ks, 64)))
+    assert got_mul == [affine_mul(a, k, Fp) for a, k in zip(pts, ks)]
+
+
+def test_g1_add_infinity_cases():
+    pts = rand_g1_points(B)
+    dp = P.from_affine(P.FP_OPS, P.g1_encode(pts))
+    inf = P.pt_infinity_like(P.FP_OPS, dp)
+    assert P.g1_decode_jac(J(P.jac_add, 0)(P.FP_OPS, dp, inf)) == pts
+    assert P.g1_decode_jac(J(P.jac_add, 0)(P.FP_OPS, inf, dp)) == pts
+    assert P.g1_decode_jac(J(P.jac_add, 0)(P.FP_OPS, inf, inf)) == [None] * B
+    assert P.g1_decode_jac(J(P.jac_double, 0)(P.FP_OPS, inf)) == [None] * B
+
+
+def test_g2_add_scalar_mul():
+    pts = rand_g2_points(B)
+    qts = rand_g2_points(B)
+    dp = P.from_affine(P.FP2_OPS, P.g2_encode(pts))
+    dq = P.from_affine(P.FP2_OPS, P.g2_encode(qts))
+    got = P.g2_decode_jac(J(P.jac_add, 0)(P.FP2_OPS, dp, dq))
+    assert got == [affine_add(a, b, Fp2) for a, b in zip(pts, qts)]
+    ks = [rng.randrange(1, 2**64) for _ in range(B)]
+    got_mul = P.g2_decode_jac(J(P.scalar_mul_bits, 0)(P.FP2_OPS, dp, bits_of(ks, 64)))
+    assert got_mul == [affine_mul(a, k, Fp2) for a, k in zip(pts, ks)]
+
+
+def test_jac_eq():
+    pts = rand_g1_points(B)
+    dp = P.from_affine(P.FP_OPS, P.g1_encode(pts))
+    # same points with different Z: 2P computed two ways
+    d1 = J(P.jac_add, 0)(P.FP_OPS, dp, dp)
+    d2 = J(P.jac_double, 0)(P.FP_OPS, dp)
+    assert np.asarray(J(P.jac_eq, 0)(P.FP_OPS, d1, d2)).all()
+    assert not np.asarray(J(P.jac_eq, 0)(P.FP_OPS, d1, dp)).any()
+    inf = P.pt_infinity_like(P.FP_OPS, dp)
+    assert np.asarray(J(P.jac_eq, 0)(P.FP_OPS, inf, inf)).all()
+    assert not np.asarray(J(P.jac_eq, 0)(P.FP_OPS, inf, dp)).any()
+
+
+def test_psi_matches_oracle():
+    from lighthouse_tpu.crypto.bls import endo
+
+    pts = rand_g2_points(B)
+    got = J(P.psi_affine)(P.g2_encode(pts))
+    from lighthouse_tpu.crypto.bls.jax_backend import tower as T
+
+    xs, ys = T.fp2_decode(got[0]), T.fp2_decode(got[1])
+    want = [endo.psi(p) for p in pts]
+    assert list(zip(xs, ys)) == [(w[0], w[1]) for w in want]
+
+
+def test_g2_subgroup_check_device():
+    good = rand_g2_points(2)
+    # a twist point NOT in G2
+    from lighthouse_tpu.crypto.bls.curve import B2
+
+    while True:
+        x = Fp2(rng.randrange(params.P), rng.randrange(params.P))
+        y = (x.square() * x + B2).sqrt()
+        if y is not None:
+            bad = (x, y)
+            break
+    pts = good + [bad]
+    got = np.asarray(J(P.g2_subgroup_check)(P.g2_encode(pts)))
+    want = [oracle_g2_check(p) for p in pts]
+    assert list(got) == want
+    assert list(got) == [True, True, False]
+
+
+def test_scalar_mul_const():
+    pts = rand_g1_points(B)
+    dp = P.from_affine(P.FP_OPS, P.g1_encode(pts))
+    got = P.g1_decode_jac(J(P.scalar_mul_const, 0, 2)(P.FP_OPS, dp, params.X))
+    assert got == [affine_mul(a, params.X, Fp) for a in pts]

@@ -1,0 +1,121 @@
+"""Differential tests: JAX Fp2/Fp6/Fp12 tower vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls import fields as O
+from lighthouse_tpu.crypto.bls import params
+from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+from lighthouse_tpu.crypto.bls.jax_backend import tower as T
+
+P = params.P
+rng = random.Random(0x70E2)
+
+
+def rand_fp2():
+    return O.Fp2(rng.randrange(P), rng.randrange(P))
+
+
+def rand_fp6():
+    return O.Fp6(rand_fp2(), rand_fp2(), rand_fp2())
+
+
+def rand_fp12():
+    return O.Fp12(rand_fp6(), rand_fp6())
+
+
+def enc6(vals):
+    return tuple(
+        T.fp2_encode([getattr(v, c) for v in vals]) for c in ("c0", "c1", "c2")
+    )
+
+
+def dec6(x6):
+    cs = [T.fp2_decode(x6[i]) for i in range(3)]
+    return [O.Fp6(cs[0][j], cs[1][j], cs[2][j]) for j in range(len(cs[0]))]
+
+
+B = 8
+
+from functools import partial
+
+_JIT_CACHE = {}
+
+
+def J(fn, *static):
+    """Jit-and-cache a tower op so tests avoid eager scan dispatch."""
+    key = (fn, static)
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = jax.jit(fn, static_argnums=static)
+    return _JIT_CACHE[key]
+
+
+
+def test_fp2_ops():
+    a = [rand_fp2() for _ in range(B)]
+    b = [rand_fp2() for _ in range(B)]
+    da, db = T.fp2_encode(a), T.fp2_encode(b)
+    assert T.fp2_decode(J(T.fp2_mul)(da, db)) == [x * y for x, y in zip(a, b)]
+    assert T.fp2_decode(J(T.fp2_sqr)(da)) == [x.square() for x in a]
+    assert T.fp2_decode(J(T.fp2_add)(da, db)) == [x + y for x, y in zip(a, b)]
+    assert T.fp2_decode(J(T.fp2_sub)(da, db)) == [x - y for x, y in zip(a, b)]
+    assert T.fp2_decode(J(T.fp2_conj)(da)) == [x.conjugate() for x in a]
+    assert T.fp2_decode(J(T.fp2_mul_by_nonresidue)(da)) == [
+        x.mul_by_nonresidue() for x in a
+    ]
+    assert T.fp2_decode(J(T.fp2_inv)(da)) == [x.inv() for x in a]
+    assert T.fp2_decode(J(T.fp2_mul_small, 1)(da, 3)) == [x * 3 for x in a]
+    assert T.fp2_decode(J(T.fp2_mul_small, 1)(da, 8)) == [x * 8 for x in a]
+
+
+def test_fp6_ops():
+    a = [rand_fp6() for _ in range(B)]
+    b = [rand_fp6() for _ in range(B)]
+    da, db = enc6(a), enc6(b)
+    assert dec6(J(T.fp6_mul)(da, db)) == [x * y for x, y in zip(a, b)]
+    assert dec6(J(T.fp6_mul_by_v)(da)) == [x.mul_by_v() for x in a]
+    assert dec6(J(T.fp6_inv)(da)) == [x.inv() for x in a]
+
+
+def test_fp12_ops():
+    a = [rand_fp12() for _ in range(B)]
+    b = [rand_fp12() for _ in range(B)]
+    da, db = T.fp12_encode(a), T.fp12_encode(b)
+    assert T.fp12_decode(J(T.fp12_mul)(da, db)) == [x * y for x, y in zip(a, b)]
+    assert T.fp12_decode(J(T.fp12_sqr)(da)) == [x.square() for x in a]
+    assert T.fp12_decode(J(T.fp12_conj)(da)) == [x.conjugate() for x in a]
+    assert T.fp12_decode(J(T.fp12_inv)(da)) == [x.inv() for x in a]
+
+
+def test_fp12_frobenius_and_pow():
+    a = [rand_fp12() for _ in range(4)]
+    da = T.fp12_encode(a)
+    assert T.fp12_decode(J(T.fp12_frobenius)(da)) == [x.frobenius() for x in a]
+    assert T.fp12_decode(J(T.fp12_frobenius_n, 1)(da, 2)) == [x.frobenius_n(2) for x in a]
+    e = 0xABCDEF0123
+    assert T.fp12_decode(J(T.fp12_pow, 1)(da, e)) == [x.pow(e) for x in a]
+
+
+def test_fp12_mul_by_023():
+    a = [rand_fp12() for _ in range(4)]
+    l0, l2, l3 = [rand_fp2() for _ in range(4)], [rand_fp2() for _ in range(4)], [
+        rand_fp2() for _ in range(4)
+    ]
+    da = T.fp12_encode(a)
+    got = T.fp12_decode(
+        J(T.fp12_mul_by_023)(da, T.fp2_encode(l0), T.fp2_encode(l2), T.fp2_encode(l3))
+    )
+    want = [x.mul_by_023(p, q, r) for x, p, q, r in zip(a, l0, l2, l3)]
+    assert got == want
+
+
+def test_fp12_is_one():
+    one = O.Fp12.one()
+    vals = [one, rand_fp12()]
+    d = T.fp12_encode(vals)
+    assert list(np.asarray(J(T.fp12_is_one)(d))) == [True, False]
