@@ -32,33 +32,38 @@ from . import tower as T
 
 
 def _tree_reduce_g2(pt):
-    """Reduce the trailing batch axis of a Jacobian G2 pytree by addition."""
-    import jax
+    """Reduce the trailing batch axis of a Jacobian G2 pytree (X, Y, Z, inf)
+    by point addition (log-depth tree).  Uses the COMPLETE jac_add: the
+    summands are adversarial signature points, so coincidences must be
+    handled, not assumed away."""
     import jax.numpy as jnp
 
-    B = jax.tree.leaves(pt)[0].shape[-1]
+    B = pt[3].shape[-1]
     target = 1 << max(0, (B - 1).bit_length())
     if target != B:
-        # pad with infinity
-        def padder(a):
-            pad_shape = a.shape[:-1] + (target - B,)
-            return jnp.concatenate([a, jnp.zeros(pad_shape, dtype=a.dtype)], axis=-1)
+        reps = target - B
+        one = F.LFp(F.bcast(F.ONE_MONT, (reps,)), 1.0)
+        zero = F.LFp(jnp.zeros_like(one.limbs), 0.0)
 
-        # infinity needs Z=0 but X=Y=1(mont); zeros work for Z; X/Y any value
-        # with Z=0 is treated as infinity by the branchless ops, but keep
-        # X=Y=one for canonical safety.
-        one = F.bcast(F.ONE_MONT, (target - B,))
-        X, Y, Z = pt
-        X = tuple(
-            jnp.concatenate([c, o], axis=-1)
-            for c, o in zip(X, (one, jnp.zeros_like(one)))
+        def cat_fp2(c, pad):
+            return (
+                F.LFp(
+                    jnp.concatenate([c[0].limbs, pad[0].limbs], axis=-1),
+                    max(c[0].bound, pad[0].bound),
+                ),
+                F.LFp(
+                    jnp.concatenate([c[1].limbs, pad[1].limbs], axis=-1),
+                    max(c[1].bound, pad[1].bound),
+                ),
+            )
+
+        X, Y, Z, inf = pt
+        pt = (
+            cat_fp2(X, (one, zero)),
+            cat_fp2(Y, (one, zero)),
+            cat_fp2(Z, (zero, zero)),
+            jnp.concatenate([inf, jnp.ones((reps,), dtype=bool)], axis=-1),
         )
-        Y = tuple(
-            jnp.concatenate([c, o], axis=-1)
-            for c, o in zip(Y, (one, jnp.zeros_like(one)))
-        )
-        Z = tuple(jnp.concatenate([c, jnp.zeros_like(one)], axis=-1) for c in Z)
-        pt = (X, Y, Z)
     n = target
     while n > 1:
         half = n // 2
@@ -69,17 +74,28 @@ def _tree_reduce_g2(pt):
     return pt
 
 
-def _slice_pt(pt, a, b):
-    import jax
-
-    return jax.tree.map(lambda arr: arr[..., a:b], pt)
-
-
-def _concat_tree(a, b):
-    import jax
+def _slice_lfp_tree(x, a, b):
+    if isinstance(x, F.LFp):
+        return F.LFp(x.limbs[..., a:b], x.bound)
     import jax.numpy as jnp
 
-    return jax.tree.map(lambda x, y: jnp.concatenate([x, y], axis=-1), a, b)
+    if isinstance(x, jnp.ndarray) or hasattr(x, "shape"):
+        return x[..., a:b]
+    return tuple(_slice_lfp_tree(c, a, b) for c in x)
+
+
+def _slice_pt(pt, a, b):
+    return tuple(_slice_lfp_tree(c, a, b) for c in pt)
+
+
+def _concat_lfp_tree(x, y):
+    import jax.numpy as jnp
+
+    if isinstance(x, F.LFp):
+        return F.LFp(
+            jnp.concatenate([x.limbs, y.limbs], axis=-1), max(x.bound, y.bound)
+        )
+    return tuple(_concat_lfp_tree(a, b) for a, b in zip(x, y))
 
 
 def _verify_kernel(pk_aff, sig_aff, h_aff, wbits):
@@ -107,10 +123,13 @@ def _verify_kernel(pk_aff, sig_aff, h_aff, wbits):
     # 5. assemble pairs: (wpk_i, H_i) for each set plus (-G1, S)
     neg_gen = _neg_gen_const()
     p_side = (
-        jnp.concatenate([wpk_aff[0], neg_gen[0]], axis=-1),
-        jnp.concatenate([wpk_aff[1], neg_gen[1]], axis=-1),
+        _concat_lfp_tree(wpk_aff[0], neg_gen[0]),
+        _concat_lfp_tree(wpk_aff[1], neg_gen[1]),
     )
-    q_side = (_concat_tree(h_aff[0], S_aff[0]), _concat_tree(h_aff[1], S_aff[1]))
+    q_side = (
+        _concat_lfp_tree(h_aff[0], S_aff[0]),
+        _concat_lfp_tree(h_aff[1], S_aff[1]),
+    )
     # 6. Miller loops + GT product + final exponentiation
     f = PR.miller_loop(p_side, q_side)
     # If S is infinity, its pair contributes 1 (e(P, O) = 1): mask the last

@@ -5,27 +5,44 @@ consumes it via crypto/bls/src/impls/blst.rs); every higher layer of the TPU
 backend (Fp2/Fp6/Fp12 tower, curve ops, pairing) is built on the ops here and
 is differentially tested against the pure-Python oracle (fields.py).
 
-Representation
---------------
-An Fp element is 24 x 16-bit limbs, little-endian, each stored in a uint32
-lane: shape ``(24, *batch)`` — the limb axis LEADS so that the trailing batch
-axis lands on the TPU's 128-wide vector lanes and every limb op is a full-width
-VPU instruction over the batch.  Values are kept canonical (limbs < 2^16,
-value < P) in Montgomery form (R = 2^384).
+Representation: lazy reduction with static bound tracking
+---------------------------------------------------------
+An Fp element is an ``LFp(limbs, bound)``: 26 x 15-bit limbs, little-endian,
+each in a uint32 lane, shape ``(26, *batch)`` — the limb axis LEADS so the
+trailing batch axis rides the TPU's 128-wide vector lanes.  Montgomery domain
+with R = 2^390.  ``bound`` is a STATIC (trace-time) upper bound on the value
+in units of P; it is pytree aux data, so it travels through jit/scan/select
+and mismatches surface as loud trace-time errors, never silent corruption.
 
-Multiplication is schoolbook over limbs via a Horner scan (MSB-first:
-acc = acc * 2^16 + a_i * b), with each 32-bit partial product split into
-16-bit halves before accumulation so column sums stay < 2^22 (no overflow in
-uint32).  Montgomery reduction is the standard  m = T * P' mod R;
-T' = (T + m*P) / R  with one conditional subtraction.
+Limbs are only *quasi-normalized* (<= 2^15 + 2^7) and values are bounded by
+small multiples of P rather than reduced mod P.  This removes every
+sequential carry chain from additions and subtractions (one vector add plus
+a two-op carry "compress"), which keeps both the XLA graph and the VPU work
+per op small.  Op contracts:
 
-All loops over limbs are ``lax.scan``s so the traced graph stays compact
-enough to nest inside the Miller-loop scan.
+* ``fp_add``: value a+b, bound a.bound + b.bound.
+* ``fp_sub``: value a - b + k*P where k (a power of two >= b.bound) is
+  chosen automatically; the precomputed biased k*P has every limb >= any
+  quasi limb, so the column subtraction cannot go negative.
+* ``mont_mul``: requires a.bound * b.bound <= 2000 (checked at trace time);
+  output has STRICT limbs and bound a.bound*b.bound/625 + 1.1 (< 4.3).
+  (P/R ~ 2^-9.3 ~ 1/625.)
+* ``fp_reduce(x) = mont_mul(x, R mod P)``: value-preserving mod P, bound
+  back to < 2 — inserted at op boundaries (tower/point outputs) so bounds
+  cannot creep and scan carries keep a stable static bound.
+* Canonical form (value < P) exists only at the edges: ``fp_canon`` for
+  equality tests, host codecs for I/O.
+
+Multiplication is schoolbook via a Horner scan (acc = acc*2^15 + a_i*b) with
+32-bit partial products split at 15 bits before accumulation (column sums
+< 2^21, no uint32 overflow).  Montgomery reduction is m = T*P' mod R;
+(T + m*P)/R, with ONE sequential carry normalization at the end — the only
+per-limb chain in the hot path.
 """
 
 from __future__ import annotations
 
-from functools import partial
+import math
 
 import jax
 import jax.numpy as jnp
@@ -38,22 +55,52 @@ from .. import params
 # Constants
 # ---------------------------------------------------------------------------
 
-BITS = 16
-N = 24  # 24 * 16 = 384 bits >= 381
+BITS = 15
+N = 26  # 26 * 15 = 390 bits >= 381
 MASK = (1 << BITS) - 1
-BASE = 1 << BITS
+QMAX = (1 << BITS) + (1 << 7)  # quasi-normalized limb bound
 U32 = jnp.uint32
 
+MAX_MUL_PRODUCT = 2000.0  # max a.bound * b.bound entering mont_mul
+MAX_BOUND = 500.0  # max value bound anywhere (keeps top limb small)
+
 P_INT = params.P
-R_INT = 1 << (BITS * N)  # Montgomery radix 2^384
-assert R_INT > P_INT
+R_INT = 1 << (BITS * N)  # Montgomery radix 2^390
+assert R_INT > 512 * P_INT
 R1_INT = R_INT % P_INT  # 1 in Montgomery form
-R2_INT = R_INT * R_INT % P_INT  # for to-Montgomery conversion
+R2_INT = R_INT * R_INT % P_INT
 PPRIME_INT = (-pow(P_INT, -1, R_INT)) % R_INT  # -P^-1 mod R
+
+_BIAS_KS = (2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class LFp:
+    """Lazy field element: quasi-normalized limbs + static value bound (in
+    units of P).  Registered as a pytree with ``bound`` as aux data."""
+
+    __slots__ = ("limbs", "bound")
+
+    def __init__(self, limbs, bound: float):
+        self.limbs = limbs
+        self.bound = bound
+
+    def __repr__(self):
+        return f"LFp(shape={getattr(self.limbs, 'shape', None)}, bound={self.bound})"
+
+
+def _lfp_flatten(x):
+    return (x.limbs,), x.bound
+
+
+def _lfp_unflatten(bound, children):
+    return LFp(children[0], bound)
+
+
+jax.tree_util.register_pytree_node(LFp, _lfp_flatten, _lfp_unflatten)
 
 
 def int_to_limbs(x: int) -> np.ndarray:
-    """Host codec: non-negative int < 2^384 -> (N,) uint32 limb vector."""
+    """Host codec: non-negative int < 2^390 -> (N,) uint32 strict limbs."""
     assert 0 <= x < R_INT
     return np.array([(x >> (BITS * i)) & MASK for i in range(N)], dtype=np.uint32)
 
@@ -64,7 +111,6 @@ def limbs_to_int(limbs) -> int:
 
 
 def ints_to_limbs(xs) -> np.ndarray:
-    """Host codec for a batch: list of ints -> (N, len(xs)) uint32."""
     out = np.zeros((N, len(xs)), dtype=np.uint32)
     for j, x in enumerate(xs):
         out[:, j] = int_to_limbs(x)
@@ -77,36 +123,64 @@ def limbs_to_ints(limbs) -> list[int]:
     return [limbs_to_int(flat[:, j]) for j in range(flat.shape[1])]
 
 
+def _biased_kp(k: int) -> np.ndarray:
+    """k*P with every non-top limb boosted to >= QMAX by borrowing from the
+    limb above, so (a + bias - b) is column-wise non-negative for quasi b."""
+    limbs = [int(v) for v in int_to_limbs(k * P_INT)]
+    for i in range(N - 1):
+        while limbs[i] < QMAX:
+            limbs[i] += 1 << BITS
+            limbs[i + 1] -= 1
+    assert limbs[N - 1] >= 0, f"bias top limb underflow for k={k}"
+    assert sum(v << (BITS * i) for i, v in enumerate(limbs)) == k * P_INT
+    return np.array(limbs, dtype=np.uint32)
+
+
 P_LIMBS = jnp.asarray(int_to_limbs(P_INT))
 PPRIME_LIMBS = jnp.asarray(int_to_limbs(PPRIME_INT))
 ONE_MONT = jnp.asarray(int_to_limbs(R1_INT))
-R2_LIMBS = jnp.asarray(int_to_limbs(R2_INT))
-ZERO = jnp.zeros((N,), dtype=U32)
+BIAS = {k: jnp.asarray(_biased_kp(k)) for k in _BIAS_KS}
 
 
 def bcast(const, batch_shape) -> jnp.ndarray:
-    """Broadcast an (N,) constant to (N, *batch_shape)."""
     return jnp.broadcast_to(
         const.reshape((N,) + (1,) * len(batch_shape)), (N,) + tuple(batch_shape)
     )
 
 
-def zero_like(a):
-    return jnp.zeros_like(a)
+def zero_like(a: LFp) -> LFp:
+    return LFp(jnp.zeros_like(a.limbs), 0.0)
 
 
-def one_like(a):
-    return bcast(ONE_MONT, a.shape[1:])
+def one_like(a: LFp) -> LFp:
+    return LFp(bcast(ONE_MONT, a.limbs.shape[1:]), 1.0)
+
+
+def batch_shape(a: LFp):
+    return a.limbs.shape[1:]
 
 
 # ---------------------------------------------------------------------------
-# Carry / borrow chains (scans over the leading limb axis)
+# Carry handling (raw limb arrays)
 # ---------------------------------------------------------------------------
 
 
-def carry_chain(cols):
-    """Normalize column sums (< 2^31) into canonical limbs; returns
-    (limbs, carry_out)."""
+def compress1(cols):
+    """One carry pass: quasi-normalizes column sums < 2^16.2.  The top
+    limb's carry is statically impossible (values < 500P)."""
+    lo = cols & MASK
+    hi = cols >> BITS
+    return lo.at[1:].add(hi[:-1])
+
+
+def compress2(cols):
+    """Two passes: quasi-normalizes column sums < 2^21 (Horner output)."""
+    return compress1(compress1(cols))
+
+
+def full_chain(cols):
+    """Sequential full normalization to strict limbs — the one per-limb
+    chain, used once per mont_mul."""
     init = jnp.zeros(cols.shape[1:], dtype=U32)
 
     def step(c, col):
@@ -114,76 +188,87 @@ def carry_chain(cols):
         return t >> BITS, t & MASK
 
     carry, limbs = lax.scan(step, init, cols)
-    return limbs, carry
+    del carry
+    return limbs
 
 
 def sub_chain(x, y):
-    """Limb-wise x - y with borrow; returns (diff mod 2^384, borrow_out)
-    where borrow_out is 1 iff x < y."""
+    """Limb-wise x - y with borrow (strict inputs); (diff, borrow)."""
     init = jnp.zeros(x.shape[1:], dtype=U32)
 
     def step(bor, xy):
         x_k, y_k = xy
-        t = x_k + U32(BASE) - y_k - bor
+        t = x_k + U32(1 << BITS) - y_k - bor
         return U32(1) - (t >> BITS), t & MASK
 
     borrow, limbs = lax.scan(step, init, (x, y))
     return limbs, borrow
 
 
-def _p_like(a):
-    return bcast(P_LIMBS, a.shape[1:])
-
-
-def cond_sub_p(x):
-    """x - P if x >= P else x  (x < 2P)."""
-    d, borrow = sub_chain(x, _p_like(x))
-    return jnp.where((borrow == 0)[None], d, x)
-
-
 # ---------------------------------------------------------------------------
-# Core field ops
+# Add / sub / neg (chain-free)
 # ---------------------------------------------------------------------------
 
 
-def fp_add(a, b):
-    limbs, carry = carry_chain(a + b)
-    del carry  # a + b < 2P < 2^384: no carry out
-    return cond_sub_p(limbs)
+def _check_bound(b: float, who: str):
+    assert b <= MAX_BOUND, f"{who}: value bound {b} exceeds {MAX_BOUND}P"
 
 
-def fp_sub(a, b):
-    d, borrow = sub_chain(a, b)
-    # If a < b, add P back (drop the carry: d already wrapped mod 2^384).
-    dp, _ = carry_chain(d + _p_like(a))
-    return jnp.where((borrow == 1)[None], dp, d)
+def fp_add(a: LFp, b: LFp) -> LFp:
+    out = a.bound + b.bound
+    _check_bound(out, "fp_add")
+    return LFp(compress1(a.limbs + b.limbs), out)
 
 
-def fp_neg(a):
-    d, _ = sub_chain(_p_like(a), a)
-    return jnp.where(fp_is_zero(a)[None], a, d)
+def _k_for(bound: float) -> int:
+    k = 2
+    while k < bound:
+        k *= 2
+    assert k in BIAS, f"no bias constant for k={k} (bound {bound})"
+    return k
 
 
-def fp_is_zero(a):
-    return jnp.all(a == 0, axis=0)
+def fp_sub(a: LFp, b: LFp) -> LFp:
+    """Value a - b + k*P, k auto-chosen >= b.bound."""
+    k = _k_for(b.bound)
+    out = a.bound + k
+    _check_bound(out, "fp_sub")
+    bias = bcast(BIAS[k], a.limbs.shape[1:])
+    return LFp(compress1(a.limbs + bias - b.limbs), out)
 
 
-def fp_eq(a, b):
-    return jnp.all(a == b, axis=0)
+def fp_neg(a: LFp) -> LFp:
+    k = _k_for(a.bound)
+    bias = bcast(BIAS[k], a.limbs.shape[1:])
+    return LFp(compress1(bias - a.limbs), float(k))
 
 
-def fp_select(mask, a, b):
-    """mask over batch shape: a where mask else b."""
-    return jnp.where(mask[None], a, b)
+def fp_dbl(a: LFp) -> LFp:
+    return fp_add(a, a)
 
 
-def mul_wide(a, b):
-    """Full 48-limb product of two canonical 24-limb numbers (normalized)."""
-    nb = a.shape[1:]
+def fp_select(mask, a: LFp, b: LFp) -> LFp:
+    """mask over batch shape: a where mask else b (bound = max)."""
+    return LFp(jnp.where(mask[None], a.limbs, b.limbs), max(a.bound, b.bound))
+
+
+def relabel(a: LFp, bound: float) -> LFp:
+    """Weaken the bound label (bound may only increase)."""
+    assert bound >= a.bound
+    return LFp(a.limbs, bound)
+
+
+# ---------------------------------------------------------------------------
+# Multiplication
+# ---------------------------------------------------------------------------
+
+
+def _mul_cols_wide(a_limbs, b_limbs):
+    nb = a_limbs.shape[1:]
     acc0 = jnp.zeros((2 * N,) + nb, dtype=U32)
 
     def step(acc, a_i):
-        p = a_i[None] * b
+        p = a_i[None] * b_limbs
         plo = p & MASK
         phi = p >> BITS
         acc = jnp.concatenate([jnp.zeros_like(acc[:1]), acc[:-1]], axis=0)
@@ -191,19 +276,16 @@ def mul_wide(a, b):
         acc = acc.at[1 : N + 1].add(phi)
         return acc, None
 
-    acc, _ = lax.scan(step, acc0, jnp.flip(a, 0))
-    limbs, carry = carry_chain(acc)
-    del carry  # product < 2^768
-    return limbs
+    acc, _ = lax.scan(step, acc0, jnp.flip(a_limbs, 0))
+    return compress2(acc)
 
 
-def mul_low(a, b):
-    """Low 24 limbs of a*b, i.e. a*b mod 2^384 (normalized)."""
-    nb = a.shape[1:]
+def _mul_cols_low(a_limbs, b_limbs):
+    nb = a_limbs.shape[1:]
     acc0 = jnp.zeros((N,) + nb, dtype=U32)
 
     def step(acc, a_i):
-        p = a_i[None] * b
+        p = a_i[None] * b_limbs
         plo = p & MASK
         phi = p >> BITS
         acc = jnp.concatenate([jnp.zeros_like(acc[:1]), acc[:-1]], axis=0)
@@ -211,78 +293,107 @@ def mul_low(a, b):
         acc = acc.at[1:].add(phi[:-1])
         return acc, None
 
-    acc, _ = lax.scan(step, acc0, jnp.flip(a, 0))
-    limbs, _ = carry_chain(acc)  # carries out of limb 23 are dropped (mod R)
-    return limbs
+    acc, _ = lax.scan(step, acc0, jnp.flip(a_limbs, 0))
+    return compress2(acc)
 
 
-def mont_mul(a, b):
-    """Montgomery product  a * b * R^-1 mod P  (canonical in, canonical out)."""
-    t = mul_wide(a, b)
-    m = mul_low(t[:N], bcast(PPRIME_LIMBS, a.shape[1:]))
-    u = mul_wide(m, _p_like(a))
-    s, carry = carry_chain(t + u)
-    del carry  # t + u < 2^768 for canonical inputs
-    return cond_sub_p(s[N:])
+def mont_mul(a: LFp, b: LFp) -> LFp:
+    """Montgomery product a*b*R^-1 mod P (strict limbs out)."""
+    prod = a.bound * b.bound
+    assert prod <= MAX_MUL_PRODUCT, (
+        f"mont_mul input bound product {prod} > {MAX_MUL_PRODUCT}; "
+        "insert fp_reduce on an operand"
+    )
+    t = _mul_cols_wide(a.limbs, b.limbs)
+    m = _mul_cols_low(t[:N], bcast(PPRIME_LIMBS, a.limbs.shape[1:]))
+    u = _mul_cols_wide(m, bcast(P_LIMBS, a.limbs.shape[1:]))
+    s = full_chain(t + u)  # low N limbs are exactly zero (divisible by R)
+    return LFp(s[N:], prod / 625.0 + 1.1)
 
 
-def mont_sqr(a):
+def mont_sqr(a: LFp) -> LFp:
     return mont_mul(a, a)
 
 
-def fp_dbl(a):
-    return fp_add(a, a)
+def fp_reduce(x: LFp) -> LFp:
+    """Value-preserving (mod P) reduction.  The output bound is pinned to
+    the constant 2.0 (true bound: x.bound/625 + 1.1 < 1.9 for any in-range
+    x) so reduced values have a STABLE static bound — required for lax.scan
+    carries, whose pytree aux must match between iterations."""
+    out = mont_mul(x, one_like(x))
+    assert out.bound <= 2.0
+    return LFp(out.limbs, 2.0)
 
 
-def to_mont(a):
-    """Standard-domain limbs -> Montgomery domain (device)."""
-    return mont_mul(a, bcast(R2_LIMBS, a.shape[1:]))
+def guard_le(x: LFp, m: float) -> LFp:
+    """Reduce x iff its bound exceeds m (trace-time decision)."""
+    return fp_reduce(x) if x.bound > m else x
 
 
-def from_mont(a):
-    """Montgomery -> standard domain: mont_mul(a, 1)."""
-    return mont_mul(a, one_std_like(a))
+def fp_canon(x: LFp):
+    """Canonical raw limbs (strict, value < P) for equality/serialization."""
+    if x.bound > 2.0:
+        x = fp_reduce(x)
+    limbs = x.limbs
+    p = bcast(P_LIMBS, limbs.shape[1:])
+    d, borrow = sub_chain(limbs, p)
+    return jnp.where((borrow == 0)[None], d, limbs)
 
 
-def one_std_like(a):
-    one = np.zeros((N,), dtype=np.uint32)
-    one[0] = 1
-    return bcast(jnp.asarray(one), a.shape[1:])
+def fp_eq(a: LFp, b: LFp):
+    return jnp.all(fp_canon(a) == fp_canon(b), axis=0)
 
 
-def fp_pow(a, e: int):
-    """a^e for a static exponent (square-and-multiply scan over e's bits)."""
+def fp_is_zero(a: LFp):
+    return jnp.all(fp_canon(a) == 0, axis=0)
+
+
+def fp_pow(a: LFp, e: int) -> LFp:
+    """a^e for a static exponent.  The scan carry keeps a stable bound by
+    reducing nothing: mont outputs of (reduced x reduced) stay < 2."""
     assert e >= 0
     if e == 0:
         return one_like(a)
+    if a.bound > 4.0:
+        a = fp_reduce(a)
     bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=U32)
+    # stabilize the carried bound: sqr of <=4.3 would grow, so pin to the
+    # fixpoint bound of mont outputs
+    fix = MAX_MUL_PRODUCT / 625.0 + 1.1  # 4.3, closed under mont_mul? no:
+    # 4.3*4.3 = 18.5 <= 2000 ok, out = 18.5/625+1.1 = 1.13 < 4.3 ✓ and
+    # mul with a (<= 4.3): 1.13*4.3 ok, out < 1.11 < 4.3 ✓  => 4.3 is stable.
 
     def step(acc, bit):
         acc = mont_sqr(acc)
         withmul = mont_mul(acc, a)
-        return jnp.where((bit == 1), withmul, acc), None
+        sel = fp_select(bit == 1, withmul, acc)
+        return relabel(sel, fix), None
 
-    # MSB-first from acc = 1: first iteration yields a itself.
-    acc, _ = lax.scan(step, one_like(a), bits)
+    acc, _ = lax.scan(step, relabel(one_like(a), fix), bits)
     return acc
 
 
-def fp_inv(a):
-    """Inverse by Fermat: a^(P-2).  a == 0 maps to 0."""
+def fp_inv(a: LFp) -> LFp:
+    """Inverse by Fermat: a^(P-2).  a ≡ 0 maps to 0."""
     return fp_pow(a, P_INT - 2)
 
 
 # ---------------------------------------------------------------------------
-# Host helpers: Montgomery-domain codecs
+# Host codecs
 # ---------------------------------------------------------------------------
 
 
 def encode_mont(xs) -> np.ndarray:
-    """Host: list of ints (standard domain) -> (N, B) Montgomery limbs."""
+    """Host: ints (standard domain) -> (N, B) canonical Montgomery limbs."""
     return ints_to_limbs([x * R_INT % P_INT for x in xs])
 
 
-def decode_mont(limbs) -> list[int]:
-    """Host: (N, ...) Montgomery limbs -> standard-domain ints."""
+def lfp_encode(xs) -> LFp:
+    return LFp(jnp.asarray(encode_mont(xs)), 1.0)
+
+
+def decode_mont(x) -> list[int]:
+    """Host: LFp or raw limb array (any lazy form) -> standard-domain ints."""
+    limbs = x.limbs if isinstance(x, LFp) else x
     rinv = pow(R_INT, -1, P_INT)
-    return [x * rinv % P_INT for x in limbs_to_ints(limbs)]
+    return [v * rinv % P_INT for v in limbs_to_ints(np.asarray(limbs))]

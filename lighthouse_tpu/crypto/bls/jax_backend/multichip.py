@@ -74,6 +74,5 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
         mesh=mesh,
         in_specs=(batch_spec, batch_spec, batch_spec, batch_spec),
         out_specs=PS(),
-        check_rep=False,
     )
     return jax.jit(sharded)

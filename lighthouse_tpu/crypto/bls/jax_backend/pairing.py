@@ -9,9 +9,13 @@ parameter, and the cubed-hard-part final exponentiation via the identity
 Reference semantics: one Miller loop per (pubkey, message) pair plus one for
 the weighted signature aggregate, a single shared final exponentiation —
 blst's verify_multiple_aggregate_signatures (crypto/bls/src/impls/blst.rs:
-107-117, SURVEY.md §3.5).  Here the per-pair loops run vmapped-by-layout
+107-117, SURVEY.md §3.5).  Here the per-pair loops run batched-by-layout
 (batch = trailing axis), the GT product is a log-depth tree reduction over
 the batch axis, and the final exponentiation runs once.
+
+Independent field products are grouped into stacked multiplies, and every
+loop-carried value is reduced to the stable bound class at step boundaries
+(see fp.py on the lazy representation).
 """
 
 from __future__ import annotations
@@ -29,49 +33,66 @@ _X_BITS = [int(c) for c in bin(abs(params.X))[2:]]
 
 
 def _line_dbl(Tpt, xp, yp):
-    """Tangent line at Jacobian twist point, evaluated at P = (xp, yp) in
-    Montgomery limb form.  Returns ((l0, l2, l3), 2T) — the JAX twin of the
-    oracle's _line_dbl."""
+    """Tangent line at Jacobian twist point, evaluated at P = (xp, yp) (LFp
+    pair).  Returns ((l0, l2, l3), 2T), all coordinates reduced.  JAX twin
+    of the oracle's _line_dbl."""
     X1, Y1, Z1 = Tpt
-    X_sq = T.fp2_sqr(X1)
-    Y_sq = T.fp2_sqr(Y1)
-    Z_sq = T.fp2_sqr(Z1)
-    Z_cu = T.fp2_mul(Z_sq, Z1)
-    l0 = T.fp2_sub(T.fp2_mul_small(T.fp2_mul(X_sq, X1), 3), T.fp2_dbl(Y_sq))
-    l2 = T.fp2_neg(T.fp2_mul_fp(T.fp2_mul_small(T.fp2_mul(X_sq, Z_sq), 3), xp))
-    l3 = T.fp2_mul_fp(T.fp2_dbl(T.fp2_mul(Y1, Z_cu)), yp)
-    # Jacobian doubling reusing X_sq / Y_sq.
-    C = T.fp2_sqr(Y_sq)
-    D = T.fp2_dbl(
-        T.fp2_sub(T.fp2_sub(T.fp2_sqr(T.fp2_add(X1, Y_sq)), X_sq), C)
-    )
+    X_sq, Y_sq, Z_sq, YZ = T.fp2_mul_many([X1, Y1, Z1, Y1], [X1, Y1, Z1, Z1])
     E = T.fp2_mul_small(X_sq, 3)
-    Fv = T.fp2_sqr(E)
+    XB = T.fp2_add(X1, Y_sq)
+    X_cu, Z_cu, XZ, C, t, Fv = T.fp2_mul_many(
+        [X_sq, Z_sq, X_sq, Y_sq, XB, E],
+        [X1, Z1, Z_sq, Y_sq, XB, E],
+    )
+    l0 = T.fp2_sub(T.fp2_mul_small(X_cu, 3), T.fp2_dbl(Y_sq))
+    D = T.fp2_dbl(T.fp2_sub(T.fp2_sub(t, X_sq), C))
     X3 = T.fp2_sub(Fv, T.fp2_dbl(D))
-    Y3 = T.fp2_sub(T.fp2_mul(E, T.fp2_sub(D, X3)), T.fp2_mul_small(C, 8))
-    Z3 = T.fp2_dbl(T.fp2_mul(Y1, Z1))
-    return (l0, l2, l3), (X3, Y3, Z3)
+    YZ3 = T.fp2_dbl(T.fp2_mul(Y1, Z_cu))
+    m3XZ = T.fp2_neg(T.fp2_mul_small(XZ, 3))
+    # scalar evaluations at P: one stacked base multiply (4 lanes)
+    ev = T.mm_many([m3XZ[0], m3XZ[1], YZ3[0], YZ3[1]], [xp, xp, yp, yp])
+    l2 = (ev[0], ev[1])
+    l3 = (ev[2], ev[3])
+    (m,) = T.fp2_mul_many([E], [T.fp2_sub(D, X3)])
+    Y3 = T.fp2_sub(m, T.fp2_mul_small(C, 8))
+    Z3 = T.fp2_dbl(YZ)
+    l0, l2, l3, X3, Y3, Z3 = _reduce_fp2_group([l0, l2, l3, X3, Y3, Z3])
+    return ((l0, l2, l3), (X3, Y3, Z3))
 
 
 def _line_add(Tpt, Q, xp, yp):
     """Chord line through Jacobian T and affine twist Q, evaluated at P.
-    Returns ((l0, l2, l3), T + Q) — the JAX twin of the oracle's _line_add."""
+    Returns ((l0, l2, l3), T + Q), reduced.  JAX twin of the oracle's
+    _line_add."""
     X1, Y1, Z1 = Tpt
     x2, y2 = Q
-    Z_sq = T.fp2_sqr(Z1)
-    Z_cu = T.fp2_mul(Z_sq, Z1)
-    H = T.fp2_sub(T.fp2_mul(x2, Z_sq), X1)
-    rr = T.fp2_sub(T.fp2_mul(y2, Z_cu), Y1)
-    ZH = T.fp2_mul(Z1, H)
-    l0 = T.fp2_sub(T.fp2_mul(rr, x2), T.fp2_mul(y2, ZH))
-    l2 = T.fp2_neg(T.fp2_mul_fp(rr, xp))
-    l3 = T.fp2_mul_fp(ZH, yp)
-    H_sq = T.fp2_sqr(H)
-    H_cu = T.fp2_mul(H, H_sq)
-    V = T.fp2_mul(X1, H_sq)
-    X3 = T.fp2_sub(T.fp2_sub(T.fp2_sqr(rr), H_cu), T.fp2_dbl(V))
-    Y3 = T.fp2_sub(T.fp2_mul(rr, T.fp2_sub(V, X3)), T.fp2_mul(Y1, H_cu))
-    return (l0, l2, l3), (X3, Y3, ZH)
+    (Z_sq,) = T.fp2_mul_many([Z1], [Z1])
+    Z_cu, U2 = T.fp2_mul_many([Z_sq, x2], [Z1, Z_sq])
+    H = T.fp2_sub(U2, X1)
+    S2, ZH, H_sq = T.fp2_mul_many([y2, Z1, H], [Z_cu, H, H])
+    rr = T.fp2_sub(S2, Y1)
+    p_rx, p_yZH, rr2, H_cu, V = T.fp2_mul_many(
+        [rr, y2, rr, H, X1], [x2, ZH, rr, H_sq, H_sq]
+    )
+    l0 = T.fp2_sub(p_rx, p_yZH)
+    X3 = T.fp2_sub(T.fp2_sub(rr2, H_cu), T.fp2_dbl(V))
+    m1, m2 = T.fp2_mul_many([rr, Y1], [T.fp2_sub(V, X3), H_cu])
+    Y3 = T.fp2_sub(m1, m2)
+    neg_rr = T.fp2_neg(rr)
+    ev = T.mm_many([neg_rr[0], neg_rr[1], ZH[0], ZH[1]], [xp, xp, yp, yp])
+    l2 = (ev[0], ev[1])
+    l3 = (ev[2], ev[3])
+    l0, l2, l3, X3, Y3, Z3 = _reduce_fp2_group([l0, l2, l3, X3, Y3, ZH])
+    return ((l0, l2, l3), (X3, Y3, Z3))
+
+
+def _reduce_fp2_group(items):
+    """Stacked reduction of a list of Fp2 values to stable bound 2."""
+    lanes = []
+    for it in items:
+        lanes += [it[0], it[1]]
+    red = T.reduce_many(lanes)
+    return [(red[2 * i], red[2 * i + 1]) for i in range(len(items))]
 
 
 def miller_loop(p_aff, q_aff):
@@ -79,9 +100,17 @@ def miller_loop(p_aff, q_aff):
     points ((x2c0,x2c1),(y2c0,y2c1)); trailing axes are the batch.  Neither
     input may be infinity (callers enforce this host-side, as the reference
     rejects infinity pubkeys/signatures before pairing)."""
-    xp, yp = p_aff
+    def pin(c):
+        return F.relabel(F.guard_le(c, 2.0), 2.0)
+
+    xp, yp = pin(p_aff[0]), pin(p_aff[1])
+    q_aff = (
+        (pin(q_aff[0][0]), pin(q_aff[0][1])),
+        (pin(q_aff[1][0]), pin(q_aff[1][1])),
+    )
     bits = jnp.array(_X_BITS[1:], dtype=jnp.uint32)
-    T0 = (q_aff[0], q_aff[1], T.fp2_one_like(q_aff[0]))
+    one2 = tuple(F.relabel(c, 2.0) for c in T.fp2_one_like(q_aff[0]))
+    T0 = (q_aff[0], q_aff[1], one2)
 
     def step(carry, bit):
         f, Tpt = carry
@@ -90,11 +119,17 @@ def miller_loop(p_aff, q_aff):
         line_a, T_add = _line_add(Tpt, q_aff, xp, yp)
         f_a = T.fp12_mul_by_023(f, *line_a)
         take = bit == 1
-        f = jax.tree.map(lambda m, n: jnp.where(take, m, n), f_a, f)
-        Tpt = P.pt_select(P.FP2_OPS, take, T_add, Tpt)
-        return (f, Tpt), None
+        f = T._map2_lfp(lambda m, n: F.fp_select(take, m, n), f_a, f)
+        f = T.fp12_relabel(f, 2.0)
+        Tsel = tuple(
+            T.fp2_select(take, a, b) for a, b in zip(T_add, Tpt)
+        )
+        Tsel = tuple(
+            (F.relabel(c[0], 2.0), F.relabel(c[1], 2.0)) for c in Tsel
+        )
+        return (f, Tsel), None
 
-    f_init = _fp12_one_like_from_fp2(q_aff[0])
+    f_init = T.fp12_relabel(_fp12_one_like_from_fp2(q_aff[0]), 2.0)
     (f, _), _ = lax.scan(step, (f_init, T0), bits)
     return T.fp12_conj(f)
 
@@ -108,31 +143,42 @@ def _fp12_one_like_from_fp2(x2):
 def gt_product(f):
     """Reduce the trailing batch axis of an fp12 pytree by multiplication
     (log-depth tree).  Batch must be along the last axis."""
-    B = jax.tree.leaves(f)[0].shape[-1]
-    # pad to a power of two with ones
+    B = _fp12_batch(f)
     target = 1 << max(1, (B - 1).bit_length())
     if target != B:
-        pad_one = _fp12_one_like_pad(f, target - B)
-        f = jax.tree.map(
-            lambda a, b: jnp.concatenate([a, b], axis=-1), f, pad_one
+        pad_one = _fp12_one_pad(f, target - B)
+        f = T._map2_lfp(
+            lambda a, b: F.LFp(
+                jnp.concatenate([a.limbs, b.limbs], axis=-1),
+                max(a.bound, b.bound),
+            ),
+            f,
+            pad_one,
         )
     n = target
     while n > 1:
         half = n // 2
-        lo = jax.tree.map(lambda a: a[..., :half], f)
-        hi = jax.tree.map(lambda a: a[..., half : 2 * half], f)
+        lo = T._map_lfp(lambda a: F.LFp(a.limbs[..., :half], a.bound), f)
+        hi = T._map_lfp(
+            lambda a: F.LFp(a.limbs[..., half : 2 * half], a.bound), f
+        )
         f = T.fp12_mul(lo, hi)
         n = half
     return f
 
 
-def _fp12_one_like_pad(f, count: int):
-    ref = jax.tree.leaves(f)[0]
-    shape = ref.shape[:-1] + (count,)
-    zero = jnp.zeros(shape, dtype=ref.dtype)
-    one_limbs = F.bcast(F.ONE_MONT, shape[1:])
+def _fp12_batch(f):
+    c = f[0][0][0]
+    return c.limbs.shape[-1]
+
+
+def _fp12_one_pad(f, count: int):
+    ref = f[0][0][0]
+    shape = ref.limbs.shape[:-1] + (count,)
+    zero = F.LFp(jnp.zeros(shape, dtype=ref.limbs.dtype), 0.0)
+    one = F.LFp(F.bcast(F.ONE_MONT, shape[1:]), 1.0)
     z2 = (zero, zero)
-    o2 = (one_limbs, zero)
+    o2 = (one, zero)
     return ((o2, z2, z2), (z2, z2, z2))
 
 

@@ -7,19 +7,98 @@ Tower construction (identical to the oracle and to blst):
 
 Elements are pytrees of limb arrays: Fp2 = (c0, c1), Fp6 = (c0, c1, c2) of
 Fp2, Fp12 = (c0, c1) of Fp6 — so they thread through lax.scan carries and
-jnp.where selections transparently.  Frobenius coefficients are taken from
-the oracle's computed FROB_GAMMA table (never transcribed) and converted to
-Montgomery limb constants at import.
+jnp.where selections transparently.
+
+TPU-shaping: every multi-multiplication formula (Karatsuba products, the
+sparse line multiply) funnels its independent base-field products through a
+SINGLE ``mont_mul`` on batch-axis-concatenated operands ("horizontal
+stacking").  One wide multiply instead of k narrow ones keeps the XLA graph
+small (compile time) and the VPU lanes full (run time).  Additions and
+subtractions are stacked the same way where they come in groups.
+
+Frobenius coefficients are taken from the oracle's computed FROB_GAMMA table
+(never transcribed) and converted to Montgomery limb constants at import.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import fields as _oracle
-from .. import params
 from . import fp as F
+
+# ---------------------------------------------------------------------------
+# Stacking helpers: k independent fp ops as one wide op
+# ---------------------------------------------------------------------------
+
+
+def _cat(xs):
+    """Stack LFp lanes along the batch axis; bound = max (pessimistic)."""
+    if len(xs) == 1:
+        return xs[0]
+    return F.LFp(
+        jnp.concatenate([x.limbs for x in xs], axis=-1),
+        max(x.bound for x in xs),
+    )
+
+
+def _split(x, k):
+    if k == 1:
+        return [x]
+    b = x.limbs.shape[-1] // k
+    return [
+        F.LFp(x.limbs[..., i * b : (i + 1) * b], x.bound) for i in range(k)
+    ]
+
+
+def mm_many(As, Bs):
+    """[a_i * b_i] via one Montgomery multiply on stacked lanes.  Lanes with
+    oversized bounds are auto-reduced first (the stacked multiply's bound is
+    the max over lanes, so one fat lane taxes them all)."""
+    As = [F.guard_le(a, 40.0) for a in As]
+    Bs = [F.guard_le(b, 40.0) for b in Bs]
+    return _split(F.mont_mul(_cat(As), _cat(Bs)), len(As))
+
+
+def add_many(As, Bs):
+    return _split(F.fp_add(_cat(As), _cat(Bs)), len(As))
+
+
+def sub_many(As, Bs):
+    return _split(F.fp_sub(_cat(As), _cat(Bs)), len(As))
+
+
+def reduce_many(xs):
+    """Stacked value-preserving reduction: every element back to bound < 2."""
+    return _split(F.fp_reduce(_cat(xs)), len(xs))
+
+
+def fp2_reduce(a):
+    c = reduce_many([a[0], a[1]])
+    return (c[0], c[1])
+
+
+def fp6_reduce(a):
+    c = reduce_many([a[0][0], a[0][1], a[1][0], a[1][1], a[2][0], a[2][1]])
+    return ((c[0], c[1]), (c[2], c[3]), (c[4], c[5]))
+
+
+def _fp12_lanes(a):
+    return [
+        a[0][0][0], a[0][0][1], a[0][1][0], a[0][1][1], a[0][2][0], a[0][2][1],
+        a[1][0][0], a[1][0][1], a[1][1][0], a[1][1][1], a[1][2][0], a[1][2][1],
+    ]
+
+
+def fp12_reduce(a):
+    c = reduce_many(_fp12_lanes(a))
+    return (
+        ((c[0], c[1]), (c[2], c[3]), (c[4], c[5])),
+        ((c[6], c[7]), (c[8], c[9]), (c[10], c[11])),
+    )
+
 
 # ---------------------------------------------------------------------------
 # Fp2
@@ -39,11 +118,13 @@ def fp2_one_like(x2):
 
 
 def fp2_add(a, b):
-    return (F.fp_add(a[0], b[0]), F.fp_add(a[1], b[1]))
+    c = add_many([a[0], a[1]], [b[0], b[1]])
+    return (c[0], c[1])
 
 
 def fp2_sub(a, b):
-    return (F.fp_sub(a[0], b[0]), F.fp_sub(a[1], b[1]))
+    c = sub_many([a[0], a[1]], [b[0], b[1]])
+    return (c[0], c[1])
 
 
 def fp2_neg(a):
@@ -54,24 +135,35 @@ def fp2_dbl(a):
     return fp2_add(a, a)
 
 
+def fp2_guard(a, m: float = 11.0):
+    """Auto-reduce an Fp2 operand whose coords exceed bound m (keeps the
+    Karatsuba sum lanes inside mont_mul's input budget)."""
+    if max(a[0].bound, a[1].bound) > m:
+        return fp2_reduce(a)
+    return a
+
+
 def fp2_mul(a, b):
-    """Karatsuba: 3 base muls."""
-    t0 = F.mont_mul(a[0], b[0])
-    t1 = F.mont_mul(a[1], b[1])
-    s = F.mont_mul(F.fp_add(a[0], a[1]), F.fp_add(b[0], b[1]))
-    return (F.fp_sub(t0, t1), F.fp_sub(F.fp_sub(s, t0), t1))
+    """Karatsuba with one stacked base multiply (3 lanes)."""
+    a, b = fp2_guard(a), fp2_guard(b)
+    s = add_many([a[0], b[0]], [a[1], b[1]])  # a0+a1, b0+b1
+    t0, t1, t2 = mm_many([a[0], a[1], s[0]], [b[0], b[1], s[1]])
+    c = sub_many([t0, t2], [t1, F.fp_add(t0, t1)])
+    return (c[0], c[1])
 
 
 def fp2_sqr(a):
-    """(a0+a1 u)^2 = (a0-a1)(a0+a1) + 2 a0 a1 u — 2 base muls."""
-    c0 = F.mont_mul(F.fp_sub(a[0], a[1]), F.fp_add(a[0], a[1]))
-    t = F.mont_mul(a[0], a[1])
+    """(a0-a1)(a0+a1), 2 a0 a1 — one stacked multiply (2 lanes)."""
+    a = fp2_guard(a)
+    d = F.fp_sub(a[0], a[1])
+    s = F.fp_add(a[0], a[1])
+    c0, t = mm_many([d, a[0]], [s, a[1]])
     return (c0, F.fp_add(t, t))
 
 
 def fp2_mul_fp(a, s):
-    """Multiply by an Fp element (limb array)."""
-    return (F.mont_mul(a[0], s), F.mont_mul(a[1], s))
+    c = mm_many([a[0], a[1]], [s, s])
+    return (c[0], c[1])
 
 
 def fp2_mul_small(a, k: int):
@@ -91,13 +183,18 @@ def fp2_conj(a):
 
 def fp2_mul_by_nonresidue(a):
     """Multiply by xi = 1 + u."""
-    return (F.fp_sub(a[0], a[1]), F.fp_add(a[0], a[1]))
+    c0 = F.fp_sub(a[0], a[1])
+    c1 = F.fp_add(a[0], a[1])
+    return (c0, c1)
 
 
 def fp2_inv(a):
-    norm = F.fp_add(F.mont_sqr(a[0]), F.mont_sqr(a[1]))
+    a = fp2_guard(a)
+    sq = mm_many([a[0], a[1]], [a[0], a[1]])
+    norm = F.fp_add(sq[0], sq[1])
     ninv = F.fp_inv(norm)
-    return (F.mont_mul(a[0], ninv), F.fp_neg(F.mont_mul(a[1], ninv)))
+    c = mm_many([a[0], a[1]], [ninv, ninv])
+    return (c[0], F.fp_neg(c[1]))
 
 
 def fp2_is_zero(a):
@@ -116,7 +213,43 @@ def fp2_const(oracle_fp2: "_oracle.Fp2", batch_shape):
     """Oracle Fp2 constant -> broadcast Montgomery limb pytree."""
     c0 = jnp.asarray(F.int_to_limbs(oracle_fp2.c0 * F.R_INT % F.P_INT))
     c1 = jnp.asarray(F.int_to_limbs(oracle_fp2.c1 * F.R_INT % F.P_INT))
-    return (F.bcast(c0, batch_shape), F.bcast(c1, batch_shape))
+    return (
+        F.LFp(F.bcast(c0, batch_shape), 1.0),
+        F.LFp(F.bcast(c1, batch_shape), 1.0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fp2 product stacking: k independent Fp2 multiplies in one base multiply
+# ---------------------------------------------------------------------------
+
+
+def fp2_mul_many(As, Bs):
+    """[a_i * b_i] for Fp2 pairs via ONE stacked base multiply (3k lanes)."""
+    k = len(As)
+    if k == 1:
+        return [fp2_mul(As[0], Bs[0])]
+    As = [fp2_guard(a) for a in As]
+    Bs = [fp2_guard(b) for b in Bs]
+    # sums a0+a1 and b0+b1 for every pair: one stacked add
+    sums = add_many(
+        [a[0] for a in As] + [b[0] for b in Bs],
+        [a[1] for a in As] + [b[1] for b in Bs],
+    )
+    a_sums, b_sums = sums[:k], sums[k:]
+    lanes_a, lanes_b = [], []
+    for a, b, sa, sb in zip(As, Bs, a_sums, b_sums):
+        lanes_a += [a[0], a[1], sa]
+        lanes_b += [b[0], b[1], sb]
+    prods = mm_many(lanes_a, lanes_b)
+    # combine per pair: c0 = t0 - t1 ; c1 = s - (t0 + t1)
+    t0s = prods[0::3]
+    t1s = prods[1::3]
+    ss = prods[2::3]
+    t01s = add_many(t0s, t1s)
+    c0s = sub_many(t0s, t1s)
+    c1s = sub_many(ss, t01s)
+    return [(c0, c1) for c0, c1 in zip(c0s, c1s)]
 
 
 # ---------------------------------------------------------------------------
@@ -125,11 +258,19 @@ def fp2_const(oracle_fp2: "_oracle.Fp2", batch_shape):
 
 
 def fp6_add(a, b):
-    return tuple(fp2_add(x, y) for x, y in zip(a, b))
+    c = add_many(
+        [a[0][0], a[0][1], a[1][0], a[1][1], a[2][0], a[2][1]],
+        [b[0][0], b[0][1], b[1][0], b[1][1], b[2][0], b[2][1]],
+    )
+    return ((c[0], c[1]), (c[2], c[3]), (c[4], c[5]))
 
 
 def fp6_sub(a, b):
-    return tuple(fp2_sub(x, y) for x, y in zip(a, b))
+    c = sub_many(
+        [a[0][0], a[0][1], a[1][0], a[1][1], a[2][0], a[2][1]],
+        [b[0][0], b[0][1], b[1][0], b[1][1], b[2][0], b[2][1]],
+    )
+    return ((c[0], c[1]), (c[2], c[3]), (c[4], c[5]))
 
 
 def fp6_neg(a):
@@ -146,26 +287,42 @@ def fp6_one_like(a):
 
 
 def fp6_mul(a, b):
-    """Toom/Karatsuba interpolation, as the oracle (fields.py Fp6.__mul__)."""
+    """Toom/Karatsuba (as the oracle) with all six Fp2 products in one
+    stacked base multiply."""
     a0, a1, a2 = a
     b0, b1, b2 = b
-    t0 = fp2_mul(a0, b0)
-    t1 = fp2_mul(a1, b1)
-    t2 = fp2_mul(a2, b2)
-    c0 = fp2_add(
-        fp2_mul_by_nonresidue(
-            fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)
-        ),
-        t0,
+    # pairwise sums for the cross terms: one stacked Fp2 add
+    s = add_many(
+        [a1[0], a1[1], b1[0], b1[1], a0[0], a0[1], b0[0], b0[1], a0[0], a0[1], b0[0], b0[1]],
+        [a2[0], a2[1], b2[0], b2[1], a1[0], a1[1], b1[0], b1[1], a2[0], a2[1], b2[0], b2[1]],
     )
-    c1 = fp2_add(
-        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
-        fp2_mul_by_nonresidue(t2),
+    a12, b12 = (s[0], s[1]), (s[2], s[3])
+    a01, b01 = (s[4], s[5]), (s[6], s[7])
+    a02, b02 = (s[8], s[9]), (s[10], s[11])
+    t0, t1, t2, u12, u01, u02 = fp2_mul_many(
+        [a0, a1, a2, a12, a01, a02], [b0, b1, b2, b12, b01, b02]
     )
-    c2 = fp2_add(
-        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1
+    # c0 = xi*(u12 - t1 - t2) + t0
+    # c1 = u01 - t0 - t1 + xi*t2
+    # c2 = u02 - t0 - t2 + t1
+    d1 = sub_many(
+        [u12[0], u12[1], u01[0], u01[1], u02[0], u02[1]],
+        [t1[0], t1[1], t0[0], t0[1], t0[0], t0[1]],
     )
-    return (c0, c1, c2)
+    d2 = sub_many(
+        [d1[0], d1[1], d1[2], d1[3], d1[4], d1[5]],
+        [t2[0], t2[1], t1[0], t1[1], t2[0], t2[1]],
+    )
+    X = (d2[0], d2[1])  # u12 - t1 - t2
+    Y = (d2[2], d2[3])  # u01 - t0 - t1
+    Z = (d2[4], d2[5])  # u02 - t0 - t2
+    xiX = fp2_mul_by_nonresidue(X)
+    xit2 = fp2_mul_by_nonresidue(t2)
+    e = add_many(
+        [xiX[0], xiX[1], Y[0], Y[1], Z[0], Z[1]],
+        [t0[0], t0[1], xit2[0], xit2[1], t1[0], t1[1]],
+    )
+    return fp6_reduce(((e[0], e[1]), (e[2], e[3]), (e[4], e[5])))
 
 
 def fp6_sqr(a):
@@ -177,23 +334,25 @@ def fp6_mul_by_v(a):
 
 
 def fp6_mul_fp2(a, s):
-    return tuple(fp2_mul(x, s) for x in a)
+    c = fp2_mul_many([a[0], a[1], a[2]], [s, s, s])
+    return (c[0], c[1], c[2])
 
 
 def fp6_inv(a):
     a0, a1, a2 = a
-    t0 = fp2_sub(fp2_sqr(a0), fp2_mul_by_nonresidue(fp2_mul(a1, a2)))
-    t1 = fp2_sub(fp2_mul_by_nonresidue(fp2_sqr(a2)), fp2_mul(a0, a1))
-    t2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    sq0, sq1, sq2, m12, m01, m02 = fp2_mul_many(
+        [a0, a2, a1, a1, a0, a0], [a0, a2, a1, a2, a1, a2]
+    )
+    t0 = fp2_sub(sq0, fp2_mul_by_nonresidue(m12))
+    t1 = fp2_sub(fp2_mul_by_nonresidue(sq1), m01)
+    t2 = fp2_sub(sq2, m02)
+    p0, p1, p2 = fp2_mul_many([a0, a2, a1], [t0, t1, t2])
     denom = fp2_add(
-        fp2_mul(a0, t0),
-        fp2_add(
-            fp2_mul_by_nonresidue(fp2_mul(a2, t1)),
-            fp2_mul_by_nonresidue(fp2_mul(a1, t2)),
-        ),
+        p0, fp2_add(fp2_mul_by_nonresidue(p1), fp2_mul_by_nonresidue(p2))
     )
     dinv = fp2_inv(denom)
-    return (fp2_mul(t0, dinv), fp2_mul(t1, dinv), fp2_mul(t2, dinv))
+    c = fp2_mul_many([t0, t1, t2], [dinv, dinv, dinv])
+    return fp6_reduce((c[0], c[1], c[2]))
 
 
 def fp6_select(mask, a, b):
@@ -222,9 +381,10 @@ def fp12_mul(a, b):
     b0, b1 = b
     t0 = fp6_mul(a0, b0)
     t1 = fp6_mul(a1, b1)
+    u = fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1))
     c0 = fp6_add(t0, fp6_mul_by_v(t1))
-    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
-    return (c0, c1)
+    c1 = fp6_sub(fp6_sub(u, t0), t1)
+    return fp12_reduce((c0, c1))
 
 
 def fp12_sqr(a):
@@ -234,7 +394,7 @@ def fp12_sqr(a):
         fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1))), t),
         fp6_mul_by_v(t),
     )
-    return (c0, fp6_add(t, t))
+    return fp12_reduce((c0, fp6_add(t, t)))
 
 
 def fp12_conj(a):
@@ -245,7 +405,7 @@ def fp12_inv(a):
     a0, a1 = a
     denom = fp6_sub(fp6_sqr(a0), fp6_mul_by_v(fp6_sqr(a1)))
     dinv = fp6_inv(denom)
-    return (fp6_mul(a0, dinv), fp6_neg(fp6_mul(a1, dinv)))
+    return fp12_reduce((fp6_mul(a0, dinv), fp6_neg(fp6_mul(a1, dinv))))
 
 
 def fp12_select(mask, a, b):
@@ -261,26 +421,40 @@ def fp12_is_one(a):
 
 
 def fp12_mul_by_023(f, l0, l2, l3):
-    """Sparse line multiplication, mirroring the oracle's Fp12.mul_by_023."""
+    """Sparse line multiplication (oracle: Fp12.mul_by_023) with all fifteen
+    Fp2 products in one stacked base multiply."""
     a0, a1 = f
-    t0 = (
-        fp2_add(fp2_mul(a0[0], l0), fp2_mul_by_nonresidue(fp2_mul(a0[2], l2))),
-        fp2_add(fp2_mul(a0[0], l2), fp2_mul(a0[1], l0)),
-        fp2_add(fp2_mul(a0[1], l2), fp2_mul(a0[2], l0)),
-    )
-    t1 = (
-        fp2_mul_by_nonresidue(fp2_mul(a1[2], l3)),
-        fp2_mul(a1[0], l3),
-        fp2_mul(a1[1], l3),
-    )
     s = fp6_add(a0, a1)
     l23 = fp2_add(l2, l3)
-    t2 = (
-        fp2_add(fp2_mul(s[0], l0), fp2_mul_by_nonresidue(fp2_mul(s[2], l23))),
-        fp2_add(fp2_mul(s[0], l23), fp2_mul(s[1], l0)),
-        fp2_add(fp2_mul(s[1], l23), fp2_mul(s[2], l0)),
+    prods = fp2_mul_many(
+        [
+            a0[0], a0[2], a0[0], a0[1], a0[1], a0[2],  # t0 terms
+            a1[2], a1[0], a1[1],                        # t1 terms
+            s[0], s[2], s[0], s[1], s[1], s[2],         # t2 terms
+        ],
+        [
+            l0, l2, l2, l0, l2, l0,
+            l3, l3, l3,
+            l0, l23, l23, l0, l23, l0,
+        ],
     )
-    return (fp6_add(t0, fp6_mul_by_v(t1)), fp6_sub(fp6_sub(t2, t0), t1))
+    (p00, p02, q00, q01, r01, r02,
+     w2, w0, w1,
+     s00, s02, v00, v01, x01, x02) = prods
+    t0 = (
+        fp2_add(p00, fp2_mul_by_nonresidue(p02)),
+        fp2_add(q00, q01),
+        fp2_add(r01, r02),
+    )
+    t1 = (fp2_mul_by_nonresidue(w2), w0, w1)
+    t2 = (
+        fp2_add(s00, fp2_mul_by_nonresidue(s02)),
+        fp2_add(v00, v01),
+        fp2_add(x01, x02),
+    )
+    return fp12_reduce(
+        (fp6_add(t0, fp6_mul_by_v(t1)), fp6_sub(fp6_sub(t2, t0), t1))
+    )
 
 
 # Frobenius: coefficients from the oracle's computed table.
@@ -291,20 +465,20 @@ def _gamma(i: int, batch_shape):
 
 
 def fp12_frobenius(a):
-    bs = a[0][0][0].shape[1:]
+    bs = F.batch_shape(a[0][0][0])
     c0, c1 = a
-    f0 = (
-        fp2_conj(c0[0]),
-        fp2_mul(fp2_conj(c0[1]), _gamma(2, bs)),
-        fp2_mul(fp2_conj(c0[2]), _gamma(4, bs)),
-    )
     g1 = _gamma(1, bs)
-    f1 = (
-        fp2_mul(fp2_conj(c1[0]), g1),
-        fp2_mul(fp2_mul(fp2_conj(c1[1]), _gamma(2, bs)), g1),
-        fp2_mul(fp2_mul(fp2_conj(c1[2]), _gamma(4, bs)), g1),
+    g2 = _gamma(2, bs)
+    g4 = _gamma(4, bs)
+    g1g2 = fp2_mul(g2, g1)
+    g1g4 = fp2_mul(g4, g1)
+    m = fp2_mul_many(
+        [fp2_conj(c0[1]), fp2_conj(c0[2]), fp2_conj(c1[0]), fp2_conj(c1[1]), fp2_conj(c1[2])],
+        [g2, g4, g1, g1g2, g1g4],
     )
-    return (f0, f1)
+    f0 = (fp2_conj(c0[0]), m[0], m[1])
+    f1 = (m[2], m[3], m[4])
+    return fp12_reduce((f0, f1))
 
 
 def fp12_frobenius_n(a, n: int):
@@ -313,27 +487,47 @@ def fp12_frobenius_n(a, n: int):
     return a
 
 
+def _map_lfp(f, x):
+    """Apply f to every LFp leaf of a nested-tuple field element."""
+    if isinstance(x, F.LFp):
+        return f(x)
+    return tuple(_map_lfp(f, c) for c in x)
+
+
+def _map2_lfp(f, x, y):
+    if isinstance(x, F.LFp):
+        return f(x, y)
+    return tuple(_map2_lfp(f, a, b) for a, b in zip(x, y))
+
+
+def fp12_relabel(x, bound: float):
+    """Pin every coordinate's static bound (upward only) — used to keep scan
+    carries structurally stable."""
+    return _map_lfp(lambda c: F.relabel(c, bound), x)
+
+
 def fp12_pow(a, e: int):
     """a^e for a static non-negative exponent; scan over bits."""
-    import jax
     from jax import lax
 
     assert e >= 0
     if e == 0:
         return fp12_one_like(a)
+    a = _map_lfp(lambda c: F.guard_le(c, 2.0), a)
     bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=jnp.uint32)
 
     def step(acc, bit):
         acc = fp12_sqr(acc)
         withmul = fp12_mul(acc, a)
         take = bit == 1
-        return jax.tree.map(lambda m, n: jnp.where(take, m, n), withmul, acc), None
+        sel = _map2_lfp(lambda m, n: F.fp_select(take, m, n), withmul, acc)
+        return fp12_relabel(sel, 2.0), None
 
-    acc, _ = lax.scan(step, fp12_one_like(a), bits)
+    acc, _ = lax.scan(step, fp12_relabel(fp12_one_like(a), 2.0), bits)
     return acc
 
 
-def fp12_pow_signed(a, e: int, cyclotomic: bool = False):
+def fp12_pow_signed(a, e: int):
     """a^e allowing negative e when a is unit-norm (conjugate == inverse)."""
     if e < 0:
         return fp12_conj(fp12_pow(a, -e))
@@ -347,14 +541,12 @@ def fp12_pow_signed(a, e: int, cyclotomic: bool = False):
 
 def fp2_encode(vals: list["_oracle.Fp2"]) -> tuple:
     """Host: list of oracle Fp2 -> device Montgomery pytree, batch = len."""
-    c0 = jnp.asarray(F.encode_mont([v.c0 for v in vals]))
-    c1 = jnp.asarray(F.encode_mont([v.c1 for v in vals]))
-    return (c0, c1)
+    return (F.lfp_encode([v.c0 for v in vals]), F.lfp_encode([v.c1 for v in vals]))
 
 
 def fp2_decode(x2) -> list["_oracle.Fp2"]:
-    c0s = F.decode_mont(np.asarray(x2[0]))
-    c1s = F.decode_mont(np.asarray(x2[1]))
+    c0s = F.decode_mont(x2[0])
+    c1s = F.decode_mont(x2[1])
     return [_oracle.Fp2(a, b) for a, b in zip(c0s, c1s)]
 
 

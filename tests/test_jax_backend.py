@@ -1,0 +1,98 @@
+"""End-to-end differential tests: JaxBackend vs PythonBackend.
+
+The jitted kernel compiles once per padded batch size (~minutes on CPU
+XLA); these tests share one backend instance and one batch size so the
+whole file pays a single compile.
+"""
+
+import random
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from lighthouse_tpu.crypto.bls import api
+from lighthouse_tpu.crypto.bls.api import (
+    AggregateSignature,
+    PythonBackend,
+    SecretKey,
+    SignatureSet,
+)
+
+rng = random.Random(0xBEEF)
+
+
+@pytest.fixture(scope="module")
+def jax_backend():
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import JaxBackend
+
+    return JaxBackend(min_batch=4)
+
+
+def make_set(sk_int: int, msg: bytes, corrupt: bool = False) -> SignatureSet:
+    sk = SecretKey(sk_int)
+    sig = sk.sign(msg)
+    if corrupt:
+        msg = bytes(b ^ 0x5A for b in msg)
+    return SignatureSet(sig, [sk.public_key()], msg)
+
+
+def test_valid_batch(jax_backend):
+    sets = [make_set(1000 + i, bytes([i]) * 32) for i in range(3)]
+    assert jax_backend.verify_signature_sets(sets) is True
+
+
+def test_poisoned_batch(jax_backend):
+    sets = [make_set(1000 + i, bytes([i]) * 32) for i in range(3)]
+    sets.append(make_set(4242, b"\x42" * 32, corrupt=True))
+    assert jax_backend.verify_signature_sets(sets) is False
+
+
+def test_multi_pubkey_aggregation(jax_backend):
+    sks = [SecretKey(500 + i) for i in range(4)]
+    msg = b"\x11" * 32
+    agg = AggregateSignature.aggregate([s.sign(msg) for s in sks])
+    s = SignatureSet(agg.signature, [s.public_key() for s in sks], msg)
+    assert jax_backend.verify_signature_sets([s]) is True
+
+
+def test_edge_semantics(jax_backend):
+    from lighthouse_tpu.crypto.bls.api import Signature
+
+    assert jax_backend.verify_signature_sets([]) is False
+    good = make_set(7, b"\x01" * 32)
+    inf = SignatureSet(Signature.infinity(), good.signing_keys, good.message)
+    assert jax_backend.verify_signature_sets([good, inf]) is False
+    empty_keys = SignatureSet(good.signature, [], good.message)
+    assert jax_backend.verify_signature_sets([good, empty_keys]) is False
+
+
+def test_differential_random(jax_backend):
+    oracle = PythonBackend()
+    trial_sets = []
+    for i in range(4):
+        corrupt = rng.random() < 0.4
+        trial_sets.append(
+            make_set(rng.randrange(2, 10**9), rng.randbytes(32), corrupt)
+        )
+    assert jax_backend.verify_signature_sets(
+        trial_sets
+    ) == oracle.verify_signature_sets(trial_sets)
+
+
+def test_non_subgroup_signature_rejected(jax_backend):
+    """A signature point on the curve but outside G2 must fail the device
+    subgroup check (blst.rs:71-81 semantics)."""
+    from lighthouse_tpu.crypto.bls import params
+    from lighthouse_tpu.crypto.bls.api import Signature
+    from lighthouse_tpu.crypto.bls.curve import B2, Fp2
+
+    while True:
+        x = Fp2(rng.randrange(params.P), rng.randrange(params.P))
+        y = (x.square() * x + B2).sqrt()
+        if y is not None:
+            break
+    bad_sig = Signature((x, y), subgroup_checked=False)
+    good = make_set(9, b"\x02" * 32)
+    s = SignatureSet(bad_sig, good.signing_keys, good.message)
+    assert jax_backend.verify_signature_sets([good, s]) is False
