@@ -69,10 +69,17 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
         ok_pair = PR.final_exp_is_one(total)
         return jnp.reshape(ok_pair & ok_all, ())
 
+    # check_vma=False: the field core's lax.scan carries initialize from
+    # replicated constants (e.g. the Montgomery accumulator in fp.mont_mul);
+    # under the varying-manual-axes type system every such carry would need a
+    # pcast at its init.  The kernel is used both inside and outside
+    # shard_map, so opt out of vma tracking here instead of threading mesh
+    # metadata through the whole limb library.
     sharded = shard_map(
         local_part,
         mesh=mesh,
         in_specs=(batch_spec, batch_spec, batch_spec, batch_spec),
         out_specs=PS(),
+        check_vma=False,
     )
     return jax.jit(sharded)
