@@ -27,15 +27,34 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+def _emit_error(exc: BaseException) -> None:
+    """Never die with a raw traceback: the driver records the JSON line."""
+    import traceback
+
+    traceback.print_exc(file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "tpu_batch_verify",
+                "value": 0.0,
+                "unit": "sets/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(exc).__name__}: {exc}"[:500],
+            }
+        )
+    )
+
+
 def main() -> None:
     B = int(os.environ.get("BENCH_BATCH", "512"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
 
     import jax
 
-    from __graft_entry__ import _example_batch
+    from __graft_entry__ import _enable_compile_cache, _example_batch
     from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
 
+    _enable_compile_cache(jax)
     dev = jax.devices()[0]
     print(f"device: {dev}", file=sys.stderr)
 
@@ -84,4 +103,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as exc:  # noqa: BLE001 — always emit the JSON line
+        _emit_error(exc)
+        sys.exit(0)

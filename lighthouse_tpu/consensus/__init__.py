@@ -1,0 +1,7 @@
+"""Consensus layer: SSZ, typed containers, presets, state transition.
+
+Capability twin of the reference's `consensus/` workspace directory
+(consensus/types, consensus/state_processing, consensus/fork_choice, ...).
+"""
+
+from . import containers, spec, ssz  # noqa: F401

@@ -1,0 +1,624 @@
+"""Consensus containers — the `consensus/types` twin.
+
+Fresh SSZ container definitions from the consensus specs, shaped like the
+reference's type layer (consensus/types/src/: beacon_block.rs:8-55 fork
+variants, beacon_state.rs, attestation.rs, validator.rs, ...) but organized
+Python/TPU-first:
+
+* Fork-versioning: the reference uses the `superstruct` macro to generate
+  Base/Altair/Bellatrix/Capella/Deneb variants of a container; here each
+  variant is a plain class and ``<NAME>_BY_FORK`` dicts map fork name ->
+  class (the match statement analog of superstruct's enum dispatch).
+* Preset-parametric shapes (sync committee size, state list limits) live in
+  a per-`Preset` family built once by :func:`types_for` and cached — the
+  Python analog of monomorphizing `BeaconState<MainnetEthSpec>`.
+
+Scalar fields use plain ints (Slot/Epoch newtype safety is provided by the
+SSZ descriptors at the boundary, not wrapper classes — wrappers would break
+numpy/JAX interop for the dense state-transition arrays).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from .spec import Preset
+from .ssz import (
+    BOOLEAN,
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    F,
+    SSZList,
+    U8,
+    U64,
+    U256,
+    Vector,
+)
+
+Root = ByteVector(32)
+Bytes32 = ByteVector(32)
+Bytes20 = ByteVector(20)
+Bytes4 = ByteVector(4)
+Bytes48 = ByteVector(48)
+Bytes96 = ByteVector(96)
+BLSPubkey = Bytes48
+BLSSignature = Bytes96
+KZGCommitment = Bytes48
+KZGProof = Bytes48
+
+# Limits that are preset-invariant across mainnet/minimal (eth_spec.rs keeps
+# these equal in both presets).
+MAX_VALIDATORS_PER_COMMITTEE = 2048
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+class Fork(Container):
+    fields = {
+        "previous_version": Bytes4,
+        "current_version": Bytes4,
+        "epoch": U64,
+    }
+
+
+class ForkData(Container):
+    fields = {
+        "current_version": Bytes4,
+        "genesis_validators_root": Root,
+    }
+
+
+class SigningData(Container):
+    fields = {
+        "object_root": Root,
+        "domain": Bytes32,
+    }
+
+
+class Checkpoint(Container):
+    fields = {
+        "epoch": U64,
+        "root": Root,
+    }
+
+
+class Validator(Container):
+    fields = {
+        "pubkey": BLSPubkey,
+        "withdrawal_credentials": Bytes32,
+        "effective_balance": U64,
+        "slashed": BOOLEAN,
+        "activation_eligibility_epoch": U64,
+        "activation_epoch": U64,
+        "exit_epoch": U64,
+        "withdrawable_epoch": U64,
+    }
+
+
+class AttestationData(Container):
+    fields = {
+        "slot": U64,
+        "index": U64,
+        "beacon_block_root": Root,
+        "source": F(Checkpoint),
+        "target": F(Checkpoint),
+    }
+
+
+class IndexedAttestation(Container):
+    fields = {
+        "attesting_indices": SSZList(U64, MAX_VALIDATORS_PER_COMMITTEE),
+        "data": F(AttestationData),
+        "signature": BLSSignature,
+    }
+
+
+class PendingAttestation(Container):
+    fields = {
+        "aggregation_bits": Bitlist(MAX_VALIDATORS_PER_COMMITTEE),
+        "data": F(AttestationData),
+        "inclusion_delay": U64,
+        "proposer_index": U64,
+    }
+
+
+class Attestation(Container):
+    fields = {
+        "aggregation_bits": Bitlist(MAX_VALIDATORS_PER_COMMITTEE),
+        "data": F(AttestationData),
+        "signature": BLSSignature,
+    }
+
+
+class AggregateAndProof(Container):
+    fields = {
+        "aggregator_index": U64,
+        "aggregate": F(Attestation),
+        "selection_proof": BLSSignature,
+    }
+
+
+class SignedAggregateAndProof(Container):
+    fields = {
+        "message": F(AggregateAndProof),
+        "signature": BLSSignature,
+    }
+
+
+class Eth1Data(Container):
+    fields = {
+        "deposit_root": Root,
+        "deposit_count": U64,
+        "block_hash": Bytes32,
+    }
+
+
+class DepositMessage(Container):
+    fields = {
+        "pubkey": BLSPubkey,
+        "withdrawal_credentials": Bytes32,
+        "amount": U64,
+    }
+
+
+class DepositData(Container):
+    fields = {
+        "pubkey": BLSPubkey,
+        "withdrawal_credentials": Bytes32,
+        "amount": U64,
+        "signature": BLSSignature,
+    }
+
+
+class Deposit(Container):
+    fields = {
+        "proof": Vector(Bytes32, DEPOSIT_CONTRACT_TREE_DEPTH + 1),
+        "data": F(DepositData),
+    }
+
+
+class BeaconBlockHeader(Container):
+    fields = {
+        "slot": U64,
+        "proposer_index": U64,
+        "parent_root": Root,
+        "state_root": Root,
+        "body_root": Root,
+    }
+
+
+class SignedBeaconBlockHeader(Container):
+    fields = {
+        "message": F(BeaconBlockHeader),
+        "signature": BLSSignature,
+    }
+
+
+class ProposerSlashing(Container):
+    fields = {
+        "signed_header_1": F(SignedBeaconBlockHeader),
+        "signed_header_2": F(SignedBeaconBlockHeader),
+    }
+
+
+class AttesterSlashing(Container):
+    fields = {
+        "attestation_1": F(IndexedAttestation),
+        "attestation_2": F(IndexedAttestation),
+    }
+
+
+class VoluntaryExit(Container):
+    fields = {
+        "epoch": U64,
+        "validator_index": U64,
+    }
+
+
+class SignedVoluntaryExit(Container):
+    fields = {
+        "message": F(VoluntaryExit),
+        "signature": BLSSignature,
+    }
+
+
+class BLSToExecutionChange(Container):
+    fields = {
+        "validator_index": U64,
+        "from_bls_pubkey": BLSPubkey,
+        "to_execution_address": Bytes20,
+    }
+
+
+class SignedBLSToExecutionChange(Container):
+    fields = {
+        "message": F(BLSToExecutionChange),
+        "signature": BLSSignature,
+    }
+
+
+class Withdrawal(Container):
+    fields = {
+        "index": U64,
+        "validator_index": U64,
+        "address": Bytes20,
+        "amount": U64,
+    }
+
+
+class DepositRequest(Container):
+    fields = {
+        "pubkey": BLSPubkey,
+        "withdrawal_credentials": Bytes32,
+        "amount": U64,
+        "signature": BLSSignature,
+        "index": U64,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Preset-parametric family
+# ---------------------------------------------------------------------------
+
+FORKS = ("base", "altair", "bellatrix", "capella", "deneb")
+
+
+class TypesFamily:
+    """All preset-shaped containers for one `Preset`, built once.
+
+    Access fork-versioned containers via the ``*_BY_FORK`` dicts, e.g.
+    ``types_for(MAINNET).BeaconBlockBody_BY_FORK["capella"]``; bare names
+    (``.BeaconBlock``) are the base-fork variants for phase0-only callers.
+    """
+
+    def __init__(self, preset: Preset):
+        self.preset = preset
+        P = preset
+
+        class SyncCommittee(Container):
+            fields = {
+                "pubkeys": Vector(BLSPubkey, P.sync_committee_size),
+                "aggregate_pubkey": BLSPubkey,
+            }
+
+        class SyncAggregate(Container):
+            fields = {
+                "sync_committee_bits": Bitvector(P.sync_committee_size),
+                "sync_committee_signature": BLSSignature,
+            }
+
+        class SyncCommitteeMessage(Container):
+            fields = {
+                "slot": U64,
+                "beacon_block_root": Root,
+                "validator_index": U64,
+                "signature": BLSSignature,
+            }
+
+        class SyncCommitteeContribution(Container):
+            fields = {
+                "slot": U64,
+                "beacon_block_root": Root,
+                "subcommittee_index": U64,
+                "aggregation_bits": Bitvector(
+                    max(P.sync_committee_size // 4, 1)
+                ),
+                "signature": BLSSignature,
+            }
+
+        class ContributionAndProof(Container):
+            fields = {
+                "aggregator_index": U64,
+                "contribution": F(SyncCommitteeContribution),
+                "selection_proof": BLSSignature,
+            }
+
+        class SignedContributionAndProof(Container):
+            fields = {
+                "message": F(ContributionAndProof),
+                "signature": BLSSignature,
+            }
+
+        class HistoricalBatch(Container):
+            fields = {
+                "block_roots": Vector(Root, P.slots_per_historical_root),
+                "state_roots": Vector(Root, P.slots_per_historical_root),
+            }
+
+        class HistoricalSummary(Container):
+            fields = {
+                "block_summary_root": Root,
+                "state_summary_root": Root,
+            }
+
+        class ExecutionPayloadHeader(Container):
+            fields = {
+                "parent_hash": Bytes32,
+                "fee_recipient": Bytes20,
+                "state_root": Bytes32,
+                "receipts_root": Bytes32,
+                "logs_bloom": ByteVector(P.bytes_per_logs_bloom),
+                "prev_randao": Bytes32,
+                "block_number": U64,
+                "gas_limit": U64,
+                "gas_used": U64,
+                "timestamp": U64,
+                "extra_data": ByteList(P.max_extra_data_bytes),
+                "base_fee_per_gas": U256,
+                "block_hash": Bytes32,
+                "transactions_root": Root,
+            }
+
+        class ExecutionPayloadHeaderCapella(ExecutionPayloadHeader):
+            fields = {
+                **ExecutionPayloadHeader.fields,
+                "withdrawals_root": Root,
+            }
+
+        class ExecutionPayloadHeaderDeneb(ExecutionPayloadHeaderCapella):
+            fields = {
+                **ExecutionPayloadHeaderCapella.fields,
+                "blob_gas_used": U64,
+                "excess_blob_gas": U64,
+            }
+
+        _txs = SSZList(
+            ByteList(P.max_bytes_per_transaction), P.max_transactions_per_payload
+        )
+
+        class ExecutionPayload(Container):
+            fields = {
+                "parent_hash": Bytes32,
+                "fee_recipient": Bytes20,
+                "state_root": Bytes32,
+                "receipts_root": Bytes32,
+                "logs_bloom": ByteVector(P.bytes_per_logs_bloom),
+                "prev_randao": Bytes32,
+                "block_number": U64,
+                "gas_limit": U64,
+                "gas_used": U64,
+                "timestamp": U64,
+                "extra_data": ByteList(P.max_extra_data_bytes),
+                "base_fee_per_gas": U256,
+                "block_hash": Bytes32,
+                "transactions": _txs,
+            }
+
+        class ExecutionPayloadCapella(ExecutionPayload):
+            fields = {
+                **ExecutionPayload.fields,
+                "withdrawals": SSZList(F(Withdrawal), P.max_withdrawals_per_payload),
+            }
+
+        class ExecutionPayloadDeneb(ExecutionPayloadCapella):
+            fields = {
+                **ExecutionPayloadCapella.fields,
+                "blob_gas_used": U64,
+                "excess_blob_gas": U64,
+            }
+
+        # ---- block bodies, fork ladder (beacon_block_body.rs) -------------
+        _body_base_fields = {
+            "randao_reveal": BLSSignature,
+            "eth1_data": F(Eth1Data),
+            "graffiti": Bytes32,
+            "proposer_slashings": SSZList(
+                F(ProposerSlashing), P.max_proposer_slashings
+            ),
+            "attester_slashings": SSZList(
+                F(AttesterSlashing), P.max_attester_slashings
+            ),
+            "attestations": SSZList(F(Attestation), P.max_attestations),
+            "deposits": SSZList(F(Deposit), P.max_deposits),
+            "voluntary_exits": SSZList(
+                F(SignedVoluntaryExit), P.max_voluntary_exits
+            ),
+        }
+
+        class BeaconBlockBody(Container):
+            fields = dict(_body_base_fields)
+
+        class BeaconBlockBodyAltair(Container):
+            fields = {
+                **_body_base_fields,
+                "sync_aggregate": F(SyncAggregate),
+            }
+
+        class BeaconBlockBodyBellatrix(Container):
+            fields = {
+                **BeaconBlockBodyAltair.fields,
+                "execution_payload": F(ExecutionPayload),
+            }
+
+        class BeaconBlockBodyCapella(Container):
+            fields = {
+                **BeaconBlockBodyAltair.fields,
+                "execution_payload": F(ExecutionPayloadCapella),
+                "bls_to_execution_changes": SSZList(
+                    F(SignedBLSToExecutionChange), P.max_bls_to_execution_changes
+                ),
+            }
+
+        class BeaconBlockBodyDeneb(Container):
+            fields = {
+                **BeaconBlockBodyAltair.fields,
+                "execution_payload": F(ExecutionPayloadDeneb),
+                "bls_to_execution_changes": SSZList(
+                    F(SignedBLSToExecutionChange), P.max_bls_to_execution_changes
+                ),
+                "blob_kzg_commitments": SSZList(
+                    KZGCommitment, P.max_blob_commitments_per_block
+                ),
+            }
+
+        self.BeaconBlockBody_BY_FORK = {
+            "base": BeaconBlockBody,
+            "altair": BeaconBlockBodyAltair,
+            "bellatrix": BeaconBlockBodyBellatrix,
+            "capella": BeaconBlockBodyCapella,
+            "deneb": BeaconBlockBodyDeneb,
+        }
+
+        def _block_cls(body_cls, suffix):
+            class BeaconBlock(Container):
+                fields = {
+                    "slot": U64,
+                    "proposer_index": U64,
+                    "parent_root": Root,
+                    "state_root": Root,
+                    "body": F(body_cls),
+                }
+
+            class SignedBeaconBlock(Container):
+                fields = {
+                    "message": F(BeaconBlock),
+                    "signature": BLSSignature,
+                }
+
+            BeaconBlock.__name__ = f"BeaconBlock{suffix}"
+            SignedBeaconBlock.__name__ = f"SignedBeaconBlock{suffix}"
+            return BeaconBlock, SignedBeaconBlock
+
+        self.BeaconBlock_BY_FORK = {}
+        self.SignedBeaconBlock_BY_FORK = {}
+        for fork, body_cls in self.BeaconBlockBody_BY_FORK.items():
+            blk, sblk = _block_cls(body_cls, fork.capitalize())
+            self.BeaconBlock_BY_FORK[fork] = blk
+            self.SignedBeaconBlock_BY_FORK[fork] = sblk
+
+        # ---- states, fork ladder (beacon_state.rs) ------------------------
+        _state_base_fields = {
+            "genesis_time": U64,
+            "genesis_validators_root": Root,
+            "slot": U64,
+            "fork": F(Fork),
+            "latest_block_header": F(BeaconBlockHeader),
+            "block_roots": Vector(Root, P.slots_per_historical_root),
+            "state_roots": Vector(Root, P.slots_per_historical_root),
+            "historical_roots": SSZList(Root, P.historical_roots_limit),
+            "eth1_data": F(Eth1Data),
+            "eth1_data_votes": SSZList(
+                F(Eth1Data),
+                P.epochs_per_eth1_voting_period * P.slots_per_epoch,
+            ),
+            "eth1_deposit_index": U64,
+            "validators": SSZList(F(Validator), P.validator_registry_limit),
+            "balances": SSZList(U64, P.validator_registry_limit),
+            "randao_mixes": Vector(Bytes32, P.epochs_per_historical_vector),
+            "slashings": Vector(U64, P.epochs_per_slashings_vector),
+        }
+        _state_tail_fields = {
+            "justification_bits": Bitvector(4),
+            "previous_justified_checkpoint": F(Checkpoint),
+            "current_justified_checkpoint": F(Checkpoint),
+            "finalized_checkpoint": F(Checkpoint),
+        }
+
+        class BeaconState(Container):
+            fields = {
+                **_state_base_fields,
+                "previous_epoch_attestations": SSZList(
+                    F(PendingAttestation), P.pending_attestations_limit
+                ),
+                "current_epoch_attestations": SSZList(
+                    F(PendingAttestation), P.pending_attestations_limit
+                ),
+                **_state_tail_fields,
+            }
+
+        _altair_participation = {
+            "previous_epoch_participation": SSZList(
+                U8, P.validator_registry_limit
+            ),
+            "current_epoch_participation": SSZList(U8, P.validator_registry_limit),
+        }
+        _altair_tail = {
+            "inactivity_scores": SSZList(U64, P.validator_registry_limit),
+            "current_sync_committee": F(SyncCommittee),
+            "next_sync_committee": F(SyncCommittee),
+        }
+
+        class BeaconStateAltair(Container):
+            fields = {
+                **_state_base_fields,
+                **_altair_participation,
+                **_state_tail_fields,
+                **_altair_tail,
+            }
+
+        class BeaconStateBellatrix(Container):
+            fields = {
+                **BeaconStateAltair.fields,
+                "latest_execution_payload_header": F(ExecutionPayloadHeader),
+            }
+
+        class BeaconStateCapella(Container):
+            fields = {
+                **BeaconStateAltair.fields,
+                "latest_execution_payload_header": F(ExecutionPayloadHeaderCapella),
+                "next_withdrawal_index": U64,
+                "next_withdrawal_validator_index": U64,
+                "historical_summaries": SSZList(
+                    F(HistoricalSummary), P.historical_roots_limit
+                ),
+            }
+
+        class BeaconStateDeneb(Container):
+            fields = {
+                **BeaconStateAltair.fields,
+                "latest_execution_payload_header": F(ExecutionPayloadHeaderDeneb),
+                "next_withdrawal_index": U64,
+                "next_withdrawal_validator_index": U64,
+                "historical_summaries": SSZList(
+                    F(HistoricalSummary), P.historical_roots_limit
+                ),
+            }
+
+        self.BeaconState_BY_FORK = {
+            "base": BeaconState,
+            "altair": BeaconStateAltair,
+            "bellatrix": BeaconStateBellatrix,
+            "capella": BeaconStateCapella,
+            "deneb": BeaconStateDeneb,
+        }
+
+        class BlobSidecar(Container):
+            fields = {
+                "index": U64,
+                "blob": ByteVector(32 * P.field_elements_per_blob),
+                "kzg_commitment": KZGCommitment,
+                "kzg_proof": KZGProof,
+                "signed_block_header": F(SignedBeaconBlockHeader),
+                "kzg_commitment_inclusion_proof": Vector(
+                    Bytes32, P.kzg_commitment_inclusion_proof_depth
+                ),
+            }
+
+        # bare names = base-fork variants + altair extras
+        self.SyncCommittee = SyncCommittee
+        self.SyncAggregate = SyncAggregate
+        self.SyncCommitteeMessage = SyncCommitteeMessage
+        self.SyncCommitteeContribution = SyncCommitteeContribution
+        self.ContributionAndProof = ContributionAndProof
+        self.SignedContributionAndProof = SignedContributionAndProof
+        self.HistoricalBatch = HistoricalBatch
+        self.HistoricalSummary = HistoricalSummary
+        self.ExecutionPayload = ExecutionPayload
+        self.ExecutionPayloadCapella = ExecutionPayloadCapella
+        self.ExecutionPayloadDeneb = ExecutionPayloadDeneb
+        self.ExecutionPayloadHeader = ExecutionPayloadHeader
+        self.ExecutionPayloadHeaderCapella = ExecutionPayloadHeaderCapella
+        self.ExecutionPayloadHeaderDeneb = ExecutionPayloadHeaderDeneb
+        self.BeaconBlockBody = BeaconBlockBody
+        self.BeaconBlock = self.BeaconBlock_BY_FORK["base"]
+        self.SignedBeaconBlock = self.SignedBeaconBlock_BY_FORK["base"]
+        self.BeaconState = BeaconState
+        self.BlobSidecar = BlobSidecar
+
+
+@lru_cache(maxsize=8)
+def types_for(preset: Preset) -> TypesFamily:
+    """The cached per-preset container family (EthSpec monomorphization)."""
+    return TypesFamily(preset)

@@ -1,0 +1,7 @@
+"""State transition — twin of consensus/state_processing.
+
+Pure functions over `BeaconState` plus the signature-set plumbing that feeds
+the device BLS backend.
+"""
+
+from . import signature_sets  # noqa: F401

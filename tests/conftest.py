@@ -11,6 +11,14 @@ import sys
 _FLAG = "--xla_force_host_platform_device_count=8"
 if _FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the image environment pins JAX_PLATFORMS=axon (the
+# TPU relay) and a sitecustomize imports jax + registers the axon PJRT plugin
+# at interpreter start — so the env var alone is captured too early to help.
+# jax.config.update before any backend init is the only reliable override.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
