@@ -45,17 +45,53 @@ def _emit_error(exc: BaseException) -> None:
     )
 
 
+def _arm_watchdog(seconds: float, stage: str):
+    """The axon TPU relay can WEDGE (jax.devices() never returns — this
+    masked every round-2 artifact as rc=124).  A watchdog thread turns a
+    hang into the error JSON line + clean exit.  Returns a disarm()."""
+    import threading
+
+    def fire():
+        print(f"bench watchdog: {stage} exceeded {seconds}s", file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "tpu_batch_verify",
+                    "value": 0.0,
+                    "unit": "sets/s",
+                    "vs_baseline": 0.0,
+                    "error": f"watchdog: {stage} exceeded {seconds}s (TPU relay hung?)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(0)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t.cancel
+
+
 def main() -> None:
     B = int(os.environ.get("BENCH_BATCH", "512"))
     iters = int(os.environ.get("BENCH_ITERS", "3"))
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
+    compile_timeout = float(os.environ.get("BENCH_COMPILE_TIMEOUT", "3000"))
 
     import jax
 
-    from __graft_entry__ import _enable_compile_cache, _example_batch
-    from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
+    from __graft_entry__ import _enable_compile_cache
 
     _enable_compile_cache(jax)
+    # Arm BEFORE the backend modules import: their jnp constants trigger
+    # backend init, which is where a wedged relay hangs.
+    disarm = _arm_watchdog(init_timeout, "device init")
+    from __graft_entry__ import _example_batch
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
+
     dev = jax.devices()[0]
+    disarm()
     print(f"device: {dev}", file=sys.stderr)
 
     t0 = time.time()
@@ -71,8 +107,10 @@ def main() -> None:
     fn = jax.jit(_verify_kernel)
 
     t0 = time.time()
+    disarm = _arm_watchdog(compile_timeout, f"compile B={B}")
     ok = fn(*args)
     ok.block_until_ready()
+    disarm()
     t_compile = time.time() - t0
     print(f"compile+first run: {t_compile:.1f}s, result={bool(ok)}", file=sys.stderr)
     assert bool(ok) is True, "benchmark batch must verify"

@@ -1,0 +1,107 @@
+"""Deterministic test fixtures: interop keypairs + synthetic states/blocks.
+
+Twin of the reference's interop genesis + harness seeding
+(beacon_node/genesis/src/interop.rs, beacon_chain/src/test_utils.rs:324
+`generate_deterministic_keypairs`): validator i's secret key is the standard
+interop derivation sha256(uint64_le(i) padded to 32) reduced mod the curve
+order, so fixtures here are reproducible and match other interop tooling.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ..crypto.bls import api as bls
+from ..crypto.bls.params import R as CURVE_ORDER
+from ..ops import sha256
+from .containers import BeaconBlockHeader, Checkpoint, Fork, Validator, types_for
+from .spec import ChainSpec, Preset
+
+FAR_FUTURE_EPOCH = 2**64 - 1
+
+
+@lru_cache(maxsize=4096)
+def interop_secret_key(index: int) -> bls.SecretKey:
+    sk = (
+        int.from_bytes(sha256(index.to_bytes(32, "little")), "little")
+        % CURVE_ORDER
+    )
+    return bls.SecretKey(sk)
+
+
+def interop_keypairs(n: int) -> list[tuple[bls.SecretKey, bls.PublicKey]]:
+    out = []
+    for i in range(n):
+        sk = interop_secret_key(i)
+        out.append((sk, sk.public_key()))
+    return out
+
+
+def phase0_spec(preset: Preset) -> ChainSpec:
+    """A forks-off ChainSpec: everything stays at the genesis fork version
+    (the shape most unit fixtures want; fork-transition tests override)."""
+    return ChainSpec(
+        preset=preset,
+        config_name=f"{preset.name}-phase0-test",
+        altair_fork_epoch=None,
+        bellatrix_fork_epoch=None,
+        capella_fork_epoch=None,
+        deneb_fork_epoch=None,
+    )
+
+
+def interop_state(n_validators: int, spec: ChainSpec, balance: int | None = None):
+    """Genesis-like base-fork BeaconState with n interop validators, plus
+    the keypairs.  genesis_validators_root is computed per spec (the root of
+    the validator registry)."""
+    preset = spec.preset
+    T = types_for(preset)
+    balance = balance if balance is not None else spec.max_effective_balance
+    keypairs = interop_keypairs(n_validators)
+    validators = [
+        Validator(
+            pubkey=pk.to_bytes(),
+            withdrawal_credentials=b"\x00" * 32,
+            effective_balance=spec.max_effective_balance,
+            slashed=False,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for _, pk in keypairs
+    ]
+    state = T.BeaconState(
+        genesis_time=spec.min_genesis_time,
+        slot=0,
+        fork=Fork(
+            previous_version=spec.genesis_fork_version,
+            current_version=spec.genesis_fork_version,
+            epoch=0,
+        ),
+        latest_block_header=BeaconBlockHeader(),
+        validators=validators,
+        balances=[balance] * n_validators,
+        randao_mixes=[bytes(32)] * preset.epochs_per_historical_vector,
+        finalized_checkpoint=Checkpoint(),
+    )
+    gvr = T.BeaconState._fields["validators"].hash_tree_root(validators)
+    state.genesis_validators_root = gvr
+    return state, keypairs
+
+
+def pubkey_getter(state):
+    """A decompression cache over the state's validators — the
+    ValidatorPubkeyCache analog (validator_pubkey_cache.rs:9-16)."""
+    cache: dict[int, bls.PublicKey] = {}
+
+    def get(index: int):
+        if index in cache:
+            return cache[index]
+        if index >= len(state.validators):
+            return None
+        pk = bls.PublicKey.from_bytes(bytes(state.validators[index].pubkey))
+        cache[index] = pk
+        return pk
+
+    return get

@@ -1,0 +1,35 @@
+"""Mesh-sharded verification on the virtual 8-device CPU mesh.
+
+The driver's MULTICHIP check runs __graft_entry__.dryrun_multichip; this test
+keeps the same path green in CI (VERDICT r2: shard_map had a scan-carry vma
+crash that no test caught because nothing exercised the 8-device mesh the
+conftest provisions).  Compile is minutes cold but served from the repo's
+persistent .jax_cache afterwards.
+"""
+
+import numpy as np
+import pytest
+
+import __graft_entry__ as graft
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8():
+    graft._enable_compile_cache(__import__("jax"))
+    graft.dryrun_multichip(8)  # asserts valid batch -> True, poisoned -> False
+
+
+@pytest.mark.slow
+def test_sharded_matches_single_chip():
+    import jax
+    from jax.sharding import Mesh
+
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
+    from lighthouse_tpu.crypto.bls.jax_backend.multichip import make_verify_sharded
+
+    graft._enable_compile_cache(jax)
+    args = graft._example_batch(8)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+    sharded = make_verify_sharded(mesh)
+    single = jax.jit(_verify_kernel)
+    assert bool(sharded(*args)) == bool(single(*args)) is True
