@@ -50,10 +50,15 @@ def phase0_spec(preset: Preset) -> ChainSpec:
     )
 
 
-def interop_state(n_validators: int, spec: ChainSpec, balance: int | None = None):
-    """Genesis-like base-fork BeaconState with n interop validators, plus
-    the keypairs.  genesis_validators_root is computed per spec (the root of
-    the validator registry)."""
+def interop_state(
+    n_validators: int,
+    spec: ChainSpec,
+    balance: int | None = None,
+    fork: str = "base",
+):
+    """Genesis-like BeaconState (chosen fork variant) with n interop
+    validators, plus the keypairs.  genesis_validators_root is computed per
+    spec (the root of the validator registry)."""
     preset = spec.preset
     T = types_for(preset)
     balance = balance if balance is not None else spec.max_effective_balance
@@ -71,7 +76,8 @@ def interop_state(n_validators: int, spec: ChainSpec, balance: int | None = None
         )
         for _, pk in keypairs
     ]
-    state = T.BeaconState(
+    state_cls = T.BeaconState_BY_FORK[fork]
+    state = state_cls(
         genesis_time=spec.min_genesis_time,
         slot=0,
         fork=Fork(
@@ -85,8 +91,18 @@ def interop_state(n_validators: int, spec: ChainSpec, balance: int | None = None
         randao_mixes=[bytes(32)] * preset.epochs_per_historical_vector,
         finalized_checkpoint=Checkpoint(),
     )
-    gvr = T.BeaconState._fields["validators"].hash_tree_root(validators)
+    gvr = state_cls._fields["validators"].hash_tree_root(validators)
     state.genesis_validators_root = gvr
+    if fork != "base":
+        state.previous_epoch_participation = [0] * n_validators
+        state.current_epoch_participation = [0] * n_validators
+        state.inactivity_scores = [0] * n_validators
+        from .state_processing.per_epoch import compute_sync_committee
+
+        state.current_sync_committee = compute_sync_committee(state, 0, spec)
+        state.next_sync_committee = compute_sync_committee(
+            state, preset.epochs_per_sync_committee_period, spec
+        )
     return state, keypairs
 
 

@@ -1,0 +1,148 @@
+"""Proto-array + fork choice: GHOST behavior, reorgs, pruning, boost,
+invalidation.  Scenario shapes follow the reference's proto_array unit tests
+(proto_array.rs tests + fork_choice tests): chains, forks, vote moves."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.consensus.fork_choice import ForkChoice, ProtoArray
+from lighthouse_tpu.consensus.fork_choice.proto_array import (
+    EXEC_OPTIMISTIC,
+    Block,
+)
+from lighthouse_tpu.consensus.spec import ChainSpec, MINIMAL
+from lighthouse_tpu.consensus.testing import phase0_spec
+
+
+def blk(root: bytes, parent: bytes | None, slot: int, je=0, fe=0) -> Block:
+    return Block(
+        slot=slot,
+        root=root,
+        parent_root=parent,
+        state_root=b"\x00" * 32,
+        justified_epoch=je,
+        finalized_epoch=fe,
+    )
+
+
+def r(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+@pytest.fixture
+def fc() -> ForkChoice:
+    return ForkChoice(phase0_spec(MINIMAL), blk(r(0), None, 0))
+
+
+def test_linear_chain_head(fc):
+    fc.on_block(blk(r(1), r(0), 1))
+    fc.on_block(blk(r(2), r(1), 2))
+    head = fc.get_head(np.array([32, 32], dtype=np.int64))
+    assert head == r(2)
+
+
+def test_fork_resolved_by_votes(fc):
+    fc.on_block(blk(r(1), r(0), 1))
+    fc.on_block(blk(r(2), r(0), 1))  # competing sibling
+    fc.process_attestation(0, r(1), 0)
+    fc.process_attestation(1, r(2), 0)
+    fc.process_attestation(2, r(2), 0)
+    head = fc.get_head(np.array([32, 32, 32], dtype=np.int64))
+    assert head == r(2)
+    # votes move: validators 1,2 switch to r(1)'s branch
+    fc.process_attestation(1, r(1), 0)
+    fc.process_attestation(2, r(1), 0)
+    head = fc.get_head(np.array([32, 32, 32], dtype=np.int64))
+    assert head == r(1)
+
+
+def test_heavier_subtree_beats_longer_chain(fc):
+    fc.on_block(blk(r(1), r(0), 1))
+    fc.on_block(blk(r(2), r(1), 2))
+    fc.on_block(blk(r(3), r(2), 3))  # long chain, no votes
+    fc.on_block(blk(r(4), r(0), 1))  # short heavy branch
+    for v in range(3):
+        fc.process_attestation(v, r(4), 0)
+    head = fc.get_head(np.array([32, 32, 32], dtype=np.int64))
+    assert head == r(4)
+
+
+def test_tie_break_is_deterministic(fc):
+    fc.on_block(blk(r(1), r(0), 1))
+    fc.on_block(blk(r(2), r(0), 1))
+    h1 = fc.get_head(np.array([32], dtype=np.int64))
+    h2 = fc.get_head(np.array([32], dtype=np.int64))
+    assert h1 == h2 == r(2)  # larger root bytes wins ties
+
+
+def test_proposer_boost_flips_head(fc):
+    fc.on_block(blk(r(1), r(0), 1))
+    fc.on_block(blk(r(2), r(0), 1))
+    # r(1) has one vote; r(2) arrives as a timely proposal with boost.
+    # 64 validators -> slot committee weight = 64*32e9/8 = 256e9; boost =
+    # 40% = 102.4e9 > the single 32e9 vote on r(1).
+    fc.process_attestation(0, r(1), 0)
+    bal = np.array([32_000_000_000] * 64, dtype=np.int64)
+    fc.on_block(blk(r(3), r(2), 2), is_timely_proposal=True)
+    head = fc.get_head(bal)
+    assert head == r(3)
+    fc.on_slot_boundary()
+    head = fc.get_head(bal)
+    assert head == r(1)  # boost expired, the real vote decides
+
+
+def test_future_attestation_queued(fc):
+    fc.on_block(blk(r(1), r(0), 1))
+    fc.on_block(blk(r(2), r(0), 1))
+    fc.process_attestation(0, r(1), target_epoch=3, current_slot=2)
+    # queued: does not count yet
+    head = fc.get_head(np.array([32], dtype=np.int64), current_slot=2)
+    assert head == r(2)
+    # after the epoch arrives, it counts
+    head = fc.get_head(
+        np.array([32], dtype=np.int64),
+        current_slot=3 * MINIMAL.slots_per_epoch,
+    )
+    assert head == r(1)
+
+
+def test_prune_reindexes(fc):
+    for i in range(1, 6):
+        fc.on_block(blk(r(i), r(i - 1), i))
+    fc.on_block(blk(r(9), r(0), 1))  # stale sibling, will be pruned
+    fc.finalized_checkpoint = (0, r(3))
+    fc.proto.prune(r(3))
+    assert not fc.contains_block(r(9))
+    assert not fc.contains_block(r(2))
+    assert fc.contains_block(r(3)) and fc.contains_block(r(5))
+    fc.justified_checkpoint = (0, r(3))
+    head = fc.get_head(np.array([32], dtype=np.int64))
+    assert head == r(5)
+
+
+def test_execution_invalidation_excludes_subtree(fc):
+    fc.on_block(blk(r(1), r(0), 1))
+    b2 = blk(r(2), r(1), 2)
+    b2.execution_status = EXEC_OPTIMISTIC
+    fc.on_block(b2)
+    fc.on_block(blk(r(3), r(0), 1))
+    fc.proto.propagate_execution_invalidation(r(2))
+    head = fc.get_head(np.array([32], dtype=np.int64))
+    assert head == r(3)
+
+
+def test_unknown_parent_rejected(fc):
+    with pytest.raises(Exception):
+        fc.on_block(blk(r(5), r(77), 3))
+
+
+def test_unviable_justified_mismatch():
+    """Nodes carrying a stale justified epoch can't be head once the store
+    advances (proto_array.rs node_is_viable_for_head)."""
+    spec = phase0_spec(MINIMAL)
+    fc = ForkChoice(spec, blk(r(0), None, 0))
+    fc.on_block(blk(r(1), r(0), 1, je=0))
+    fc.on_block(blk(r(2), r(1), 2, je=1))  # justifies epoch 1
+    fc.justified_checkpoint = (1, r(0))
+    head = fc.get_head(np.array([32], dtype=np.int64))
+    assert head == r(2)

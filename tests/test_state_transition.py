@@ -1,0 +1,257 @@
+"""State transition: slots, epochs, blocks, operations (altair line).
+
+Scenario coverage mirrors the reference's state_processing tests + EF sanity
+shapes: empty-slot advance across epoch boundaries, full-participation
+justification, attestation rewards, deposits (with real Merkle proofs from
+the incremental tree), exits, slashings, and validity-error paths.
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import committees as cm
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.containers import (
+    Attestation,
+    AttestationData,
+    Checkpoint,
+    Deposit,
+    DepositData,
+    DepositMessage,
+    SignedVoluntaryExit,
+    VoluntaryExit,
+    types_for,
+)
+from lighthouse_tpu.consensus.merkle import DepositTree, verify_merkle_proof
+from lighthouse_tpu.consensus.state_processing import signature_sets as sets
+from lighthouse_tpu.consensus.state_processing.per_block import (
+    BlockProcessingError,
+    apply_deposit,
+    process_attestation,
+    process_deposit,
+    process_voluntary_exit,
+    slash_validator,
+)
+from lighthouse_tpu.consensus.state_processing.per_epoch import process_epoch
+from lighthouse_tpu.consensus.state_processing.per_slot import (
+    process_slots,
+)
+from lighthouse_tpu.consensus.testing import (
+    FAR_FUTURE_EPOCH,
+    interop_keypairs,
+    interop_state,
+    phase0_spec,
+    pubkey_getter,
+)
+
+N = 16
+
+
+@pytest.fixture()
+def altair():
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(N, spec, fork="altair")
+    return spec, state, keys
+
+
+def test_empty_slot_advance_over_epoch(altair):
+    spec, state, _ = altair
+    per_epoch = spec.preset.slots_per_epoch
+    process_slots(state, per_epoch + 1, spec)
+    assert state.slot == per_epoch + 1
+    # roots cached for every past slot
+    assert all(
+        bytes(state.block_roots[s]) != bytes(32) for s in range(per_epoch)
+    )
+    # participation rotated
+    assert list(state.current_epoch_participation) == [0] * N
+
+
+def _full_target_participation(state, epoch_field: str):
+    flags = 1 << 0 | 1 << 1 | 1 << 2  # source+target+head
+    setattr(state, epoch_field, [flags] * len(state.validators))
+
+
+def test_full_participation_justifies(altair):
+    spec, state, _ = altair
+    per_epoch = spec.preset.slots_per_epoch
+    # justification is skipped through GENESIS_EPOCH+1, so work in epoch 2
+    process_slots(state, 2 * per_epoch, spec)
+    _full_target_participation(state, "previous_epoch_participation")
+    _full_target_participation(state, "current_epoch_participation")
+    before = state.current_justified_checkpoint.epoch
+    process_slots(state, 3 * per_epoch, spec)
+    after = state.current_justified_checkpoint.epoch
+    assert after > before, "supermajority target participation must justify"
+
+
+def test_rewards_move_balances(altair):
+    spec, state, _ = altair
+    per_epoch = spec.preset.slots_per_epoch
+    process_slots(state, per_epoch, spec)
+    _full_target_participation(state, "previous_epoch_participation")
+    balances_before = list(state.balances)
+    process_slots(state, 2 * per_epoch, spec)
+    gained = [a - b for a, b in zip(state.balances, balances_before)]
+    assert all(g > 0 for g in gained), "participants must be rewarded"
+
+
+def test_nonparticipation_penalized(altair):
+    spec, state, _ = altair
+    per_epoch = spec.preset.slots_per_epoch
+    process_slots(state, per_epoch, spec)
+    # nobody participates in epoch 0 (previous): everyone eligible is penalized
+    balances_before = list(state.balances)
+    process_slots(state, 2 * per_epoch, spec)
+    assert all(
+        a < b for a, b in zip(state.balances, balances_before)
+    ), "absentees must be penalized"
+
+
+def test_attestation_flow_rewards_proposer(altair):
+    spec, state, keys = altair
+    preset = spec.preset
+    process_slots(state, 1, spec)
+    cache = cm.CommitteeCache(state, 0, preset)
+    committee = cache.committee(0, 0)
+    data = AttestationData(
+        slot=0,
+        index=0,
+        beacon_block_root=bytes(state.block_roots[0]),
+        source=Checkpoint(epoch=0, root=bytes(state.block_roots[0])),
+        target=Checkpoint(epoch=0, root=bytes(state.block_roots[0])),
+    )
+    # source must match current justified checkpoint (genesis: epoch 0 root 0)
+    data.source = state.current_justified_checkpoint
+    domain = sets.get_domain(
+        state.fork, state.genesis_validators_root, S.DOMAIN_BEACON_ATTESTER, 0
+    )
+    root = S.compute_signing_root(data, domain)
+    from lighthouse_tpu.crypto.bls import api as bls
+
+    sigs = [keys[int(v)][0].sign(root) for v in committee]
+    att = Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=bls.AggregateSignature.aggregate(sigs).to_bytes(),
+    )
+    proposer = cm.get_beacon_proposer_index(state, state.slot, preset)
+    before = state.balances[proposer]
+    process_attestation(
+        state, att, spec, cache, verify_signatures=True,
+        get_pubkey=pubkey_getter(state),
+    )
+    assert state.balances[proposer] > before
+    # target epoch == current epoch, so flags land in CURRENT participation
+    for v in committee:
+        assert state.current_epoch_participation[int(v)] != 0
+
+
+def test_deposit_tree_proof_roundtrip():
+    tree = DepositTree()
+    spec = phase0_spec(S.MINIMAL)
+    datas = []
+    for i in range(3):
+        sk = interop_keypairs(20 + i + 1)[20 + i][0]
+        dd = DepositData(
+            pubkey=sk.public_key().to_bytes(),
+            withdrawal_credentials=b"\x00" * 32,
+            amount=spec.max_effective_balance,
+        )
+        msg = DepositMessage(
+            pubkey=dd.pubkey,
+            withdrawal_credentials=dd.withdrawal_credentials,
+            amount=dd.amount,
+        )
+        domain = S.compute_domain(S.DOMAIN_DEPOSIT, spec.genesis_fork_version, bytes(32))
+        dd.signature = sk.sign(S.compute_signing_root(msg, domain)).to_bytes()
+        datas.append(dd)
+        tree.push(dd.root())
+    root = tree.root()
+    for i, dd in enumerate(datas):
+        proof = tree.proof(i)
+        assert verify_merkle_proof(dd.root(), proof, 33, i, root)
+
+
+def test_process_deposit_adds_validator(altair):
+    spec, state, _ = altair
+    tree = DepositTree()
+    sk = interop_keypairs(40)[39][0]
+    dd = DepositData(
+        pubkey=sk.public_key().to_bytes(),
+        withdrawal_credentials=b"\x11" * 32,
+        amount=spec.max_effective_balance,
+    )
+    msg = DepositMessage(
+        pubkey=dd.pubkey,
+        withdrawal_credentials=dd.withdrawal_credentials,
+        amount=dd.amount,
+    )
+    domain = S.compute_domain(S.DOMAIN_DEPOSIT, spec.genesis_fork_version, bytes(32))
+    dd.signature = sk.sign(S.compute_signing_root(msg, domain)).to_bytes()
+    tree.push(dd.root())
+    state.eth1_data.deposit_root = tree.root()
+    state.eth1_data.deposit_count = 1
+    dep = Deposit(proof=tree.proof(0), data=dd)
+    n_before = len(state.validators)
+    process_deposit(state, dep, spec)
+    assert len(state.validators) == n_before + 1
+    assert state.balances[-1] == spec.max_effective_balance
+    assert state.eth1_deposit_index == 1
+
+
+def test_bad_deposit_signature_skipped(altair):
+    spec, state, _ = altair
+    dd = DepositData(
+        pubkey=interop_keypairs(42)[41][0].public_key().to_bytes(),
+        withdrawal_credentials=b"\x11" * 32,
+        amount=spec.max_effective_balance,
+        signature=b"\x00" * 96,  # invalid
+    )
+    n_before = len(state.validators)
+    apply_deposit(state, dd, spec)
+    assert len(state.validators) == n_before  # skipped, not an error
+
+
+def test_exit_too_young_rejected(altair):
+    spec, state, keys = altair
+    ex = SignedVoluntaryExit(
+        message=VoluntaryExit(epoch=0, validator_index=3)
+    )
+    with pytest.raises(BlockProcessingError, match="too young"):
+        process_voluntary_exit(
+            state, ex, spec, verify_signatures=False, get_pubkey=pubkey_getter(state)
+        )
+
+
+def test_exit_happy_path(altair):
+    spec, state, keys = altair
+    # age the validators past the shard committee period
+    per_epoch = spec.preset.slots_per_epoch
+    import dataclasses
+
+    fast = dataclasses.replace(spec, shard_committee_period=0)
+    ex = SignedVoluntaryExit(message=VoluntaryExit(epoch=0, validator_index=3))
+    process_voluntary_exit(
+        state, ex, fast, verify_signatures=False, get_pubkey=pubkey_getter(state)
+    )
+    assert state.validators[3].exit_epoch != FAR_FUTURE_EPOCH
+
+
+def test_slash_validator(altair):
+    spec, state, _ = altair
+    eb = state.validators[5].effective_balance
+    bal_before = state.balances[5]
+    slash_validator(state, 5, spec)
+    v = state.validators[5]
+    assert v.slashed
+    assert v.exit_epoch != FAR_FUTURE_EPOCH
+    assert state.balances[5] < bal_before
+    assert sum(state.slashings) == eb
+
+
+def test_epoch_effective_balance_hysteresis(altair):
+    spec, state, _ = altair
+    # drain a quarter of validator 0's balance: effective balance must drop
+    state.balances[0] -= 9_000_000_000
+    process_epoch(state, spec)
+    assert state.validators[0].effective_balance < spec.max_effective_balance
