@@ -1,0 +1,273 @@
+"""The work scheduler: bounded priority queues feeding device-sized batches.
+
+Twin of beacon_node/beacon_processor/src/lib.rs — manager + bounded queues
+(:77-196), LIFO for attestations / FIFO for blocks & anti-censorship ops
+(:773-797), hardcoded priority dispatch (:946-1070), gossip batch assembly
+(:204-217, batch sizes 64), and the work journal used by scheduler tests
+(:759-766).  Differences are deliberate TPU re-design, not omissions:
+
+* Batch sizes follow the device jit cache's compiled shapes (powers of two
+  from the backend's min_batch) instead of the CPU-tuned 64, and assembly is
+  *deadline-driven*: a batch flushes when full OR when the slot-phase
+  deadline arrives (attestations are due at 1/3 slot — BASELINE.md).
+* Poisoned batches (one bad signature fails the whole AND-reduce) are
+  *bisected on device* — log2(B) extra batch verifies — rather than falling
+  back to per-set CPU verification (attestation_verification/batch.rs:
+  116-120 documents the CPU poisoning trade-off this replaces).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum, auto
+from typing import Any, Callable
+
+
+class WorkKind(Enum):
+    """Work taxonomy (the `Work` enum, lib.rs:562 — the kinds the
+    implemented layers emit; extended as layers land)."""
+
+    CHAIN_SEGMENT = auto()
+    RPC_BLOCK = auto()
+    GOSSIP_BLOCK = auto()
+    API_REQUEST_P0 = auto()
+    GOSSIP_AGGREGATE = auto()
+    GOSSIP_ATTESTATION = auto()
+    GOSSIP_VOLUNTARY_EXIT = auto()
+    GOSSIP_PROPOSER_SLASHING = auto()
+    GOSSIP_ATTESTER_SLASHING = auto()
+    GOSSIP_SYNC_SIGNATURE = auto()
+    API_REQUEST_P1 = auto()
+
+
+# queue bounds (lib.rs:77-196's explicit capacities)
+DEFAULT_QUEUE_BOUNDS = {
+    WorkKind.CHAIN_SEGMENT: 64,
+    WorkKind.RPC_BLOCK: 1024,
+    WorkKind.GOSSIP_BLOCK: 1024,
+    WorkKind.API_REQUEST_P0: 1024,
+    WorkKind.GOSSIP_AGGREGATE: 4096,
+    WorkKind.GOSSIP_ATTESTATION: 16384,
+    WorkKind.GOSSIP_VOLUNTARY_EXIT: 4096,
+    WorkKind.GOSSIP_PROPOSER_SLASHING: 4096,
+    WorkKind.GOSSIP_ATTESTER_SLASHING: 4096,
+    WorkKind.GOSSIP_SYNC_SIGNATURE: 16384,
+    WorkKind.API_REQUEST_P1: 1024,
+}
+
+# LIFO kinds: freshest-first (stale attestations lose value; lib.rs:773-786)
+LIFO_KINDS = {
+    WorkKind.GOSSIP_ATTESTATION,
+    WorkKind.GOSSIP_AGGREGATE,
+    WorkKind.GOSSIP_SYNC_SIGNATURE,
+}
+
+# dispatch priority (lib.rs:946-1070's if-else ladder, highest first)
+PRIORITY_ORDER = [
+    WorkKind.CHAIN_SEGMENT,
+    WorkKind.RPC_BLOCK,
+    WorkKind.GOSSIP_BLOCK,
+    WorkKind.API_REQUEST_P0,
+    WorkKind.GOSSIP_AGGREGATE,
+    WorkKind.GOSSIP_ATTESTATION,
+    WorkKind.GOSSIP_VOLUNTARY_EXIT,
+    WorkKind.GOSSIP_PROPOSER_SLASHING,
+    WorkKind.GOSSIP_ATTESTER_SLASHING,
+    WorkKind.GOSSIP_SYNC_SIGNATURE,
+    WorkKind.API_REQUEST_P1,
+]
+
+# batchable kinds and their device assembly caps
+BATCHED_KINDS = {
+    WorkKind.GOSSIP_ATTESTATION,
+    WorkKind.GOSSIP_AGGREGATE,
+    WorkKind.GOSSIP_SYNC_SIGNATURE,
+}
+
+
+@dataclass
+class WorkEvent:
+    kind: WorkKind
+    item: Any
+    received_at: float = field(default_factory=time.monotonic)
+
+
+class BoundedQueue:
+    """Bounded FIFO/LIFO with drop-count accounting (load shedding)."""
+
+    def __init__(self, bound: int, lifo: bool):
+        self.bound = bound
+        self.lifo = lifo
+        self._dq: deque = deque()
+        self.dropped = 0
+
+    def push(self, ev: WorkEvent) -> bool:
+        if len(self._dq) >= self.bound:
+            if self.lifo:
+                # LIFO sheds the OLDEST (bottom) — freshest data wins
+                self._dq.popleft()
+                self.dropped += 1
+            else:
+                self.dropped += 1
+                return False
+        self._dq.append(ev)
+        return True
+
+    def pop(self) -> WorkEvent | None:
+        if not self._dq:
+            return None
+        return self._dq.pop() if self.lifo else self._dq.popleft()
+
+    def pop_many(self, n: int) -> list[WorkEvent]:
+        out = []
+        while len(out) < n:
+            ev = self.pop()
+            if ev is None:
+                break
+            out.append(ev)
+        return out
+
+    def __len__(self):
+        return len(self._dq)
+
+
+class BeaconProcessor:
+    """Single-threaded dispatch core (the manager loop).  Async/thread
+    pumping lives in the runtime layer; tests drive `dispatch_once`."""
+
+    def __init__(
+        self,
+        handlers: dict[WorkKind, Callable[[list[WorkEvent]], None]],
+        batch_size_for: Callable[[WorkKind], int] | None = None,
+        bounds: dict[WorkKind, int] | None = None,
+        journal: list | None = None,
+    ):
+        bounds = {**DEFAULT_QUEUE_BOUNDS, **(bounds or {})}
+        self.queues = {
+            k: BoundedQueue(bounds[k], k in LIFO_KINDS) for k in WorkKind
+        }
+        self.handlers = handlers
+        self.batch_size_for = batch_size_for or (lambda k: 64)
+        # the work journal (lib.rs:759-766): every dispatch is observable
+        self.journal = journal if journal is not None else []
+
+    def try_send(self, ev: WorkEvent) -> bool:
+        ok = self.queues[ev.kind].push(ev)
+        if not ok:
+            self.journal.append(("dropped", ev.kind.name))
+        return ok
+
+    def dispatch_once(self) -> bool:
+        """Pop the highest-priority available work (batch-assembled for
+        batchable kinds) and run its handler.  Returns False when idle."""
+        for kind in PRIORITY_ORDER:
+            q = self.queues[kind]
+            if not len(q):
+                continue
+            n = self.batch_size_for(kind) if kind in BATCHED_KINDS else 1
+            batch = q.pop_many(n)
+            self.journal.append((kind.name, len(batch)))
+            handler = self.handlers.get(kind)
+            if handler is not None:
+                handler(batch)
+            return True
+        return False
+
+    def drain(self, budget: int | None = None) -> int:
+        done = 0
+        while budget is None or done < budget:
+            if not self.dispatch_once():
+                break
+            done += 1
+        return done
+
+    def queue_lengths(self) -> dict[str, int]:
+        return {k.name: len(q) for k, q in self.queues.items() if len(q)}
+
+
+# ---------------------------------------------------------------------------
+# Device batch verification with on-device bisection
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BatchOutcome:
+    verdicts: list[bool]
+    device_calls: int
+
+
+def verify_with_bisection(
+    verify: Callable[[list], bool], sets: list
+) -> BatchOutcome:
+    """AND-reduce batch verify with poisoned-batch attribution by on-device
+    bisection: a failing batch splits in half and re-verifies each side,
+    recursing to singles.  Cost for one poisoned item in B: ~2*log2(B) extra
+    batch calls — replacing batch.rs:116-120's per-set CPU fallback (B CPU
+    verifies) with device work.
+    """
+    calls = 0
+
+    def go(items: list) -> list[bool]:
+        nonlocal calls
+        if not items:
+            return []
+        calls += 1
+        if verify(items):
+            return [True] * len(items)
+        if len(items) == 1:
+            return [False]
+        mid = len(items) // 2
+        return go(items[:mid]) + go(items[mid:])
+
+    verdicts = go(list(sets))
+    return BatchOutcome(verdicts=verdicts, device_calls=calls)
+
+
+class DeadlineBatcher:
+    """Deadline-driven batch assembly for one batchable kind.
+
+    Flush triggers (whichever first):
+    * the accumulation reaches the largest compiled device batch size, or
+    * the slot-phase deadline arrives (e.g. attestations: 1/3 slot).
+
+    The flush size snaps DOWN to a compiled power-of-two (padding waste is
+    bounded and no new XLA program is compiled mid-slot) — the TPU version
+    of "batch sizes chosen for the CPU poisoning trade-off" (lib.rs:204-216).
+    """
+
+    def __init__(
+        self,
+        compiled_sizes: list[int],
+        deadline_fn: Callable[[], float],
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.sizes = sorted(compiled_sizes)
+        self.deadline_fn = deadline_fn
+        self.now = now
+        self.pending: list = []
+
+    def offer(self, item) -> list | None:
+        self.pending.append(item)
+        if len(self.pending) >= self.sizes[-1]:
+            return self._take(self.sizes[-1])
+        return None
+
+    def poll(self) -> list | None:
+        """Deadline check: flush whatever is pending at the phase edge."""
+        if self.pending and self.now() >= self.deadline_fn():
+            return self._take(len(self.pending))
+        return None
+
+    def _take(self, n: int) -> list:
+        batch, self.pending = self.pending[:n], self.pending[n:]
+        return batch
+
+    def snap_size(self, n: int) -> int:
+        """Smallest compiled size >= n (the jit-cache shape the flush will
+        run at; the pad is filled by the backend)."""
+        for s in self.sizes:
+            if s >= n:
+                return s
+        return self.sizes[-1]

@@ -1,0 +1,136 @@
+"""Scheduler: priority order, LIFO/FIFO semantics, shedding, batch assembly,
+deadline flush, and on-device bisection — asserted through the work journal
+(the reference tests scheduler behavior the same way,
+network_beacon_processor/tests.rs + beacon_processor/src/lib.rs:759-766)."""
+
+import itertools
+
+from lighthouse_tpu.beacon.processor import (
+    BatchOutcome,
+    BeaconProcessor,
+    DeadlineBatcher,
+    WorkEvent,
+    WorkKind,
+    verify_with_bisection,
+)
+
+
+def mk(kind, item):
+    return WorkEvent(kind=kind, item=item)
+
+
+def collector(sink):
+    def handler(batch):
+        sink.extend(ev.item for ev in batch)
+
+    return handler
+
+
+def test_priority_order():
+    seen = []
+    bp = BeaconProcessor(
+        handlers={k: collector(seen) for k in WorkKind},
+        batch_size_for=lambda k: 64,
+    )
+    bp.try_send(mk(WorkKind.GOSSIP_ATTESTATION, "att"))
+    bp.try_send(mk(WorkKind.GOSSIP_BLOCK, "block"))
+    bp.try_send(mk(WorkKind.CHAIN_SEGMENT, "segment"))
+    bp.try_send(mk(WorkKind.API_REQUEST_P1, "api1"))
+    bp.drain()
+    assert seen == ["segment", "block", "att", "api1"]
+
+
+def test_attestations_are_lifo_blocks_fifo():
+    seen = []
+    bp = BeaconProcessor(
+        handlers={k: collector(seen) for k in WorkKind},
+        batch_size_for=lambda k: 1,
+    )
+    for i in range(3):
+        bp.try_send(mk(WorkKind.GOSSIP_ATTESTATION, f"att{i}"))
+        bp.try_send(mk(WorkKind.GOSSIP_BLOCK, f"blk{i}"))
+    bp.drain()
+    blocks = [s for s in seen if s.startswith("blk")]
+    atts = [s for s in seen if s.startswith("att")]
+    assert blocks == ["blk0", "blk1", "blk2"]  # FIFO
+    assert atts == ["att2", "att1", "att0"]  # LIFO: freshest first
+
+
+def test_lifo_overflow_sheds_oldest_fifo_rejects_newest():
+    bp = BeaconProcessor(
+        handlers={},
+        bounds={WorkKind.GOSSIP_ATTESTATION: 2, WorkKind.GOSSIP_BLOCK: 2},
+    )
+    for i in range(4):
+        bp.try_send(mk(WorkKind.GOSSIP_ATTESTATION, i))
+    q = bp.queues[WorkKind.GOSSIP_ATTESTATION]
+    assert q.dropped == 2
+    assert [q.pop().item, q.pop().item] == [3, 2]  # newest kept
+    ok = [bp.try_send(mk(WorkKind.GOSSIP_BLOCK, i)) for i in range(4)]
+    assert ok == [True, True, False, False]  # FIFO rejects at the door
+    qb = bp.queues[WorkKind.GOSSIP_BLOCK]
+    assert [qb.pop().item, qb.pop().item] == [0, 1]
+
+
+def test_batch_assembly_4096_through_queue():
+    """BASELINE.md config 3: 4,096 synthetic attestation work items flow
+    through the bounded queue into device-sized batches."""
+    batches = []
+    bp = BeaconProcessor(
+        handlers={WorkKind.GOSSIP_ATTESTATION: batches.append},
+        batch_size_for=lambda k: 512,
+    )
+    for i in range(4096):
+        assert bp.try_send(mk(WorkKind.GOSSIP_ATTESTATION, i))
+    bp.drain()
+    assert [len(b) for b in batches] == [512] * 8
+    assert bp.journal.count(("GOSSIP_ATTESTATION", 512)) == 8
+    # LIFO: the first assembled batch holds the freshest items
+    assert batches[0][0].item == 4095
+
+
+def test_bisection_single_poison():
+    poisoned = {137}
+
+    def verify(items):
+        return not (set(items) & poisoned)
+
+    out = verify_with_bisection(verify, list(range(512)))
+    assert out.verdicts.count(False) == 1
+    assert out.verdicts[137] is False
+    # 2*log2(512)+1 = 19 batch calls, far below 512 singles
+    assert out.device_calls <= 19
+
+
+def test_bisection_all_good_one_call():
+    out = verify_with_bisection(lambda items: True, list(range(512)))
+    assert all(out.verdicts) and out.device_calls == 1
+
+
+def test_bisection_multiple_poison():
+    poisoned = {3, 200, 201}
+
+    def verify(items):
+        return not (set(items) & poisoned)
+
+    out = verify_with_bisection(verify, list(range(256)))
+    assert [i for i, v in enumerate(out.verdicts) if not v] == [3, 200, 201]
+
+
+def test_deadline_batcher():
+    clock = itertools.count()
+    t = [0.0]
+
+    def now():
+        return t[0]
+
+    b = DeadlineBatcher([8, 16], deadline_fn=lambda: 4.0, now=now)
+    for i in range(15):
+        full = b.offer(i)
+        assert full is None  # cap is 16
+    assert b.offer(15) == list(range(16))  # full flush at the cap
+    b.offer(99)
+    assert b.poll() is None  # deadline not reached
+    t[0] = 5.0
+    assert b.poll() == [99]  # deadline flush
+    assert b.snap_size(3) == 8 and b.snap_size(9) == 16

@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Convert the public KZG ceremony trusted setup into the repo's binary form.
+
+Input: the c-kzg-style JSON shipped with the reference
+(common/eth2_network_config/built_in_network_configs/trusted_setup.json) —
+the output of the public Ethereum KZG ceremony, a protocol constant every
+implementation embeds (crypto/kzg/src/lib.rs:30-45 loads the same data).
+
+Output: lighthouse_tpu/crypto/kzg/trusted_setup.npz holding DECOMPRESSED
+affine coordinates (big-endian 48-byte field elements), so framework startup
+skips 4096 G1 + 65 G2 point decompressions (~seconds of Tonelli-Shanks).
+
+Run: python tools/convert_trusted_setup.py [src.json] [dst.npz]
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.crypto.bls.curve import g1_from_bytes, g2_from_bytes
+
+DEFAULT_SRC = (
+    "/root/reference/common/eth2_network_config/built_in_network_configs/"
+    "trusted_setup.json"
+)
+DEFAULT_DST = "lighthouse_tpu/crypto/kzg/trusted_setup.npz"
+
+
+def main() -> None:
+    src = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_SRC
+    dst = sys.argv[2] if len(sys.argv) > 2 else DEFAULT_DST
+    with open(src) as f:
+        data = json.load(f)
+
+    g1 = np.zeros((len(data["g1_lagrange"]), 2, 48), dtype=np.uint8)
+    for i, hx in enumerate(data["g1_lagrange"]):
+        pt = g1_from_bytes(bytes.fromhex(hx[2:]), subgroup_check=True)
+        assert pt is not None, f"g1[{i}] must not be infinity"
+        x, y = pt
+        g1[i, 0] = np.frombuffer(x.v.to_bytes(48, "big"), dtype=np.uint8)
+        g1[i, 1] = np.frombuffer(y.v.to_bytes(48, "big"), dtype=np.uint8)
+        if i % 512 == 0:
+            print(f"g1 {i}/{len(data['g1_lagrange'])}", file=sys.stderr)
+
+    g2 = np.zeros((len(data["g2_monomial"]), 4, 48), dtype=np.uint8)
+    for i, hx in enumerate(data["g2_monomial"]):
+        pt = g2_from_bytes(bytes.fromhex(hx[2:]), subgroup_check=True)
+        assert pt is not None
+        x, y = pt
+        for j, c in enumerate((x.c0, x.c1, y.c0, y.c1)):  # ints mod P
+            g2[i, j] = np.frombuffer(int(c).to_bytes(48, "big"), dtype=np.uint8)
+
+    np.savez_compressed(dst.removesuffix(".npz"), g1_lagrange=g1, g2_monomial=g2)
+    print(f"wrote {dst}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
