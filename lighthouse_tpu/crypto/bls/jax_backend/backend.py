@@ -144,6 +144,33 @@ def _verify_kernel(pk_aff, sig_aff, h_aff, wbits):
     return ok_pair & ok_sub
 
 
+def _aggregate_verify_kernel(pk_aff, h_aff, sig_aff):
+    """Distinct-message aggregate verification (blst.rs:244-255 semantics):
+    check prod_i e(pk_i, H(m_i)) * e(-G1, sig) == 1 with ONE final exp.
+
+    pk_aff: G1 affine batch (one per message); h_aff: G2 affine batch of
+    message points; sig_aff: batch-1 G2 affine aggregate signature.
+    Unlike the signature-set kernel there are no random weights (single
+    statement, not a batch of independent claims) and just one subgroup
+    check.
+    """
+    import jax.numpy as jnp
+
+    ok_sub = jnp.all(P.g2_subgroup_check(sig_aff))
+    neg_gen = _neg_gen_const()
+    p_side = (
+        _concat_lfp_tree(pk_aff[0], neg_gen[0]),
+        _concat_lfp_tree(pk_aff[1], neg_gen[1]),
+    )
+    q_side = (
+        _concat_lfp_tree(h_aff[0], sig_aff[0]),
+        _concat_lfp_tree(h_aff[1], sig_aff[1]),
+    )
+    f = PR.miller_loop(p_side, q_side)
+    ok_pair = PR.final_exp_is_one(PR.gt_product(f))
+    return ok_pair & ok_sub
+
+
 def _neg_gen_const():
     """-G1 generator as a batch-1 device constant."""
     ng = affine_neg(G1_GENERATOR)
@@ -174,12 +201,38 @@ class JaxBackend:
         return self.verify_signature_sets([SignatureSet(sig, [pubkey], msg)])
 
     def aggregate_verify(self, pubkeys, msgs, sig) -> bool:
-        """Distinct-message aggregate verification (blst.rs:244-255): treated
-        as one multi-pairing check; host falls back to the oracle for this
-        rarely-used path."""
-        from ..api import PythonBackend
+        """Distinct-message aggregate verification (blst.rs:244-255) on the
+        device: one multi-pairing over the (pk_i, H(m_i)) pairs plus the
+        aggregate signature, one final exp."""
+        if not pubkeys or len(pubkeys) != len(msgs):
+            return False
+        if sig.point is None:
+            return False
+        if len(set(msgs)) != len(msgs):
+            return False  # messages must be distinct (eth2 semantics)
+        import jax
 
-        return PythonBackend().aggregate_verify(pubkeys, msgs, sig)
+        h_pts = [hash_to_g2(m) for m in msgs]
+        pk_pts = [pk.point for pk in pubkeys]
+        if any(p is None for p in pk_pts) or any(h is None for h in h_pts):
+            return False
+        # pad the pair list to a pow2-ish size class by replicating pair 0
+        # with its own message point: e(pk0, h0) appears k times, which
+        # WOULD change the product, so pad instead with (G1, O)-style
+        # neutral pairs — cheapest neutral is repeating (pk0, h0) and
+        # (-pk0, h0), which cancel pairwise.  For simplicity compile per
+        # distinct n (aggregate_verify is a rare path; sizes are small).
+        B = len(pk_pts)
+        key = ("agg", B)
+        if key not in self._kernels:
+            self._kernels[key] = jax.jit(_aggregate_verify_kernel)
+        fn = self._kernels[key]
+        ok = fn(
+            P.g1_encode(pk_pts),
+            P.g2_encode(h_pts),
+            P.g2_encode([sig.point]),
+        )
+        return bool(ok)
 
     def fast_aggregate_verify(self, pubkeys, msg: bytes, sig) -> bool:
         from ..api import SignatureSet
