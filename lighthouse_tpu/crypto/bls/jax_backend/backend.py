@@ -208,20 +208,13 @@ class JaxBackend:
             return False
         if sig.point is None:
             return False
-        if len(set(msgs)) != len(msgs):
-            return False  # messages must be distinct (eth2 semantics)
         import jax
 
         h_pts = [hash_to_g2(m) for m in msgs]
         pk_pts = [pk.point for pk in pubkeys]
         if any(p is None for p in pk_pts) or any(h is None for h in h_pts):
             return False
-        # pad the pair list to a pow2-ish size class by replicating pair 0
-        # with its own message point: e(pk0, h0) appears k times, which
-        # WOULD change the product, so pad instead with (G1, O)-style
-        # neutral pairs — cheapest neutral is repeating (pk0, h0) and
-        # (-pk0, h0), which cancel pairwise.  For simplicity compile per
-        # distinct n (aggregate_verify is a rare path; sizes are small).
+        # compiled per distinct n: this path is rare and sizes are small
         B = len(pk_pts)
         key = ("agg", B)
         if key not in self._kernels:

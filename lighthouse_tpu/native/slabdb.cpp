@@ -1,0 +1,265 @@
+// slabdb — embedded append-log key-value store for the beacon database.
+//
+// Native-runtime twin of the reference's LevelDB dependency
+// (beacon_node/store/Cargo.toml:13, used by HotColdDB at
+// beacon_node/store/src/hot_cold_store.rs:43): the framework's host-side
+// storage engine, written in C++ as the reference's store backend is native
+// C++ (SURVEY §2.7).  Design favors the beacon workload over generality:
+//
+//   * values are immutable blobs keyed by (column u8, key bytes) — blocks
+//     and states are content-addressed, so overwrites are rare and
+//     compaction is simple "copy live set".
+//   * writes append to a data log (crash-safe: a torn tail record is
+//     truncated on open), an in-memory unordered_map indexes offsets.
+//   * deletes are tombstone records; `slab_compact` rewrites the live set.
+//
+// C ABI (consumed via ctypes from lighthouse_tpu/store):
+//   slab_open/close/put/get/del/free/count/compact/flush/iter_prefix.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Rec {
+    uint64_t off;   // offset of the value payload in the log
+    uint32_t len;   // value length
+};
+
+struct Slab {
+    FILE* f = nullptr;
+    std::string path;
+    std::unordered_map<std::string, Rec> index;
+    uint64_t end = 0;       // logical end of valid data
+    uint64_t dead = 0;      // bytes of dead (overwritten/deleted) payload
+};
+
+constexpr uint32_t MAGIC = 0x534c4142u;  // "SLAB"
+constexpr uint8_t TAG_PUT = 1;
+constexpr uint8_t TAG_DEL = 2;
+
+bool read_exact(FILE* f, void* buf, size_t n) {
+    return fread(buf, 1, n, f) == n;
+}
+
+// Record layout: tag u8 | klen u32 | vlen u32 | key | value
+bool replay(Slab* s) {
+    uint32_t magic = 0;
+    if (!read_exact(s->f, &magic, 4)) {  // brand-new file
+        if (fseek(s->f, 0, SEEK_SET) != 0) return false;
+        if (fwrite(&MAGIC, 4, 1, s->f) != 1) return false;
+        fflush(s->f);
+        s->end = 4;
+        return true;
+    }
+    if (magic != MAGIC) return false;
+    // file size bound: a record whose value runs past EOF is a torn WRITE
+    // (crash mid-value) and must be dropped, not zero-extended.
+    if (fseek(s->f, 0, SEEK_END) != 0) return false;
+    uint64_t fsize = (uint64_t)ftell(s->f);
+    if (fseek(s->f, 4, SEEK_SET) != 0) return false;
+    uint64_t pos = 4;
+    for (;;) {
+        uint8_t tag;
+        uint32_t klen, vlen;
+        if (!read_exact(s->f, &tag, 1) || !read_exact(s->f, &klen, 4) ||
+            !read_exact(s->f, &vlen, 4)) {
+            break;  // clean EOF or torn header: truncate here
+        }
+        if (klen > (1u << 20) || vlen > (1u << 30)) break;  // corrupt
+        if (pos + 9ull + klen + (tag == TAG_PUT ? vlen : 0) > fsize) break;
+        std::string key(klen, '\0');
+        if (!read_exact(s->f, key.data(), klen)) break;
+        uint64_t voff = pos + 9 + klen;
+        if (tag == TAG_PUT) {
+            if (fseek(s->f, (long)vlen, SEEK_CUR) != 0) break;
+            auto it = s->index.find(key);
+            if (it != s->index.end()) s->dead += it->second.len;
+            s->index[key] = Rec{voff, vlen};
+        } else {
+            auto it = s->index.find(key);
+            if (it != s->index.end()) {
+                s->dead += it->second.len;
+                s->index.erase(it);
+            }
+        }
+        pos = voff + vlen;
+    }
+    s->end = pos;
+    // drop any torn tail so the next append starts at a record boundary
+    (void)!ftruncate(fileno(s->f), (off_t)pos);
+    return fseek(s->f, (long)pos, SEEK_SET) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* slab_open(const char* path) {
+    Slab* s = new Slab();
+    s->path = path;
+    s->f = fopen(path, "r+b");
+    if (!s->f) s->f = fopen(path, "w+b");
+    if (!s->f || !replay(s)) {
+        if (s->f) fclose(s->f);
+        delete s;
+        return nullptr;
+    }
+    return s;
+}
+
+void slab_close(void* h) {
+    Slab* s = static_cast<Slab*>(h);
+    if (s->f) fclose(s->f);
+    delete s;
+}
+
+int slab_put(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val,
+             uint32_t vlen) {
+    Slab* s = static_cast<Slab*>(h);
+    if (fseek(s->f, (long)s->end, SEEK_SET) != 0) return -1;
+    uint8_t tag = TAG_PUT;
+    if (fwrite(&tag, 1, 1, s->f) != 1 || fwrite(&klen, 4, 1, s->f) != 1 ||
+        fwrite(&vlen, 4, 1, s->f) != 1 ||
+        (klen && fwrite(key, 1, klen, s->f) != klen) ||
+        (vlen && fwrite(val, 1, vlen, s->f) != vlen)) {
+        return -1;
+    }
+    std::string k(reinterpret_cast<const char*>(key), klen);
+    auto it = s->index.find(k);
+    if (it != s->index.end()) s->dead += it->second.len;
+    s->index[k] = Rec{s->end + 9 + klen, vlen};
+    s->end += 9ull + klen + vlen;
+    return 0;
+}
+
+// Returns value length, or -1 if absent. *out is malloc'd; free with
+// slab_free.
+int64_t slab_get(void* h, const uint8_t* key, uint32_t klen, uint8_t** out) {
+    Slab* s = static_cast<Slab*>(h);
+    auto it = s->index.find(std::string(reinterpret_cast<const char*>(key), klen));
+    if (it == s->index.end()) return -1;
+    uint8_t* buf = static_cast<uint8_t*>(malloc(it->second.len ? it->second.len : 1));
+    if (fseek(s->f, (long)it->second.off, SEEK_SET) != 0 ||
+        (it->second.len && !read_exact(s->f, buf, it->second.len))) {
+        free(buf);
+        return -1;
+    }
+    // restore append position for the next put
+    fseek(s->f, (long)s->end, SEEK_SET);
+    *out = buf;
+    return it->second.len;
+}
+
+void slab_free(uint8_t* p) { free(p); }
+
+int slab_del(void* h, const uint8_t* key, uint32_t klen) {
+    Slab* s = static_cast<Slab*>(h);
+    std::string k(reinterpret_cast<const char*>(key), klen);
+    auto it = s->index.find(k);
+    if (it == s->index.end()) return 0;
+    if (fseek(s->f, (long)s->end, SEEK_SET) != 0) return -1;
+    uint8_t tag = TAG_DEL;
+    uint32_t vlen = 0;
+    if (fwrite(&tag, 1, 1, s->f) != 1 || fwrite(&klen, 4, 1, s->f) != 1 ||
+        fwrite(&vlen, 4, 1, s->f) != 1 || fwrite(key, 1, klen, s->f) != klen) {
+        return -1;
+    }
+    s->dead += it->second.len;
+    s->index.erase(it);
+    s->end += 9ull + klen;
+    return 0;
+}
+
+uint64_t slab_count(void* h) {
+    return static_cast<Slab*>(h)->index.size();
+}
+
+uint64_t slab_dead_bytes(void* h) {
+    return static_cast<Slab*>(h)->dead;
+}
+
+int slab_flush(void* h) {
+    Slab* s = static_cast<Slab*>(h);
+    return fflush(s->f) == 0 ? 0 : -1;
+}
+
+// Rewrite only the live set into a fresh log (garbage collection — the
+// analog of the reference's store GC/migration passes).
+int slab_compact(void* h) {
+    Slab* s = static_cast<Slab*>(h);
+    std::string tmp = s->path + ".compact";
+    FILE* nf = fopen(tmp.c_str(), "w+b");
+    if (!nf) return -1;
+    if (fwrite(&MAGIC, 4, 1, nf) != 1) { fclose(nf); return -1; }
+    std::unordered_map<std::string, Rec> nindex;
+    uint64_t nend = 4;
+    std::vector<uint8_t> buf;
+    for (auto& [k, rec] : s->index) {
+        buf.resize(rec.len);
+        if (fseek(s->f, (long)rec.off, SEEK_SET) != 0 ||
+            (rec.len && !read_exact(s->f, buf.data(), rec.len))) {
+            fclose(nf);
+            remove(tmp.c_str());
+            return -1;
+        }
+        uint8_t tag = TAG_PUT;
+        uint32_t klen = (uint32_t)k.size(), vlen = rec.len;
+        if (fwrite(&tag, 1, 1, nf) != 1 || fwrite(&klen, 4, 1, nf) != 1 ||
+            fwrite(&vlen, 4, 1, nf) != 1 ||
+            fwrite(k.data(), 1, klen, nf) != klen ||
+            (vlen && fwrite(buf.data(), 1, vlen, nf) != vlen)) {
+            fclose(nf);
+            remove(tmp.c_str());
+            return -1;
+        }
+        nindex[k] = Rec{nend + 9 + klen, vlen};
+        nend += 9ull + klen + vlen;
+    }
+    fflush(nf);
+    if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+        // old handle stays valid and open — the store keeps working
+        fclose(nf);
+        remove(tmp.c_str());
+        return -1;
+    }
+    fclose(s->f);
+    s->f = nf;
+    s->index.swap(nindex);
+    s->end = nend;
+    s->dead = 0;
+    return fseek(s->f, (long)nend, SEEK_SET) == 0 ? 0 : -1;
+}
+
+// Collect keys with a given prefix. Returns count; keys are packed as
+// u32 len | bytes, into a malloc'd buffer (slab_free it).
+int64_t slab_iter_prefix(void* h, const uint8_t* prefix, uint32_t plen,
+                         uint8_t** out, uint64_t* out_len) {
+    Slab* s = static_cast<Slab*>(h);
+    std::string p(reinterpret_cast<const char*>(prefix), plen);
+    std::vector<uint8_t> packed;
+    int64_t n = 0;
+    for (auto& [k, rec] : s->index) {
+        (void)rec;
+        if (k.size() >= p.size() && k.compare(0, p.size(), p) == 0) {
+            uint32_t kl = (uint32_t)k.size();
+            const uint8_t* klp = reinterpret_cast<const uint8_t*>(&kl);
+            packed.insert(packed.end(), klp, klp + 4);
+            packed.insert(packed.end(), k.begin(), k.end());
+            ++n;
+        }
+    }
+    uint8_t* buf = static_cast<uint8_t*>(malloc(packed.empty() ? 1 : packed.size()));
+    memcpy(buf, packed.data(), packed.size());
+    *out = buf;
+    *out_len = packed.size();
+    return n;
+}
+
+}  // extern "C"

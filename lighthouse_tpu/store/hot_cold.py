@@ -1,0 +1,170 @@
+"""HotColdDB: the hot/cold split beacon database.
+
+Twin of beacon_node/store/src/hot_cold_store.rs:43-50: recent (hot) blocks
+and full states live ahead of the finalized split; at finalization, blocks
+and periodic restore-point states migrate to the cold section and
+intermediate hot states are dropped (reconstructable by replay — the
+BlockReplayer pattern of store/src/reconstruct.rs).  Schema versioning in
+the metadata column mirrors store/src/metadata.rs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .kv import DBColumn, KeyValueStore, MemoryStore
+
+SCHEMA_VERSION = 1
+SPLIT_KEY = b"split"
+SCHEMA_KEY = b"schema"
+
+
+@dataclass
+class Split:
+    """The hot/cold boundary (finalized slot + state root)."""
+
+    slot: int
+    state_root: bytes
+
+    def encode(self) -> bytes:
+        return self.slot.to_bytes(8, "little") + self.state_root
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Split":
+        return cls(int.from_bytes(data[:8], "little"), data[8:40])
+
+
+class HotColdDB:
+    def __init__(
+        self,
+        store: KeyValueStore | None = None,
+        types_family=None,
+        slots_per_restore_point: int = 32,
+    ):
+        self.db = store if store is not None else MemoryStore()
+        self.types = types_family
+        self.slots_per_restore_point = slots_per_restore_point
+        raw = self.db.get(DBColumn.BEACON_META, SCHEMA_KEY)
+        if raw is None:
+            self.db.put(
+                DBColumn.BEACON_META, SCHEMA_KEY,
+                SCHEMA_VERSION.to_bytes(4, "little"),
+            )
+        else:
+            found = int.from_bytes(raw, "little")
+            if found != SCHEMA_VERSION:
+                raise IOError(
+                    f"schema v{found} needs migration to v{SCHEMA_VERSION} "
+                    "(database_manager analog)"
+                )
+
+    # ------------------------------------------------------------- split
+
+    @property
+    def split(self) -> Split:
+        raw = self.db.get(DBColumn.BEACON_META, SPLIT_KEY)
+        return Split.decode(raw) if raw else Split(0, bytes(32))
+
+    # ------------------------------------------------------------- blocks
+
+    def put_block(self, block_root: bytes, signed_block) -> None:
+        self.db.put(DBColumn.BEACON_BLOCK, block_root, signed_block.encode())
+
+    def get_block(self, block_root: bytes, block_cls=None):
+        for col in (DBColumn.BEACON_BLOCK, DBColumn.COLD_BLOCK):
+            raw = self.db.get(col, block_root)
+            if raw is not None:
+                cls = block_cls or (self.types and self.types.SignedBeaconBlock)
+                return cls.deserialize_value(raw) if cls else raw
+        return None
+
+    def block_exists(self, block_root: bytes) -> bool:
+        return any(
+            self.db.get(c, block_root) is not None
+            for c in (DBColumn.BEACON_BLOCK, DBColumn.COLD_BLOCK)
+        )
+
+    # ------------------------------------------------------------- states
+
+    def put_state(self, state_root: bytes, state) -> None:
+        self.db.put(DBColumn.BEACON_STATE, state_root, state.encode())
+        self.db.put(
+            DBColumn.BEACON_STATE_SUMMARY,
+            state_root,
+            int(state.slot).to_bytes(8, "little"),
+        )
+
+    def get_state(self, state_root: bytes, state_cls=None):
+        for col in (DBColumn.BEACON_STATE, DBColumn.COLD_STATE):
+            raw = self.db.get(col, state_root)
+            if raw is not None:
+                cls = state_cls or (self.types and self.types.BeaconState)
+                return cls.deserialize_value(raw) if cls else raw
+        return None
+
+    def state_slot(self, state_root: bytes) -> int | None:
+        raw = self.db.get(DBColumn.BEACON_STATE_SUMMARY, state_root)
+        return int.from_bytes(raw, "little") if raw else None
+
+    # ------------------------------------------------------- finalization
+
+    def migrate_to_cold(
+        self, finalized_slot: int, finalized_state_root: bytes,
+        keep_block_roots: set[bytes] | None = None,
+    ) -> dict:
+        """Advance the split (hot_cold_store freezer migration): move
+        finalized blocks cold, keep restore-point states, drop intermediate
+        hot states (replayable).  `keep_block_roots`: canonical-chain roots
+        to migrate; others (pruned forks) are deleted."""
+        stats = {"blocks_cold": 0, "blocks_pruned": 0, "states_dropped": 0,
+                 "states_kept": 0}
+        for root in list(self.db.keys(DBColumn.BEACON_BLOCK)):
+            raw = self.db.get(DBColumn.BEACON_BLOCK, root)
+            slot = self._block_slot(raw)
+            if slot is None or slot > finalized_slot:
+                continue
+            if keep_block_roots is None or root in keep_block_roots:
+                self.db.put(DBColumn.COLD_BLOCK, root, raw)
+                stats["blocks_cold"] += 1
+            else:
+                stats["blocks_pruned"] += 1
+            self.db.delete(DBColumn.BEACON_BLOCK, root)
+        for root in list(self.db.keys(DBColumn.BEACON_STATE)):
+            slot = self.state_slot(root)
+            if slot is None or slot > finalized_slot:
+                continue
+            raw = self.db.get(DBColumn.BEACON_STATE, root)
+            if slot % self.slots_per_restore_point == 0 or root == finalized_state_root:
+                # restore point: keep the state AND its slot summary so
+                # replay can locate the nearest restore point by slot
+                self.db.put(DBColumn.COLD_STATE, root, raw)
+                stats["states_kept"] += 1
+            else:
+                stats["states_dropped"] += 1
+                self.db.delete(DBColumn.BEACON_STATE_SUMMARY, root)
+            self.db.delete(DBColumn.BEACON_STATE, root)
+        self.db.put(
+            DBColumn.BEACON_META, SPLIT_KEY,
+            Split(finalized_slot, finalized_state_root).encode(),
+        )
+        self.db.flush()
+        return stats
+
+    @staticmethod
+    def _block_slot(signed_block_bytes: bytes) -> int | None:
+        # SignedBeaconBlock = 4-byte offset to message | signature(96) |
+        # message{slot u64 at its head}
+        if len(signed_block_bytes) < 108:
+            return None
+        return int.from_bytes(signed_block_bytes[100:108], "little")
+
+    # ------------------------------------------------------------- misc
+
+    def put_item(self, column: DBColumn, key: bytes, value: bytes) -> None:
+        self.db.put(column, key, value)
+
+    def get_item(self, column: DBColumn, key: bytes) -> bytes | None:
+        return self.db.get(column, key)
+
+    def close(self):
+        self.db.close()

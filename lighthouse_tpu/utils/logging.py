@@ -1,0 +1,92 @@
+"""Structured logging — twin of common/logging (slog terminal/file logging,
+metrics-counting layer at tracing_metrics_layer.rs, TimeLatch debounce at
+src/lib.rs:209).  Built on stdlib logging with slog-style key=value fields,
+a per-level metrics hook, and ring-buffer capture for the SSE stream."""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from collections import deque
+
+from .metrics import Counter
+
+LOG_EVENTS = Counter("log_events_total", "Log records by level", ("level",))
+
+
+class FieldsFormatter(logging.Formatter):
+    """slog-style: `Mon HH:MM:SS LEVEL message, key: value, key: value`."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = (
+            f"{self.formatTime(record, '%b %d %H:%M:%S')} "
+            f"{record.levelname:<5} {record.getMessage()}"
+        )
+        fields = getattr(record, "fields", None)
+        if fields:
+            base += ", " + ", ".join(f"{k}: {v}" for k, v in fields.items())
+        return base
+
+
+class MetricsHandler(logging.Handler):
+    """Counts records per level (tracing_metrics_layer.rs analog)."""
+
+    def emit(self, record):
+        LOG_EVENTS.inc(labels=(record.levelname,))
+
+
+class RingBufferHandler(logging.Handler):
+    """Retains the last N formatted records (SSE log streaming backing,
+    sse_logging_components.rs analog)."""
+
+    def __init__(self, capacity: int = 1024):
+        super().__init__()
+        self.buffer: deque[str] = deque(maxlen=capacity)
+
+    def emit(self, record):
+        self.buffer.append(self.format(record))
+
+
+class TimeLatch:
+    """Debounce helper (common/logging/src/lib.rs:209): True at most once
+    per interval — for warn-spam suppression."""
+
+    def __init__(self, interval: float = 30.0):
+        self.interval = interval
+        self._last = 0.0
+
+    def elapsed(self) -> bool:
+        now = time.monotonic()
+        if now - self._last >= self.interval:
+            self._last = now
+            return True
+        return False
+
+
+_ring = RingBufferHandler()
+
+
+def get_logger(name: str = "lighthouse_tpu", level: int = logging.INFO,
+               stream=None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not getattr(logger, "_lh_configured", False):
+        logger.setLevel(level)
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(FieldsFormatter())
+        logger.addHandler(h)
+        logger.addHandler(MetricsHandler())
+        _ring.setFormatter(FieldsFormatter())
+        logger.addHandler(_ring)
+        logger._lh_configured = True  # type: ignore[attr-defined]
+        logger.propagate = False
+    return logger
+
+
+def recent_logs() -> list[str]:
+    return list(_ring.buffer)
+
+
+def log_with(logger: logging.Logger, level: int, msg: str, **fields):
+    """slog-style structured fields: log_with(log, INFO, "Synced", slot=5)"""
+    logger.log(level, msg, extra={"fields": fields})

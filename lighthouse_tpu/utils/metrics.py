@@ -1,0 +1,159 @@
+"""Process-global metrics registry, Prometheus text exposition.
+
+Twin of common/lighthouse_metrics (global lazy_static registry + helpers,
+src/lib.rs:1-15) and the scrape surface behind http_metrics.  Pure stdlib:
+counters, gauges, histograms with label support and a `render()` that emits
+the Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+_REGISTRY: list["_Metric"] = []
+_REG_LOCK = threading.Lock()
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+        with _REG_LOCK:
+            _REGISTRY.append(self)
+
+    def _fmt_labels(self, labels: tuple) -> str:
+        if not labels:
+            return ""
+        if self.label_names and len(self.label_names) == len(labels):
+            inner = ",".join(
+                f'{n}="{v}"' for n, v in zip(self.label_names, labels)
+            )
+        else:
+            inner = ",".join(f'l{i}="{v}"' for i, v in enumerate(labels))
+        return "{" + inner + "}"
+
+    def samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, labels: tuple = ()):
+        with self._lock:
+            self._values[labels] += amount
+
+    def value(self, labels: tuple = ()) -> float:
+        with self._lock:
+            return self._values[labels]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float, labels: tuple = ()):
+        with self._lock:
+            self._values[labels] = v
+
+    def inc(self, amount: float = 1.0, labels: tuple = ()):
+        with self._lock:
+            self._values[labels] += amount
+
+    def dec(self, amount: float = 1.0, labels: tuple = ()):
+        with self._lock:
+            self._values[labels] -= amount
+
+    def value(self, labels: tuple = ()) -> float:
+        with self._lock:
+            return self._values[labels]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (
+        0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+        1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self, name, help_, buckets=None, label_names=()):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: dict[tuple, list[int]] = defaultdict(
+            lambda: [0] * (len(self.buckets) + 1)
+        )
+        self._sums: dict[tuple, float] = defaultdict(float)
+
+    def observe(self, v: float, labels: tuple = ()):
+        with self._lock:
+            counts = self._counts[labels]
+            for i, edge in enumerate(self.buckets):
+                if v <= edge:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[labels] += v
+            self._values[labels] += 1  # total count
+
+    def timer(self, labels: tuple = ()):
+        return _Timer(self, labels)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: tuple):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, self.labels)
+
+
+def render() -> str:
+    """Prometheus text exposition of every registered metric."""
+    out = []
+    with _REG_LOCK:
+        metrics = list(_REGISTRY)
+    for m in metrics:
+        out.append(f"# HELP {m.name} {m.help}")
+        out.append(f"# TYPE {m.name} {m.kind}")
+        if isinstance(m, Histogram):
+            with m._lock:
+                for labels, counts in m._counts.items():
+                    cum = 0
+                    base = m._fmt_labels(labels)[1:-1] if labels else ""
+                    for edge, c in zip(m.buckets, counts):
+                        cum += c
+                        lbl = f'{{le="{edge}"' + (f",{base}" if base else "") + "}"
+                        out.append(f"{m.name}_bucket{lbl} {cum}")
+                    cum += counts[-1]
+                    lbl = '{le="+Inf"' + (f",{base}" if base else "") + "}"
+                    out.append(f"{m.name}_bucket{lbl} {cum}")
+                    out.append(
+                        f"{m.name}_sum{m._fmt_labels(labels)} {m._sums[labels]}"
+                    )
+                    out.append(
+                        f"{m.name}_count{m._fmt_labels(labels)} "
+                        f"{int(m._values[labels])}"
+                    )
+        else:
+            for labels, v in m.samples():
+                out.append(f"{m.name}{m._fmt_labels(labels)} {v}")
+    return "\n".join(out) + "\n"
+
+
+def registry_names() -> list[str]:
+    with _REG_LOCK:
+        return [m.name for m in _REGISTRY]

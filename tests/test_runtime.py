@@ -1,0 +1,123 @@
+"""Runtime layer: slot clocks, executor supervision, metrics exposition,
+structured logging."""
+
+import asyncio
+import logging
+
+import pytest
+
+from lighthouse_tpu.utils import (
+    Counter,
+    Gauge,
+    Histogram,
+    ManualSlotClock,
+    TaskExecutor,
+    TimeLatch,
+    get_logger,
+    log_with,
+    recent_logs,
+    render,
+)
+
+
+class TestSlotClock:
+    def test_slot_arithmetic(self):
+        c = ManualSlotClock(genesis_time=1000, seconds_per_slot=12)
+        c.set_slot(5)
+        assert c.current_slot() == 5
+        assert c.start_of(5) == 1060
+        c.advance(11.9)
+        assert c.current_slot() == 5
+        c.advance(0.2)
+        assert c.current_slot() == 6
+
+    def test_phase_deadlines(self):
+        c = ManualSlotClock(genesis_time=0, seconds_per_slot=12)
+        c.set_slot(2)
+        assert c.attestation_deadline() == 24 + 4
+        assert c.aggregate_deadline() == 24 + 8
+        assert c.duration_to_next_slot() == 12
+
+    def test_pre_genesis(self):
+        c = ManualSlotClock(genesis_time=100, seconds_per_slot=12)
+        assert c.current_slot() == 0
+
+
+class TestExecutor:
+    def test_spawn_and_shutdown(self):
+        async def main():
+            ex = TaskExecutor(loop=asyncio.get_running_loop())
+            ran = []
+
+            async def service():
+                ran.append(1)
+                await asyncio.sleep(100)  # until cancelled
+
+            ex.spawn(service(), "svc")
+            await asyncio.sleep(0.01)
+            assert ex.active_tasks == 1
+            ex.shutdown("test done")
+            reason = await ex.wait_for_shutdown()
+            assert reason.reason == "test done" and not reason.failure
+            assert ran == [1]
+
+        asyncio.run(main())
+
+    def test_panicked_task_triggers_failure_shutdown(self):
+        async def main():
+            ex = TaskExecutor(loop=asyncio.get_running_loop())
+
+            async def broken():
+                raise RuntimeError("boom")
+
+            ex.spawn(broken(), "broken")
+            reason = await ex.wait_for_shutdown()
+            assert reason.failure and "boom" in reason.reason
+
+        asyncio.run(main())
+
+    def test_spawn_blocking(self):
+        async def main():
+            ex = TaskExecutor(loop=asyncio.get_running_loop())
+            out = await ex.spawn_blocking(lambda a, b: a + b, 2, 3, name="add")
+            assert out == 5
+
+        asyncio.run(main())
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_render(self):
+        c = Counter("test_ctr_total", "a counter", ("kind",))
+        c.inc(labels=("x",))
+        c.inc(2, labels=("x",))
+        g = Gauge("test_gauge", "a gauge")
+        g.set(7)
+        h = Histogram("test_hist_seconds", "a histogram", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = render()
+        assert 'test_ctr_total{kind="x"} 3.0' in text
+        assert "test_gauge 7" in text
+        assert 'test_hist_seconds_bucket{le="+Inf"} 3' in text
+        assert "test_hist_seconds_count 3" in text
+
+    def test_histogram_timer(self):
+        h = Histogram("test_timer_seconds", "t")
+        with h.timer():
+            pass
+        assert h.value() if hasattr(h, "value") else True
+        assert int(h._values[()]) == 1
+
+
+class TestLogging:
+    def test_structured_fields_and_ring(self):
+        log = get_logger("test-lh", stream=None)
+        log_with(log, logging.INFO, "Block imported", slot=123, root="0xab")
+        lines = recent_logs()
+        assert any("Block imported, slot: 123, root: 0xab" in ln for ln in lines)
+
+    def test_time_latch(self):
+        tl = TimeLatch(interval=1000)
+        assert tl.elapsed() is True
+        assert tl.elapsed() is False
