@@ -1,0 +1,7 @@
+"""Beacon node core — twin of beacon_node/ (chain engine, scheduler, pools,
+harness)."""
+
+from .chain import BeaconChain, BlockError, ChainError  # noqa: F401
+from .harness import BeaconChainHarness  # noqa: F401
+from .op_pool import OperationPool  # noqa: F401
+from .processor import BeaconProcessor, WorkEvent, WorkKind  # noqa: F401

@@ -1,0 +1,116 @@
+"""Operation pool: exits/slashings/attestations awaiting block inclusion.
+
+Twin of beacon_node/operation_pool: pooled ops keyed for dedup, and
+attestation packing as greedy weighted max-coverage (src/max_cover.rs:4-11
+documents the same approximation: pick the set covering the most yet-
+uncovered validators, mask, repeat).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class OperationPool:
+    attestations: dict[bytes, list] = field(default_factory=dict)
+    proposer_slashings: dict[int, object] = field(default_factory=dict)
+    attester_slashings: list = field(default_factory=list)
+    voluntary_exits: dict[int, object] = field(default_factory=dict)
+    bls_changes: dict[int, object] = field(default_factory=dict)
+
+    # ---------------------------------------------------------------- insert
+
+    def insert_attestation(self, attestation) -> None:
+        """Group by attestation data root (mergeable aggregates)."""
+        key = attestation.data.root()
+        self.attestations.setdefault(key, []).append(attestation)
+
+    def insert_proposer_slashing(self, slashing) -> None:
+        self.proposer_slashings[
+            slashing.signed_header_1.message.proposer_index
+        ] = slashing
+
+    def insert_attester_slashing(self, slashing) -> None:
+        self.attester_slashings.append(slashing)
+
+    def insert_voluntary_exit(self, signed_exit) -> None:
+        self.voluntary_exits[signed_exit.message.validator_index] = signed_exit
+
+    # ----------------------------------------------------------------- pack
+
+    def get_attestations_for_block(
+        self, state, preset, max_count: int | None = None
+    ) -> list:
+        """Greedy max-cover packing (max_cover.rs): score = newly covered
+        attesters, iteratively masked."""
+        max_count = max_count if max_count is not None else preset.max_attestations
+        current = state.slot // preset.slots_per_epoch
+        previous = max(current, 1) - 1
+        candidates = []
+        for group in self.attestations.values():
+            for att in group:
+                epoch = att.data.slot // preset.slots_per_epoch
+                if epoch not in (previous, current):
+                    continue
+                if att.data.slot + 1 > state.slot:
+                    continue  # inclusion delay not met
+                candidates.append(att)
+        covered: set[tuple[bytes, int]] = set()
+        packed = []
+        while candidates and len(packed) < max_count:
+            best, best_new = None, set()
+            for att in candidates:
+                key = att.data.root()
+                new = {
+                    (key, i)
+                    for i, b in enumerate(att.aggregation_bits)
+                    if b and (key, i) not in covered
+                }
+                if len(new) > len(best_new):
+                    best, best_new = att, new
+            if best is None or not best_new:
+                break
+            packed.append(best)
+            covered |= best_new
+            candidates.remove(best)
+        return packed
+
+    def get_slashings_and_exits(self, state, preset):
+        """Bounded op lists for a block (FIFO-fair, validity filtered by
+        the caller's state transition)."""
+        ps = list(self.proposer_slashings.values())[: preset.max_proposer_slashings]
+        asl = self.attester_slashings[: preset.max_attester_slashings]
+        exits = list(self.voluntary_exits.values())[: preset.max_voluntary_exits]
+        return ps, asl, exits
+
+    # ---------------------------------------------------------------- prune
+
+    def prune(self, state, preset) -> None:
+        """Drop ops made irrelevant by finalization/inclusion."""
+        current = state.slot // preset.slots_per_epoch
+        previous = max(current, 1) - 1
+        for key in list(self.attestations):
+            group = [
+                a
+                for a in self.attestations[key]
+                if a.data.slot // preset.slots_per_epoch >= previous
+            ]
+            if group:
+                self.attestations[key] = group
+            else:
+                del self.attestations[key]
+        from ..consensus.testing import FAR_FUTURE_EPOCH
+
+        for idx in list(self.voluntary_exits):
+            if (
+                idx < len(state.validators)
+                and state.validators[idx].exit_epoch != FAR_FUTURE_EPOCH
+            ):
+                del self.voluntary_exits[idx]
+        for idx in list(self.proposer_slashings):
+            if idx < len(state.validators) and state.validators[idx].slashed:
+                del self.proposer_slashings[idx]
+
+    def num_attestations(self) -> int:
+        return sum(len(g) for g in self.attestations.values())

@@ -120,7 +120,13 @@ class ForkChoice:
             self.justified_checkpoint = justified_checkpoint
         if finalized_checkpoint and finalized_checkpoint[0] > self.finalized_checkpoint[0]:
             self.finalized_checkpoint = finalized_checkpoint
-            self.proto.prune(finalized_checkpoint[1])
+            remap = self.proto.prune(finalized_checkpoint[1])
+            if remap is not None:
+                # votes hold node indices: follow the prune's reindexing
+                # (votes into pruned subtrees become NONE and stop counting)
+                for arr in (self._votes_current, self._votes_next):
+                    live = arr != NONE
+                    arr[live] = remap[arr[live]]
         if is_timely_proposal:
             self.proposer_boost_root = block.root
 

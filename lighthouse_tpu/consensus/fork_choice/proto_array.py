@@ -160,14 +160,16 @@ class ProtoArray:
             )
         return self.roots[best]
 
-    def prune(self, finalized_root: bytes) -> None:
+    def prune(self, finalized_root: bytes) -> np.ndarray | None:
         """proto_array.rs:754: drop everything not descending from the new
-        finalized root and reindex the columns."""
+        finalized root and reindex the columns.  Returns the old->new index
+        remap (NONE for pruned nodes) so vote trackers can follow, or None
+        if nothing changed."""
         fi = self.index.get(finalized_root)
         if fi is None:
             raise KeyError("finalized root unknown")
         if fi == 0:
-            return
+            return None
         n = len(self.blocks)
         keep = np.zeros(n, dtype=bool)
         keep[fi] = True
@@ -197,6 +199,7 @@ class ProtoArray:
         self.blocks = [self.blocks[i] for i in kept]
         self.roots = [self.roots[i] for i in kept]
         self.index = {r: j for j, r in enumerate(self.roots)}
+        return remap
 
     def propagate_execution_invalidation(self, root: bytes) -> None:
         """proto_array.rs:436-560 (condensed): mark a payload invalid and
