@@ -1,0 +1,113 @@
+"""EIP-2335 keystores: password-encrypted BLS secret keys.
+
+Twin of crypto/eth2_keystore (Keystore at src/keystore.rs): scrypt or
+pbkdf2 KDF (hashlib), AES-128-CTR cipher (cryptography package),
+sha256 checksum binding KDF output to ciphertext.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import uuid as uuid_mod
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+class KeystoreError(ValueError):
+    pass
+
+
+def _scrypt(password: bytes, salt: bytes, n: int, r: int, p: int, dklen: int):
+    return hashlib.scrypt(
+        password, salt=salt, n=n, r=r, p=p, dklen=dklen, maxmem=2**31 - 1
+    )
+
+
+def _pbkdf2(password: bytes, salt: bytes, c: int, dklen: int):
+    return hashlib.pbkdf2_hmac("sha256", password, salt, c, dklen)
+
+
+def _process_password(password: str) -> bytes:
+    """EIP-2335: NFKD normalize, strip C0/C1 control codes."""
+    import unicodedata
+
+    norm = unicodedata.normalize("NFKD", password)
+    return "".join(
+        c for c in norm if not (ord(c) < 0x20 or 0x7F <= ord(c) < 0xA0)
+    ).encode()
+
+
+def _aes128ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def encrypt(
+    secret: bytes,
+    password: str,
+    path: str = "",
+    kdf: str = "scrypt",
+    pubkey: bytes | None = None,
+    description: str = "",
+) -> dict:
+    """Build the EIP-2335 keystore JSON object."""
+    salt = os.urandom(32)
+    iv = os.urandom(16)
+    pw = _process_password(password)
+    if kdf == "scrypt":
+        params = {"dklen": 32, "n": 262144, "r": 8, "p": 1, "salt": salt.hex()}
+        dk = _scrypt(pw, salt, params["n"], params["r"], params["p"], 32)
+    elif kdf == "pbkdf2":
+        params = {"dklen": 32, "c": 262144, "prf": "hmac-sha256", "salt": salt.hex()}
+        dk = _pbkdf2(pw, salt, params["c"], 32)
+    else:
+        raise KeystoreError(f"unsupported kdf {kdf}")
+    cipher_text = _aes128ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    return {
+        "crypto": {
+            "kdf": {"function": kdf, "params": params, "message": ""},
+            "checksum": {
+                "function": "sha256",
+                "params": {},
+                "message": checksum.hex(),
+            },
+            "cipher": {
+                "function": "aes-128-ctr",
+                "params": {"iv": iv.hex()},
+                "message": cipher_text.hex(),
+            },
+        },
+        "description": description,
+        "pubkey": pubkey.hex() if pubkey else "",
+        "path": path,
+        "uuid": str(uuid_mod.uuid4()),
+        "version": 4,
+    }
+
+
+def decrypt(keystore: dict | str, password: str) -> bytes:
+    """Recover the secret; KeystoreError on wrong password (checksum)."""
+    ks = json.loads(keystore) if isinstance(keystore, str) else keystore
+    if ks.get("version") != 4:
+        raise KeystoreError("only EIP-2335 v4 keystores supported")
+    crypto = ks["crypto"]
+    kdf = crypto["kdf"]["function"]
+    params = crypto["kdf"]["params"]
+    salt = bytes.fromhex(params["salt"])
+    pw = _process_password(password)
+    if kdf == "scrypt":
+        dk = _scrypt(pw, salt, params["n"], params["r"], params["p"], params["dklen"])
+    elif kdf == "pbkdf2":
+        dk = _pbkdf2(pw, salt, params["c"], params["dklen"])
+    else:
+        raise KeystoreError(f"unsupported kdf {kdf}")
+    cipher_text = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise KeystoreError("invalid password (checksum mismatch)")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    return _aes128ctr(dk[:16], iv, cipher_text)

@@ -1,0 +1,217 @@
+"""EIP-3076 slashing protection: the database consulted before EVERY sign.
+
+Twin of validator_client/slashing_protection (SQLite `SlashingDatabase`,
+src/slashing_database.rs; EIP-3076 interchange import/export).  Same
+storage engine choice as the reference (SQLite — stdlib sqlite3 here), same
+minimal-pruning semantics: refuse any block proposal at or below the
+highest signed slot for the key unless identical, refuse any attestation
+that double-votes or surrounds/is surrounded.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+
+
+class SlashingProtectionError(Exception):
+    """Signing REFUSED: would violate slashing conditions."""
+
+
+class NotRegistered(SlashingProtectionError):
+    pass
+
+
+class SlashingDatabase:
+    def __init__(self, path: str = ":memory:", genesis_validators_root: bytes = b""):
+        self.conn = sqlite3.connect(path)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.conn.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS validators (
+                id INTEGER PRIMARY KEY, pubkey BLOB UNIQUE NOT NULL);
+            CREATE TABLE IF NOT EXISTS signed_blocks (
+                validator_id INTEGER NOT NULL REFERENCES validators(id),
+                slot INTEGER NOT NULL, signing_root BLOB,
+                UNIQUE (validator_id, slot));
+            CREATE TABLE IF NOT EXISTS signed_attestations (
+                validator_id INTEGER NOT NULL REFERENCES validators(id),
+                source_epoch INTEGER NOT NULL, target_epoch INTEGER NOT NULL,
+                signing_root BLOB, UNIQUE (validator_id, target_epoch));
+            CREATE TABLE IF NOT EXISTS metadata (
+                key TEXT PRIMARY KEY, value BLOB);
+            """
+        )
+        if genesis_validators_root:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO metadata VALUES ('gvr', ?)",
+                (genesis_validators_root,),
+            )
+        self.conn.commit()
+
+    # ------------------------------------------------------------ registry
+
+    def register_validator(self, pubkey: bytes) -> int:
+        cur = self.conn.execute(
+            "INSERT OR IGNORE INTO validators (pubkey) VALUES (?)", (pubkey,)
+        )
+        self.conn.commit()
+        return self._vid(pubkey)
+
+    def _vid(self, pubkey: bytes) -> int:
+        row = self.conn.execute(
+            "SELECT id FROM validators WHERE pubkey = ?", (pubkey,)
+        ).fetchone()
+        if row is None:
+            raise NotRegistered(f"pubkey {pubkey.hex()[:16]} not registered")
+        return row[0]
+
+    # -------------------------------------------------------------- blocks
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ) -> None:
+        """Record a proposal or raise.  Same-slot identical signing root is
+        permitted (re-broadcast); anything else at a signed slot is a
+        double proposal; slots below the maximum signed slot are refused
+        (minimal-pruning lower bound)."""
+        vid = self._vid(pubkey)
+        row = self.conn.execute(
+            "SELECT signing_root FROM signed_blocks WHERE validator_id=? AND slot=?",
+            (vid, slot),
+        ).fetchone()
+        if row is not None:
+            if row[0] == signing_root:
+                return  # identical re-sign ok
+            raise SlashingProtectionError(f"double block proposal at slot {slot}")
+        maxrow = self.conn.execute(
+            "SELECT MAX(slot) FROM signed_blocks WHERE validator_id=?", (vid,)
+        ).fetchone()
+        if maxrow[0] is not None and slot < maxrow[0]:
+            raise SlashingProtectionError(
+                f"slot {slot} at/below minimum signed slot {maxrow[0]}"
+            )
+        self.conn.execute(
+            "INSERT INTO signed_blocks VALUES (?,?,?)", (vid, slot, signing_root)
+        )
+        self.conn.commit()
+
+    # -------------------------------------------------------- attestations
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int,
+        signing_root: bytes,
+    ) -> None:
+        """EIP-3076 attestation rules: no double vote (same target unless
+        identical root), no surrounding, no surrounded, monotonic lower
+        bounds."""
+        if source_epoch > target_epoch:
+            raise SlashingProtectionError("source after target")
+        vid = self._vid(pubkey)
+        row = self.conn.execute(
+            "SELECT signing_root FROM signed_attestations "
+            "WHERE validator_id=? AND target_epoch=?",
+            (vid, target_epoch),
+        ).fetchone()
+        if row is not None:
+            if row[0] == signing_root:
+                return
+            raise SlashingProtectionError(
+                f"double vote at target epoch {target_epoch}"
+            )
+        # surround checks against everything recorded
+        surround = self.conn.execute(
+            "SELECT 1 FROM signed_attestations WHERE validator_id=? AND "
+            "((source_epoch < ? AND ? < target_epoch) OR "  # we surround prior
+            " (? < source_epoch AND target_epoch < ?))",  # prior surrounds us
+            (vid, source_epoch, target_epoch, source_epoch, target_epoch),
+        ).fetchone()
+        if surround is not None:
+            raise SlashingProtectionError("surround vote")
+        bounds = self.conn.execute(
+            "SELECT MAX(source_epoch), MAX(target_epoch) FROM "
+            "signed_attestations WHERE validator_id=?",
+            (vid,),
+        ).fetchone()
+        if bounds[0] is not None and source_epoch < bounds[0]:
+            raise SlashingProtectionError("source below minimum signed source")
+        if bounds[1] is not None and target_epoch <= bounds[1]:
+            raise SlashingProtectionError("target at/below minimum signed target")
+        self.conn.execute(
+            "INSERT INTO signed_attestations VALUES (?,?,?,?)",
+            (vid, source_epoch, target_epoch, signing_root),
+        )
+        self.conn.commit()
+
+    # --------------------------------------------------------- interchange
+
+    def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        """EIP-3076 interchange JSON (complete format)."""
+        data = []
+        for vid, pubkey in self.conn.execute("SELECT id, pubkey FROM validators"):
+            blocks = [
+                {"slot": str(s), "signing_root": "0x" + (r or b"").hex()}
+                for s, r in self.conn.execute(
+                    "SELECT slot, signing_root FROM signed_blocks "
+                    "WHERE validator_id=?",
+                    (vid,),
+                )
+            ]
+            atts = [
+                {
+                    "source_epoch": str(se),
+                    "target_epoch": str(te),
+                    "signing_root": "0x" + (r or b"").hex(),
+                }
+                for se, te, r in self.conn.execute(
+                    "SELECT source_epoch, target_epoch, signing_root FROM "
+                    "signed_attestations WHERE validator_id=?",
+                    (vid,),
+                )
+            ]
+            data.append(
+                {
+                    "pubkey": "0x" + pubkey.hex(),
+                    "signed_blocks": blocks,
+                    "signed_attestations": atts,
+                }
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, interchange: dict | str) -> None:
+        ic = json.loads(interchange) if isinstance(interchange, str) else interchange
+        if ic["metadata"]["interchange_format_version"] != "5":
+            raise SlashingProtectionError("unsupported interchange version")
+        for entry in ic["data"]:
+            pubkey = bytes.fromhex(entry["pubkey"][2:])
+            self.register_validator(pubkey)
+            vid = self._vid(pubkey)
+            for b in entry.get("signed_blocks", []):
+                self.conn.execute(
+                    "INSERT OR IGNORE INTO signed_blocks VALUES (?,?,?)",
+                    (
+                        vid,
+                        int(b["slot"]),
+                        bytes.fromhex(b.get("signing_root", "0x")[2:]),
+                    ),
+                )
+            for a in entry.get("signed_attestations", []):
+                self.conn.execute(
+                    "INSERT OR IGNORE INTO signed_attestations VALUES (?,?,?,?)",
+                    (
+                        vid,
+                        int(a["source_epoch"]),
+                        int(a["target_epoch"]),
+                        bytes.fromhex(a.get("signing_root", "0x")[2:]),
+                    ),
+                )
+        self.conn.commit()
+
+    def close(self):
+        self.conn.close()

@@ -1,0 +1,183 @@
+"""Validator stack: EIP-2333 derivation (published vector), EIP-2335
+keystores, EIP-3076 slashing protection, and the VC services against an
+in-process chain."""
+
+import pytest
+
+from lighthouse_tpu.beacon import BeaconChainHarness
+from lighthouse_tpu.crypto import keys as kd
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.validator import (
+    AttestationService,
+    BlockService,
+    DoppelgangerService,
+    DutiesService,
+    SlashingDatabase,
+    SlashingProtectionError,
+    ValidatorStore,
+)
+
+
+class TestEip2333:
+    def test_published_vector_case0(self):
+        """EIP-2333 test case 0 (the published KAT)."""
+        seed = bytes.fromhex(
+            "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+            "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+        )
+        master = kd.derive_master_sk(seed)
+        assert master == int(
+            "6083874454709270928345386274498605044986640685124978867557563392430687146096"
+        )
+        child = kd.derive_child_sk(master, 0)
+        assert child == int(
+            "20397789859736650942317412262472558107875392172444076792671091975210932703118"
+        )
+
+    def test_path_derivation(self):
+        seed = b"\x01" * 32
+        sk = kd.derive_path(seed, kd.validator_signing_path(0))
+        sk2 = kd.derive_path(seed, kd.validator_signing_path(1))
+        assert sk != sk2 and 0 < sk < kd.CURVE_ORDER
+
+    def test_short_seed_rejected(self):
+        with pytest.raises(ValueError):
+            kd.derive_master_sk(b"short")
+
+
+class TestEip2335:
+    def test_roundtrip_scrypt_and_pbkdf2(self):
+        secret = bytes.fromhex(
+            "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+        )
+        for kdf in ("scrypt", "pbkdf2"):
+            store = ks.encrypt(secret, "testpassword", kdf=kdf,
+                               path="m/12381/3600/0/0/0")
+            assert store["version"] == 4
+            assert ks.decrypt(store, "testpassword") == secret
+
+    def test_wrong_password_rejected(self):
+        store = ks.encrypt(b"\x11" * 32, "right", kdf="pbkdf2")
+        with pytest.raises(ks.KeystoreError, match="checksum"):
+            ks.decrypt(store, "wrong")
+
+    def test_password_normalization(self):
+        # control characters are stripped per EIP-2335
+        store = ks.encrypt(b"\x22" * 32, "pass\x7fword", kdf="pbkdf2")
+        assert ks.decrypt(store, "password") == b"\x22" * 32
+
+
+class TestSlashingProtection:
+    @pytest.fixture
+    def db(self):
+        d = SlashingDatabase()
+        d.register_validator(b"\xaa" * 48)
+        return d
+
+    def test_block_rules(self, db):
+        pk = b"\xaa" * 48
+        db.check_and_insert_block_proposal(pk, 10, b"\x01" * 32)
+        db.check_and_insert_block_proposal(pk, 10, b"\x01" * 32)  # same ok
+        with pytest.raises(SlashingProtectionError, match="double"):
+            db.check_and_insert_block_proposal(pk, 10, b"\x02" * 32)
+        with pytest.raises(SlashingProtectionError, match="below"):
+            db.check_and_insert_block_proposal(pk, 5, b"\x03" * 32)
+        db.check_and_insert_block_proposal(pk, 11, b"\x04" * 32)
+
+    def test_attestation_rules(self, db):
+        pk = b"\xaa" * 48
+        db.check_and_insert_attestation(pk, 2, 3, b"\x01" * 32)
+        with pytest.raises(SlashingProtectionError, match="double"):
+            db.check_and_insert_attestation(pk, 2, 3, b"\x02" * 32)
+        with pytest.raises(SlashingProtectionError, match="surround"):
+            db.check_and_insert_attestation(pk, 1, 4, b"\x03" * 32)  # surrounds
+        db.check_and_insert_attestation(pk, 3, 5, b"\x04" * 32)
+        with pytest.raises(SlashingProtectionError, match="surround"):
+            db.check_and_insert_attestation(pk, 4, 4, b"\x05" * 32)
+        # hmm: target 4 < recorded target 5 with source 4 > recorded 3:
+        # that's a surrounded vote (3,5) surrounds (4,4)
+
+    def test_unregistered_refused(self, db):
+        with pytest.raises(SlashingProtectionError):
+            db.check_and_insert_block_proposal(b"\xbb" * 48, 1, b"")
+
+    def test_interchange_roundtrip(self, db):
+        pk = b"\xaa" * 48
+        db.check_and_insert_block_proposal(pk, 7, b"\x01" * 32)
+        db.check_and_insert_attestation(pk, 0, 1, b"\x02" * 32)
+        ic = db.export_interchange(b"\x99" * 32)
+        assert ic["metadata"]["interchange_format_version"] == "5"
+        db2 = SlashingDatabase()
+        db2.import_interchange(ic)
+        with pytest.raises(SlashingProtectionError, match="double"):
+            db2.check_and_insert_block_proposal(pk, 7, b"\xff" * 32)
+
+
+class TestServices:
+    @pytest.fixture(scope="class")
+    def rig(self):
+        h = BeaconChainHarness(n_validators=16)
+        h.extend_chain(3)
+        keys = {
+            kp[1].to_bytes(): kp[0] for kp in h.keypairs
+        }
+        store = ValidatorStore(
+            keys=keys,
+            slashing_db=SlashingDatabase(),
+            index_by_pubkey={
+                kp[1].to_bytes(): i for i, kp in enumerate(h.keypairs)
+            },
+        )
+        duties = DutiesService(h.chain, store)
+        return h, store, duties
+
+    def test_attester_duties_cover_all(self, rig):
+        h, store, duties = rig
+        d = duties.attester_duties(0)
+        assert len(d) == 16  # every managed validator has exactly one duty
+        assert len({x.validator_index for x in d}) == 16
+
+    def test_attest_and_aggregate(self, rig):
+        h, store, duties = rig
+        svc = AttestationService(h.chain, store, duties)
+        slot = int(h.head_state().slot)
+        atts = svc.attest(slot)
+        assert len(atts) >= 1
+        aggs = svc.aggregate(slot, atts)
+        assert len(aggs) >= 1
+        agg = aggs[0].message.aggregate
+        assert sum(agg.aggregation_bits) == sum(
+            sum(a.aggregation_bits) for a in atts
+            if a.data.root() == agg.data.root()
+        )
+        # identical re-sign is permitted (same signing root)...
+        atts2 = svc.attest(slot)
+        assert len(atts2) == len(atts)
+        # ...but a DIFFERENT vote at the same target epoch is refused
+        from lighthouse_tpu.validator import SlashingProtectionError
+
+        changed = atts[0].data.copy()
+        changed.beacon_block_root = b"\x77" * 32
+        pk = next(iter(store.keys))
+        with pytest.raises(SlashingProtectionError, match="double"):
+            store.sign_attestation(
+                pk, changed, h.head_state(), h.chain.preset
+            )
+
+    def test_block_service_proposes(self, rig):
+        h, store, duties = rig
+        svc = BlockService(h.chain, store, duties)
+        slot = int(h.head_state().slot) + 1
+        h.set_slot(slot)
+        root = svc.propose(slot, h.keypairs)
+        assert root is not None
+        assert int(h.head_state().slot) == slot
+
+    def test_doppelganger_gate(self):
+        d = DoppelgangerService(detection_epochs=2)
+        d.begin(epoch=10)
+        assert not d.signing_enabled(0, 10)
+        assert not d.signing_enabled(0, 11)
+        assert d.signing_enabled(0, 12)
+        d.observe_liveness(0)
+        assert not d.signing_enabled(0, 12)  # duplicate detected: never sign
