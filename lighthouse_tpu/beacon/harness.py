@@ -31,6 +31,7 @@ class BeaconChainHarness:
         spec: S.ChainSpec | None = None,
         fork: str = "altair",
         verify_signatures: bool = False,
+        store=None,
     ):
         self.spec = spec or phase0_spec(S.MINIMAL)
         self.preset = self.spec.preset
@@ -42,7 +43,7 @@ class BeaconChainHarness:
             seconds_per_slot=self.spec.seconds_per_slot,
         )
         self.chain = BeaconChain(
-            self.spec, state, store=None, slot_clock=self.clock, fork=fork
+            self.spec, state, store=store, slot_clock=self.clock, fork=fork
         )
 
     # ------------------------------------------------------------ driving

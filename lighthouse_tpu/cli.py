@@ -1,0 +1,177 @@
+"""CLI entry — twin of lighthouse/src/main.rs (clap tree, :50) and the
+environment builder (lighthouse/environment): `python -m lighthouse_tpu
+<subcommand>` with bn / vc / account / db subcommands, spec-preset
+selection (--spec minimal|mainnet), and the runtime wiring (slot clock +
+API server + chain) for an interop development node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lighthouse-tpu",
+        description="TPU-native Ethereum consensus framework",
+    )
+    p.add_argument("--spec", choices=["minimal", "mainnet"], default="mainnet")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="run a beacon node (interop genesis)")
+    bn.add_argument("--validators", type=int, default=64)
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--datadir", default=None, help="slabdb path (memory if unset)")
+    bn.add_argument("--slots", type=int, default=0,
+                    help="exit after N slots (0 = run until interrupted)")
+    bn.add_argument("--auto-propose", action="store_true",
+                    help="produce blocks with interop keys each slot")
+
+    vc = sub.add_parser("vc", help="run a validator client against a BN")
+    vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument("--keys", type=int, default=8, help="interop key count")
+
+    acct = sub.add_parser("account", help="keystore operations")
+    acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
+    new = acct_sub.add_parser("new", help="create an EIP-2335 keystore")
+    new.add_argument("--password", required=True)
+    new.add_argument("--index", type=int, default=0, help="EIP-2334 index")
+    new.add_argument("--seed-hex", default=None)
+
+    db = sub.add_parser("db", help="database tools (database_manager analog)")
+    db_sub = db.add_subparsers(dest="db_cmd", required=True)
+    for name in ("inspect", "compact"):
+        d = db_sub.add_parser(name)
+        d.add_argument("path")
+
+    sub.add_parser("version")
+    return p
+
+
+def _spec_for(name: str, n_validators: int):
+    from .consensus import spec as S
+    from .consensus.testing import phase0_spec
+
+    preset = S.PRESETS[name]
+    return phase0_spec(preset)
+
+
+def run_bn(args) -> int:
+    from .beacon.harness import BeaconChainHarness
+    from .network.api import BeaconApiServer
+    from .utils import get_logger, log_with
+    import logging
+
+    log = get_logger("bn")
+    spec = _spec_for(args.spec, args.validators)
+    store = None
+    if args.datadir:
+        import os
+
+        from .consensus.containers import types_for
+        from .store import HotColdDB, SlabStore
+
+        os.makedirs(args.datadir, exist_ok=True)
+        store = HotColdDB(
+            store=SlabStore(os.path.join(args.datadir, "beacon.slab")),
+            types_family=types_for(spec.preset),
+        )
+    h = BeaconChainHarness(n_validators=args.validators, spec=spec, store=store)
+    server = BeaconApiServer(h.chain, port=args.http_port)
+    server.start()
+    log_with(
+        log, logging.INFO, "Beacon node started",
+        spec=args.spec, validators=args.validators,
+        http=f"http://127.0.0.1:{server.port}",
+    )
+    slot = 0
+    try:
+        while args.slots == 0 or slot < args.slots:
+            time.sleep(spec.seconds_per_slot if args.slots == 0 else 0.01)
+            slot += 1
+            h.set_slot(slot)
+            if args.auto_propose:
+                h.add_block_at_slot(slot)
+                h.attest_to_head(slot)
+                st = h.head_state()
+                log_with(
+                    log, logging.INFO, "Slot processed", slot=slot,
+                    head=h.chain.head_root.hex()[:8],
+                    justified=int(st.current_justified_checkpoint.epoch),
+                    finalized=int(st.finalized_checkpoint.epoch),
+                )
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+def run_vc(args) -> int:
+    from .network.api import BeaconApiClient
+
+    client = BeaconApiClient(args.beacon_node)
+    print(json.dumps({"version": client.node_version(),
+                      "syncing": client.node_syncing()}))
+    return 0
+
+
+def run_account(args) -> int:
+    from .crypto import keys as kd
+    from .crypto import keystore as ks
+    from .crypto.bls.api import SecretKey
+
+    seed = (
+        bytes.fromhex(args.seed_hex)
+        if args.seed_hex
+        else __import__("os").urandom(32)
+    )
+    path = kd.validator_signing_path(args.index)
+    sk_int = kd.derive_path(seed, path)
+    sk = SecretKey(sk_int)
+    store = ks.encrypt(
+        sk.to_bytes(), args.password, path=path,
+        pubkey=sk.public_key().to_bytes(),
+    )
+    print(json.dumps(store, indent=2))
+    return 0
+
+
+def run_db(args) -> int:
+    from .store import SlabStore, DBColumn
+
+    s = SlabStore(args.path)
+    if args.db_cmd == "inspect":
+        info = {"entries": len(s), "dead_bytes": s.dead_bytes()}
+        info["per_column"] = {
+            c.name: len(s.keys(c)) for c in DBColumn if s.keys(c)
+        }
+        print(json.dumps(info, indent=2))
+    elif args.db_cmd == "compact":
+        before = s.dead_bytes()
+        s.compact()
+        print(json.dumps({"reclaimed_bytes": before}))
+    s.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        from .network.api import VERSION
+
+        print(VERSION)
+        return 0
+    return {
+        "bn": run_bn,
+        "vc": run_vc,
+        "account": run_account,
+        "db": run_db,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

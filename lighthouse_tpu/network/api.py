@@ -1,0 +1,445 @@
+"""Beacon-API HTTP server + typed client (stdlib only).
+
+Twin of beacon_node/http_api (warp server, src/lib.rs:319 `serve`; 18,827
+LoC there — the subset here covers the endpoints the implemented layers
+serve) + common/eth2 (the typed client, src/lib.rs:1-5) + http_metrics (the
+Prometheus scrape endpoint, mounted at /metrics).
+
+JSON mapping follows the beacon-APIs conventions: uints as decimal strings,
+roots/signatures as 0x-hex, containers as objects keyed by field name.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..consensus.ssz import (
+    BOOLEAN,
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    SSZList,
+    UintN,
+    Vector,
+    _ContainerField,
+)
+from ..utils import render as render_metrics
+
+VERSION = "lighthouse-tpu/0.3.0"
+
+
+# ---------------------------------------------------------------------------
+# container <-> Beacon-API JSON
+# ---------------------------------------------------------------------------
+
+
+def to_json(type_or_cls, value):
+    if isinstance(type_or_cls, type) and issubclass(type_or_cls, Container):
+        return {
+            f: to_json(t, getattr(value, f))
+            for f, t in type_or_cls._fields.items()
+        }
+    if isinstance(type_or_cls, _ContainerField):
+        return to_json(type_or_cls.cls, value)
+    if isinstance(type_or_cls, UintN):
+        return str(int(value))
+    if isinstance(type_or_cls, type(BOOLEAN)):
+        return bool(value)
+    if isinstance(type_or_cls, (ByteVector, ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(type_or_cls, (Bitvector, Bitlist)):
+        return "0x" + type_or_cls.serialize(value).hex()
+    if isinstance(type_or_cls, (Vector, SSZList)):
+        return [to_json(type_or_cls.elem, v) for v in value]
+    raise TypeError(f"unmapped type {type_or_cls!r}")
+
+
+def from_json(type_or_cls, data):
+    if isinstance(type_or_cls, type) and issubclass(type_or_cls, Container):
+        return type_or_cls(
+            **{
+                f: from_json(t, data[f])
+                for f, t in type_or_cls._fields.items()
+            }
+        )
+    if isinstance(type_or_cls, _ContainerField):
+        return from_json(type_or_cls.cls, data)
+    if isinstance(type_or_cls, UintN):
+        return int(data)
+    if isinstance(type_or_cls, type(BOOLEAN)):
+        return bool(data)
+    if isinstance(type_or_cls, (ByteVector, ByteList)):
+        return bytes.fromhex(data[2:])
+    if isinstance(type_or_cls, (Bitvector, Bitlist)):
+        return type_or_cls.deserialize(bytes.fromhex(data[2:]))
+    if isinstance(type_or_cls, (Vector, SSZList)):
+        return [from_json(type_or_cls.elem, v) for v in data]
+    raise TypeError(f"unmapped type {type_or_cls!r}")
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class BeaconApiServer:
+    """Routes Beacon-API requests onto a BeaconChain (+ optional VC duties
+    helpers).  `task_spawner.rs` in the reference pushes blocking work onto
+    beacon_processor queues; here handlers run on the HTTP thread pool and
+    heavy verification still flows through the chain's normal pipelines."""
+
+    def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
+        self.chain = chain
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload, raw: bytes | None = None,
+                      content_type: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.end_headers()
+                if raw is not None:
+                    self.wfile.write(raw)
+                else:
+                    self.wfile.write(json.dumps(payload).encode())
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except KeyError as e:
+                    self._send(404, {"code": 404, "message": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"code": 500, "message": repr(e)})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    outer._post(self, body)
+                except ValueError as e:
+                    self._send(400, {"code": 400, "message": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"code": 500, "message": repr(e)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- routing
+
+    def _get(self, h) -> None:
+        path = h.path.split("?")[0].rstrip("/")
+        chain = self.chain
+        if path == "/eth/v1/node/health":
+            h._send(200, {})
+            return
+        if path == "/eth/v1/node/version":
+            h._send(200, {"data": {"version": VERSION}})
+            return
+        if path == "/eth/v1/node/syncing":
+            head = chain.head_state()
+            cur = (
+                chain.slot_clock.current_slot()
+                if chain.slot_clock
+                else int(head.slot)
+            )
+            distance = max(0, cur - int(head.slot))
+            h._send(
+                200,
+                {
+                    "data": {
+                        "head_slot": str(int(head.slot)),
+                        "sync_distance": str(distance),
+                        "is_syncing": distance > 1,
+                        "is_optimistic": False,
+                        "el_offline": True,
+                    }
+                },
+            )
+            return
+        if path == "/eth/v1/beacon/genesis":
+            st = chain.head_state()
+            h._send(
+                200,
+                {
+                    "data": {
+                        "genesis_time": str(int(st.genesis_time)),
+                        "genesis_validators_root": "0x"
+                        + bytes(st.genesis_validators_root).hex(),
+                        "genesis_fork_version": "0x"
+                        + bytes(chain.spec.genesis_fork_version).hex(),
+                    }
+                },
+            )
+            return
+        if path.startswith("/eth/v1/beacon/states/") and path.endswith("/root"):
+            state = self._resolve_state(path.split("/")[5])
+            h._send(200, {"data": {"root": "0x" + state.root().hex()}})
+            return
+        if path.startswith("/eth/v1/beacon/states/") and path.endswith(
+            "/finality_checkpoints"
+        ):
+            state = self._resolve_state(path.split("/")[5])
+
+            def cp(c):
+                return {"epoch": str(int(c.epoch)), "root": "0x" + bytes(c.root).hex()}
+
+            h._send(
+                200,
+                {
+                    "data": {
+                        "previous_justified": cp(state.previous_justified_checkpoint),
+                        "current_justified": cp(state.current_justified_checkpoint),
+                        "finalized": cp(state.finalized_checkpoint),
+                    }
+                },
+            )
+            return
+        if path.startswith("/eth/v1/beacon/headers"):
+            root = self._resolve_block_root(path.split("/")[-1])
+            blk = chain.store.get_block(root)
+            if blk is None:
+                raise KeyError("block not found")
+            msg = blk.message
+            h._send(
+                200,
+                {
+                    "data": {
+                        "root": "0x" + root.hex(),
+                        "canonical": True,
+                        "header": {
+                            "message": {
+                                "slot": str(int(msg.slot)),
+                                "proposer_index": str(int(msg.proposer_index)),
+                                "parent_root": "0x" + bytes(msg.parent_root).hex(),
+                                "state_root": "0x" + bytes(msg.state_root).hex(),
+                                "body_root": "0x"
+                                + type(msg)._fields["body"].hash_tree_root(msg.body).hex(),
+                            },
+                            "signature": "0x" + bytes(blk.signature).hex(),
+                        },
+                    }
+                },
+            )
+            return
+        if path.startswith("/eth/v2/beacon/blocks/"):
+            root = self._resolve_block_root(path.split("/")[-1])
+            blk = chain.store.get_block(root)
+            if blk is None:
+                raise KeyError("block not found")
+            h._send(
+                200,
+                {
+                    "version": chain.fork_name,
+                    "data": to_json(type(blk), blk),
+                },
+            )
+            return
+        if path.startswith("/eth/v1/validator/duties/proposer/"):
+            epoch = int(path.split("/")[-1])
+            from ..consensus import committees as cm
+
+            state = chain.head_state()
+            duties = []
+            preset = chain.preset
+            for slot in range(
+                max(epoch * preset.slots_per_epoch, int(state.slot), 1),
+                (epoch + 1) * preset.slots_per_epoch,
+            ):
+                vi = cm.get_beacon_proposer_index(state, slot, preset)
+                duties.append(
+                    {
+                        "pubkey": "0x" + bytes(state.validators[vi].pubkey).hex(),
+                        "validator_index": str(vi),
+                        "slot": str(slot),
+                    }
+                )
+            h._send(200, {"data": duties, "dependent_root": "0x" + "00" * 32})
+            return
+        if path == "/eth/v1/config/spec":
+            import dataclasses
+
+            spec = chain.spec
+            flat = {}
+            for f in dataclasses.fields(spec):
+                v = getattr(spec, f.name)
+                if isinstance(v, bytes):
+                    flat[f.name.upper()] = "0x" + v.hex()
+                elif isinstance(v, int):
+                    flat[f.name.upper()] = str(v)
+            for f in dataclasses.fields(spec.preset):
+                v = getattr(spec.preset, f.name)
+                if isinstance(v, int):
+                    flat[f.name.upper()] = str(v)
+            h._send(200, {"data": flat})
+            return
+        if path == "/metrics":
+            h._send(200, None, raw=render_metrics().encode(),
+                    content_type="text/plain; version=0.0.4")
+            return
+        raise KeyError(f"no route {path}")
+
+    def _post(self, h, body: bytes) -> None:
+        path = h.path.rstrip("/")
+        chain = self.chain
+        if path in ("/eth/v1/beacon/blocks", "/eth/v2/beacon/blocks"):
+            ctype = h.headers.get("Content-Type", "application/json")
+            cls = chain.types.SignedBeaconBlock_BY_FORK[chain.fork_name]
+            if "octet-stream" in ctype:
+                signed = cls.deserialize_value(body)
+            else:
+                signed = from_json(cls, json.loads(body))
+            try:
+                chain.process_block(signed)
+            except Exception as e:
+                raise ValueError(f"block rejected: {e}") from None
+            h._send(200, {})
+            return
+        if path == "/eth/v1/beacon/pool/attestations":
+            from ..consensus.containers import Attestation
+
+            payload = json.loads(body)
+            failures = []
+            for i, item in enumerate(payload):
+                att = from_json(Attestation, item)
+                try:
+                    chain.process_attestation(att)
+                except Exception as e:  # collect per-index failures
+                    failures.append({"index": i, "message": str(e)})
+            if failures:
+                h._send(400, {"code": 400, "message": "some attestations failed",
+                              "failures": failures})
+            else:
+                h._send(200, {})
+            return
+        raise KeyError(f"no route {path}")
+
+    # ----------------------------------------------------------- helpers
+
+    def _resolve_state(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head_state()
+        if state_id in ("justified", "finalized"):
+            cp = (
+                chain.fork_choice.justified_checkpoint
+                if state_id == "justified"
+                else chain.fork_choice.finalized_checkpoint
+            )
+            st = chain.state_for_block(cp[1])
+            if st is None:
+                raise KeyError(f"{state_id} state not held")
+            return st
+        if state_id.startswith("0x"):
+            st = chain.store.get_state(bytes.fromhex(state_id[2:]))
+            if st is None:
+                raise KeyError("state not found")
+            return st
+        raise KeyError(f"unsupported state id {state_id}")
+
+    def _resolve_block_root(self, block_id: str) -> bytes:
+        if block_id == "head":
+            return self.chain.head_root
+        if block_id == "genesis":
+            return self.chain.genesis_block_root
+        if block_id.startswith("0x"):
+            return bytes.fromhex(block_id[2:])
+        raise KeyError(f"unsupported block id {block_id}")
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="beacon-api"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class BeaconApiClient:
+    """Typed client (common/eth2's BeaconNodeHttpClient shape)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str) -> dict:
+        with urllib.request.urlopen(self.base + path, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def _post(self, path: str, payload, ssz: bytes | None = None) -> dict:
+        if ssz is not None:
+            req = urllib.request.Request(
+                self.base + path, data=ssz,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+        else:
+            req = urllib.request.Request(
+                self.base + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def node_version(self) -> str:
+        return self._get("/eth/v1/node/version")["data"]["version"]
+
+    def node_syncing(self) -> dict:
+        return self._get("/eth/v1/node/syncing")["data"]
+
+    def genesis(self) -> dict:
+        return self._get("/eth/v1/beacon/genesis")["data"]
+
+    def state_root(self, state_id: str = "head") -> bytes:
+        d = self._get(f"/eth/v1/beacon/states/{state_id}/root")
+        return bytes.fromhex(d["data"]["root"][2:])
+
+    def finality_checkpoints(self, state_id: str = "head") -> dict:
+        return self._get(
+            f"/eth/v1/beacon/states/{state_id}/finality_checkpoints"
+        )["data"]
+
+    def block_header(self, block_id: str = "head") -> dict:
+        return self._get(f"/eth/v1/beacon/headers/{block_id}")["data"]
+
+    def get_block_json(self, block_id: str = "head") -> dict:
+        return self._get(f"/eth/v2/beacon/blocks/{block_id}")
+
+    def proposer_duties(self, epoch: int) -> list[dict]:
+        return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
+
+    def spec(self) -> dict:
+        return self._get("/eth/v1/config/spec")["data"]
+
+    def publish_block_ssz(self, signed_block) -> None:
+        self._post("/eth/v1/beacon/blocks", None, ssz=signed_block.encode())
+
+    def publish_attestations(self, attestations) -> None:
+        from ..consensus.containers import Attestation
+
+        self._post(
+            "/eth/v1/beacon/pool/attestations",
+            [to_json(Attestation, a) for a in attestations],
+        )
+
+    def metrics(self) -> str:
+        with urllib.request.urlopen(
+            self.base + "/metrics", timeout=self.timeout
+        ) as r:
+            return r.read().decode()

@@ -53,6 +53,7 @@ class ValidatorStore:
     def __post_init__(self):
         for pk in self.keys:
             self.slashing_db.register_validator(pk)
+        self.pk_by_index = {v: k for k, v in self.index_by_pubkey.items()}
 
     def sign_attestation(self, pubkey: bytes, data: AttestationData, state, preset):
         domain = sets.get_domain(
@@ -164,7 +165,7 @@ class AttestationService:
         else:
             target_root = head_root
         produced = []
-        pk_by_index = {v: k for k, v in self.store.index_by_pubkey.items()}
+        pk_by_index = self.store.pk_by_index
         for duty in self.duties.attester_duties(epoch):
             if duty.slot != slot:
                 continue
@@ -202,6 +203,11 @@ class AttestationService:
         out = []
         state = self.chain.head_state()
         preset = self.chain.preset
+        epoch = slot // preset.slots_per_epoch
+        duties_by_committee = {}
+        for d in self.duties.attester_duties(epoch):
+            if d.slot == slot:
+                duties_by_committee.setdefault(d.committee_index, []).append(d)
         for group in by_data.values():
             base = group[0]
             bits = list(base.aggregation_bits)
@@ -216,10 +222,12 @@ class AttestationService:
                 data=base.data,
                 signature=bls.AggregateSignature.aggregate(sigs).to_bytes(),
             )
-            # first managed validator in the committee acts as aggregator
-            pk_by_index = {v: k for k, v in self.store.index_by_pubkey.items()}
-            agg_index = next(iter(sorted(pk_by_index)))
-            pubkey = pk_by_index[agg_index]
+            # the aggregator must be a managed validator IN this committee
+            committee_duties = duties_by_committee.get(int(base.data.index), [])
+            if not committee_duties:
+                continue  # no managed member: not our aggregation duty
+            agg_index = min(d.validator_index for d in committee_duties)
+            pubkey = self.store.pk_by_index[agg_index]
             proof = self.store.sign_selection_proof(pubkey, slot, state, preset)
             msg = AggregateAndProof(
                 aggregator_index=agg_index,

@@ -1,0 +1,46 @@
+"""CLI entry: version, account keystore creation, db tools, and a short
+auto-proposing bn run (the L0 smoke)."""
+
+import json
+
+from lighthouse_tpu.cli import main
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert "lighthouse-tpu" in capsys.readouterr().out
+
+
+def test_account_new(capsys):
+    rc = main([
+        "account", "new", "--password", "hunter22", "--index", "3",
+        "--seed-hex", "11" * 32,
+    ])
+    assert rc == 0
+    store = json.loads(capsys.readouterr().out)
+    assert store["version"] == 4 and store["path"] == "m/12381/3600/3/0/0"
+    from lighthouse_tpu.crypto import keystore as ks
+
+    assert len(ks.decrypt(store, "hunter22")) == 32
+
+
+def test_db_tools(tmp_path, capsys):
+    from lighthouse_tpu.store import DBColumn, SlabStore
+
+    path = str(tmp_path / "x.slab")
+    s = SlabStore(path)
+    s.put(DBColumn.BEACON_BLOCK, b"k", b"v" * 100)
+    s.put(DBColumn.BEACON_BLOCK, b"k", b"v" * 100)
+    s.close()
+    assert main(["db", "inspect", path]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["entries"] == 1 and info["dead_bytes"] > 0
+    assert main(["db", "compact", path]) == 0
+
+
+def test_bn_short_run(capsys):
+    rc = main([
+        "--spec", "minimal", "bn", "--validators", "16", "--http-port", "0",
+        "--slots", "3", "--auto-propose",
+    ])
+    assert rc == 0

@@ -1,0 +1,217 @@
+"""Network stack: snappy codec (format KATs + roundtrips), gossip message
+IDs/dedup/scoring, req/resp codec + rate limiting, topics, and the HTTP
+Beacon-API server/client end-to-end against a live chain."""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.beacon import BeaconChainHarness
+from lighthouse_tpu.consensus.spec import MINIMAL, minimal_spec
+from lighthouse_tpu.network import gossip, rpc, snappy, topics
+from lighthouse_tpu.network.api import BeaconApiClient, BeaconApiServer
+
+
+class TestSnappy:
+    def test_roundtrips(self):
+        import random
+
+        random.seed(7)
+        cases = [
+            b"", b"x", b"abc" * 1000, os.urandom(70000),
+            bytes(random.choices(b"ab", k=9999)),
+        ]
+        for c in cases:
+            assert snappy.decompress_block(snappy.compress_block(c)) == c
+            assert snappy.decompress_framed(snappy.compress_framed(c)) == c
+
+    def test_compresses_repetitive_data(self):
+        data = b"\x00" * 100000
+        assert len(snappy.compress_block(data)) < len(data) // 10
+
+    def test_crc32c_kat(self):
+        # public CRC-32/ISCSI check value for "123456789"
+        assert snappy.crc32c(b"123456789") == 0xE3069283
+
+    def test_block_format_worked_example(self):
+        """Decode a hand-assembled spec-conformant stream (literal + copy):
+        proves the DECODER against the format, not our encoder."""
+        # "Wikipedia" + copy(offset=9, len=9) => "WikipediaWikipedia"
+        raw = bytes([18]) + bytes([8 << 2]) + b"Wikipedia" + bytes(
+            [0b10 | ((9 - 1) << 2)]
+        ) + (9).to_bytes(2, "little")
+        assert snappy.decompress_block(raw) == b"WikipediaWikipedia"
+
+    def test_corrupt_crc_rejected(self):
+        framed = bytearray(snappy.compress_framed(b"hello world"))
+        framed[-1] ^= 0xFF
+        with pytest.raises(snappy.SnappyError):
+            snappy.decompress_framed(bytes(framed))
+
+
+class TestGossip:
+    def test_message_id_stable_and_domain_separated(self):
+        payload = snappy.compress_block(b"payload")
+        a = gossip.message_id("/eth2/00000000/beacon_block/ssz_snappy", payload)
+        b = gossip.message_id("/eth2/00000000/beacon_block/ssz_snappy", payload)
+        c = gossip.message_id("/eth2/00000000/voluntary_exit/ssz_snappy", payload)
+        assert a == b and a != c and len(a) == 20
+
+    def test_mesh_propagation_and_dedup(self):
+        router = gossip.GossipRouter()
+        nodes = [gossip.GossipNode(f"n{i}", router) for i in range(3)]
+        got = {n.node_id: [] for n in nodes}
+
+        def mk_handler(nid):
+            def handler(payload, frm):
+                got[nid].append(payload)
+                return "accept"
+            return handler
+
+        t = "/eth2/00000000/beacon_block/ssz_snappy"
+        for n in nodes:
+            n.subscribe(t, mk_handler(n.node_id))
+        nodes[0].publish(t, b"block-bytes")
+        assert got["n1"] == [b"block-bytes"] and got["n2"] == [b"block-bytes"]
+        # re-publish same payload: dedup suppresses redelivery
+        nodes[0].publish(t, b"block-bytes")
+        assert len(got["n1"]) == 1
+
+    def test_reject_penalizes_and_bans(self):
+        router = gossip.GossipRouter()
+        a = gossip.GossipNode("a", router)
+        b = gossip.GossipNode("b", router)
+        t = "/eth2/00000000/beacon_attestation_0/ssz_snappy"
+        a.subscribe(t, lambda p, frm: "reject")
+        b.subscribe(t, lambda p, frm: "accept")
+        for i in range(4):
+            b.publish(t, b"junk%d" % i)
+        assert a.peer_manager.is_banned("b")
+        with pytest.raises(PermissionError):
+            a.peer_manager.connect("b")
+
+
+class TestRpc:
+    def test_status_chunk_roundtrip(self):
+        msg = rpc.StatusMessage(
+            fork_digest=b"\x01\x02\x03\x04",
+            finalized_root=b"\xaa" * 32,
+            finalized_epoch=7,
+            head_root=b"\xbb" * 32,
+            head_slot=99,
+        )
+        chunk = rpc.encode_response_chunk(rpc.SUCCESS, msg.encode())
+        result, payload = rpc.decode_response_chunk(chunk)
+        assert result == rpc.SUCCESS
+        back = rpc.StatusMessage.deserialize_value(payload)
+        assert back == msg
+
+    def test_request_size_limit(self):
+        enc = rpc.encode_request(b"\x00" * 100)
+        with pytest.raises(ValueError, match="limit"):
+            rpc.decode_request(enc, max_len=10)
+
+    def test_protocol_ids(self):
+        assert rpc.protocol_id("status") == (
+            "/eth2/beacon_chain/req/status/1/ssz_snappy"
+        )
+        assert rpc.protocol_id("metadata").endswith("/2/ssz_snappy")
+
+    def test_rate_limiter(self):
+        rl = rpc.RateLimiter({"ping": (2, 0.0)})
+        assert rl.allow("p1", "ping", now=0.0)
+        assert rl.allow("p1", "ping", now=0.0)
+        assert not rl.allow("p1", "ping", now=0.0)  # bucket drained
+        assert rl.allow("p2", "ping", now=0.0)  # per-peer buckets
+
+
+class TestTopics:
+    def test_topic_shape_and_parse(self):
+        spec = minimal_spec()
+        fd = topics.fork_digest(spec, 0, b"\x00" * 32)
+        t = topics.attestation_subnet_topic(5, fd)
+        digest, kind = topics.parse_topic(t)
+        assert digest == fd and kind == "beacon_attestation_5"
+        allt = topics.all_topics(spec, fd)
+        assert len(allt) == len(topics.CORE_KINDS) + 64 + 4 + spec.preset.max_blobs_per_block
+
+    def test_subnet_mapping(self):
+        spec = minimal_spec()
+        s = topics.compute_subnet_for_attestation(spec, slot=3, committee_index=1,
+                                                  committees_per_slot=4)
+        assert 0 <= s < spec.attestation_subnet_count
+
+
+class TestBeaconApi:
+    @pytest.fixture(scope="class")
+    def rig(self):
+        h = BeaconChainHarness(n_validators=16)
+        h.extend_chain(3)
+        server = BeaconApiServer(h.chain)
+        server.start()
+        client = BeaconApiClient(f"http://127.0.0.1:{server.port}")
+        yield h, server, client
+        server.stop()
+
+    def test_node_endpoints(self, rig):
+        h, _, client = rig
+        assert client.node_version().startswith("lighthouse-tpu")
+        sync = client.node_syncing()
+        assert sync["head_slot"] == "3"
+
+    def test_genesis_and_state_root(self, rig):
+        h, _, client = rig
+        g = client.genesis()
+        assert g["genesis_validators_root"] == "0x" + bytes(
+            h.head_state().genesis_validators_root
+        ).hex()
+        assert client.state_root("head") == h.head_state().root()
+
+    def test_header_and_block(self, rig):
+        h, _, client = rig
+        hdr = client.block_header("head")
+        assert hdr["root"] == "0x" + h.chain.head_root.hex()
+        blk = client.get_block_json("head")
+        assert blk["data"]["message"]["slot"] == "3"
+
+    def test_proposer_duties(self, rig):
+        h, _, client = rig
+        duties = client.proposer_duties(0)
+        assert all(int(d["slot"]) >= 3 for d in duties)
+
+    def test_spec_endpoint(self, rig):
+        h, _, client = rig
+        spec = client.spec()
+        assert spec["SLOTS_PER_EPOCH"] == str(MINIMAL.slots_per_epoch)
+        assert spec["SECONDS_PER_SLOT"] == "12"
+
+    def test_publish_block_ssz_roundtrip(self, rig):
+        h, _, client = rig
+        slot = int(h.head_state().slot) + 1
+        h.set_slot(slot)
+        signed = h.chain.produce_block(slot, h.keypairs)
+        client.publish_block_ssz(signed)
+        assert int(h.head_state().slot) == slot
+
+    def test_publish_attestations(self, rig):
+        h, _, client = rig
+        atts = h.make_attestations(int(h.head_state().slot))
+        client.publish_attestations(atts)
+        assert h.chain.op_pool.num_attestations() >= 1
+
+    def test_bad_block_rejected_with_400(self, rig):
+        import urllib.error
+
+        h, _, client = rig
+        slot = int(h.head_state().slot) + 1
+        h.set_slot(slot)
+        signed = h.chain.produce_block(slot, h.keypairs)
+        signed.message.parent_root = b"\x13" * 32  # junk parent
+        with pytest.raises(urllib.error.HTTPError) as e:
+            client.publish_block_ssz(signed)
+        assert e.value.code == 400
+
+    def test_metrics_scrape(self, rig):
+        _, _, client = rig
+        text = client.metrics()
+        assert "beacon_blocks_imported_total" in text
