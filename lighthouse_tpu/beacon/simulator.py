@@ -1,0 +1,148 @@
+"""In-process multi-node simulator.
+
+Twin of testing/simulator (+node_test_rig): N beacon nodes in one process
+(testing/simulator/src/main.rs:1-14), minimal spec, shared interop genesis,
+connected over the in-process gossip mesh (lighthouse_tpu.network.gossip)
+speaking the real wire encodings (SSZ + snappy + spec message ids).  Each
+node runs a full BeaconChain; one node proposes per slot (the validator set
+is partitioned across nodes, but any node's keys can propose since interop
+keys are shared — mirroring the simulator's local validator clients), and
+every node imports blocks/attestations only through its gossip handlers.
+
+Liveness checks (checks.rs analog) live in the tests: all heads converge,
+finalization advances on every node.
+"""
+
+from __future__ import annotations
+
+from ..consensus import spec as S
+from ..consensus.containers import Attestation, types_for
+from ..consensus.testing import interop_state, phase0_spec
+from ..network import gossip, topics
+from ..utils import ManualSlotClock
+from .chain import BeaconChain, BlockError
+from .harness import BeaconChainHarness
+
+
+class SimNode:
+    def __init__(self, node_id: str, spec: S.ChainSpec, genesis_state,
+                 router: gossip.GossipRouter, fork: str = "altair"):
+        self.node_id = node_id
+        self.spec = spec
+        self.clock = ManualSlotClock(
+            genesis_time=float(genesis_state.genesis_time),
+            seconds_per_slot=spec.seconds_per_slot,
+        )
+        self.chain = BeaconChain(
+            spec, genesis_state, store=None, slot_clock=self.clock, fork=fork
+        )
+        self.gossip = gossip.GossipNode(node_id, router)
+        self.fork = fork
+        gvr = bytes(genesis_state.genesis_validators_root)
+        digest = topics.fork_digest(spec, 0, gvr)
+        self.block_topic = topics.topic("beacon_block", digest)
+        self.att_topics = [
+            topics.attestation_subnet_topic(i, digest)
+            for i in range(spec.attestation_subnet_count)
+        ]
+        self.gossip.subscribe(self.block_topic, self._on_block)
+        for t in self.att_topics:
+            self.gossip.subscribe(t, self._on_attestation)
+
+    # ------------------------------------------------------- gossip handlers
+
+    def _on_block(self, payload: bytes, from_peer: str) -> str:
+        cls = self.chain.types.SignedBeaconBlock_BY_FORK[self.fork]
+        try:
+            signed = cls.deserialize_value(payload)
+        except Exception:
+            return "reject"
+        try:
+            self.chain.process_block(signed, verify_signatures=False)
+            return "accept"
+        except BlockError as e:
+            if "already known" in str(e):
+                return "ignore"
+            return "reject"
+
+    def _on_attestation(self, payload: bytes, from_peer: str) -> str:
+        try:
+            att = Attestation.deserialize_value(payload)
+        except Exception:
+            return "reject"
+        try:
+            self.chain.process_attestation(
+                att, current_slot=self.clock.current_slot()
+            )
+            return "accept"
+        except Exception:
+            return "ignore"  # e.g. dedup or unknown head during sync races
+
+    # ------------------------------------------------------------ publishing
+
+    def publish_block(self, signed) -> None:
+        self.chain.process_block(signed, verify_signatures=False)
+        self.gossip.publish(self.block_topic, signed.encode())
+
+    def publish_attestation(self, att: Attestation) -> None:
+        cps = self.chain.committee_cache(
+            self.chain.head_state(),
+            int(att.data.slot) // self.spec.preset.slots_per_epoch,
+        ).committees_per_slot
+        subnet = topics.compute_subnet_for_attestation(
+            self.spec, int(att.data.slot), int(att.data.index), cps
+        )
+        try:
+            self.chain.process_attestation(
+                att, current_slot=self.clock.current_slot()
+            )
+        except Exception:
+            pass
+        self.gossip.publish(self.att_topics[subnet], att.encode())
+
+
+class Simulator:
+    def __init__(self, n_nodes: int = 3, n_validators: int = 32,
+                 fork: str = "altair"):
+        self.spec = phase0_spec(S.MINIMAL)
+        genesis, self.keypairs = interop_state(
+            n_validators, self.spec, fork=fork
+        )
+        self.router = gossip.GossipRouter()
+        self.nodes = [
+            SimNode(f"node{i}", self.spec, genesis, self.router, fork)
+            for i in range(n_nodes)
+        ]
+        # a driver harness view for producing blocks/attestations with keys
+        self._producer = BeaconChainHarness.__new__(BeaconChainHarness)
+        self._producer.spec = self.spec
+        self._producer.preset = self.spec.preset
+        self._producer.fork = fork
+        self._producer.keypairs = self.keypairs
+
+    def run_slot(self, slot: int) -> None:
+        """One protocol slot: the proposer node builds + gossips a block;
+        every node's committees attest through gossip."""
+        proposer_node = self.nodes[slot % len(self.nodes)]
+        for node in self.nodes:
+            node.clock.set_slot(slot)
+        signed = proposer_node.chain.produce_block(slot, self.keypairs)
+        proposer_node.publish_block(signed)
+        # attest from the proposer node's view (committees are identical)
+        self._producer.chain = proposer_node.chain
+        atts = BeaconChainHarness.make_attestations(self._producer, slot)
+        for att in atts:
+            attester_node = self.nodes[int(att.data.index) % len(self.nodes)]
+            attester_node.publish_attestation(att)
+
+    def run_slots(self, first: int, count: int) -> None:
+        for slot in range(first, first + count):
+            self.run_slot(slot)
+
+    # ---------------------------------------------------------- liveness
+
+    def heads(self) -> list[bytes]:
+        return [n.chain.recompute_head() for n in self.nodes]
+
+    def finalized_epochs(self) -> list[int]:
+        return [n.chain.fork_choice.finalized_checkpoint[0] for n in self.nodes]

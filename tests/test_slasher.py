@@ -1,0 +1,99 @@
+"""Slasher: double votes, double proposals, surround detection both
+directions, pruning (shapes follow slasher/src tests)."""
+
+import pytest
+
+from lighthouse_tpu.consensus.containers import (
+    AttestationData,
+    BeaconBlockHeader,
+    Checkpoint,
+    IndexedAttestation,
+    SignedBeaconBlockHeader,
+)
+from lighthouse_tpu.slasher import Slasher
+
+
+def att(validators, source, target, tag=b"\x00"):
+    return IndexedAttestation(
+        attesting_indices=list(validators),
+        data=AttestationData(
+            slot=target * 8,
+            index=0,
+            beacon_block_root=tag * 32,
+            source=Checkpoint(epoch=source, root=b"\x00" * 32),
+            target=Checkpoint(epoch=target, root=b"\x00" * 32),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def hdr(proposer, slot, tag=b"\x00"):
+    return SignedBeaconBlockHeader(
+        message=BeaconBlockHeader(
+            slot=slot, proposer_index=proposer, body_root=tag * 32
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_no_false_positives_on_clean_stream():
+    s = Slasher()
+    for e in range(1, 10):
+        s.accept_attestation(att([0, 1, 2], e - 1, e))
+    a, p = s.process_queued(10)
+    assert a == [] and p == []
+
+
+def test_double_vote_detected():
+    s = Slasher()
+    s.accept_attestation(att([5], 0, 3, tag=b"\x01"))
+    s.accept_attestation(att([5], 0, 3, tag=b"\x02"))
+    a, _ = s.process_queued(4)
+    assert len(a) == 1
+    assert a[0].attestation_1.data.beacon_block_root == b"\x01" * 32
+
+
+def test_surround_new_surrounds_old():
+    s = Slasher()
+    s.accept_attestation(att([7], 2, 3))
+    s.process_queued(4)
+    s.accept_attestation(att([7], 1, 4))  # surrounds (2,3)
+    a, _ = s.process_queued(5)
+    assert len(a) == 1
+    pair = {(int(x.data.source.epoch), int(x.data.target.epoch))
+            for x in (a[0].attestation_1, a[0].attestation_2)}
+    assert pair == {(2, 3), (1, 4)}
+
+
+def test_surround_old_surrounds_new():
+    s = Slasher()
+    s.accept_attestation(att([9], 1, 6))
+    s.process_queued(7)
+    s.accept_attestation(att([9], 2, 4))  # surrounded by (1,6)
+    a, _ = s.process_queued(7)
+    assert len(a) == 1
+
+
+def test_double_proposal_detected():
+    s = Slasher()
+    s.accept_block_header(hdr(3, 40, tag=b"\x01"))
+    s.accept_block_header(hdr(3, 40, tag=b"\x02"))
+    s.accept_block_header(hdr(3, 41, tag=b"\x03"))  # different slot: fine
+    _, p = s.process_queued(6)
+    assert len(p) == 1
+    assert int(p[0].signed_header_1.message.slot) == 40
+
+
+def test_capacity_growth():
+    s = Slasher()
+    s.accept_attestation(att([5000], 0, 1))
+    a, _ = s.process_queued(2)
+    assert a == [] and s.min_targets.shape[0] > 5000
+
+
+def test_prune_drops_old_records():
+    s = Slasher()
+    s.accept_attestation(att([1], 0, 2))
+    s.process_queued(3)
+    s.prune(finalized_epoch=2)
+    assert not s.records.attestations
