@@ -1,0 +1,140 @@
+"""Eth1 deposit-log ingestion + genesis services.
+
+Twin of beacon_node/eth1 (deposit_cache.rs, block_cache.rs, service.rs) and
+beacon_node/genesis (eth1_genesis_service.rs, interop.rs): an incremental
+deposit cache backed by the consensus DepositTree (proof source for
+process_deposit), eth1-data vote selection over the follow-distance window,
+and genesis triggering once min-genesis conditions are met.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..consensus.containers import Deposit, DepositData, Eth1Data
+from ..consensus.merkle import DepositTree
+from ..consensus.spec import ChainSpec
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+    deposit_count: int
+    deposit_root: bytes
+
+
+class DepositCache:
+    """deposit_cache.rs: every deposit log in order, with proofs."""
+
+    def __init__(self):
+        self.tree = DepositTree()
+        self.deposits: list[DepositData] = []
+
+    def insert_log(self, index: int, data: DepositData) -> None:
+        if index != len(self.deposits):
+            raise ValueError(
+                f"non-contiguous deposit log {index}, have {len(self.deposits)}"
+            )
+        self.deposits.append(data)
+        self.tree.push(data.root())
+
+    def deposit_root(self) -> bytes:
+        return self.tree.root()
+
+    def count(self) -> int:
+        return len(self.deposits)
+
+    def deposits_for_block(self, start_index: int, count: int) -> list[Deposit]:
+        """Build proof-carrying Deposits for inclusion (genesis or block
+        production)."""
+        out = []
+        for i in range(start_index, min(start_index + count, len(self.deposits))):
+            out.append(
+                Deposit(proof=self.tree.proof(i), data=self.deposits[i])
+            )
+        return out
+
+
+class Eth1Service:
+    """service.rs condensed: block cache + deposit cache + the eth1-data
+    vote choice (majority within the voting period, falling back to the
+    follow-distance block)."""
+
+    def __init__(self, spec: ChainSpec):
+        self.spec = spec
+        self.blocks: list[Eth1Block] = []
+        self.deposit_cache = DepositCache()
+
+    def insert_block(self, block: Eth1Block) -> None:
+        self.blocks.append(block)
+
+    def eth1_data_for_vote(self, state) -> Eth1Data:
+        """Pick the eth1 vote: the latest block at follow distance, unless
+        an existing vote within the period already leads."""
+        votes = list(state.eth1_data_votes)
+        if votes:
+            counts: dict[bytes, int] = {}
+            for v in votes:
+                counts[v.root()] = counts.get(v.root(), 0) + 1
+            best_root = max(counts, key=counts.get)
+            for v in votes:
+                if v.root() == best_root and counts[best_root] > len(votes) // 2:
+                    return v
+        if len(self.blocks) > self.spec.eth1_follow_distance:
+            b = self.blocks[-(self.spec.eth1_follow_distance + 1)]
+        elif self.blocks:
+            b = self.blocks[0]
+        else:
+            return state.eth1_data
+        return Eth1Data(
+            deposit_root=b.deposit_root,
+            deposit_count=b.deposit_count,
+            block_hash=b.hash,
+        )
+
+
+def eth1_genesis_state(service: Eth1Service, spec: ChainSpec, fork: str = "base"):
+    """eth1_genesis_service.rs: once min_genesis_active_validator_count
+    valid deposits exist and min_genesis_time passed, build the genesis
+    state by applying every deposit."""
+    from ..consensus.containers import BeaconBlockHeader, Fork, types_for
+    from ..consensus.state_processing.per_block import apply_deposit
+
+    cache = service.deposit_cache
+    if cache.count() < spec.min_genesis_active_validator_count:
+        return None
+    T = types_for(spec.preset)
+    state = T.BeaconState_BY_FORK[fork](
+        genesis_time=spec.min_genesis_time + spec.genesis_delay,
+        fork=Fork(
+            previous_version=spec.genesis_fork_version,
+            current_version=spec.genesis_fork_version,
+        ),
+        latest_block_header=BeaconBlockHeader(),
+        randao_mixes=[
+            service.blocks[-1].hash if service.blocks else bytes(32)
+        ] * spec.preset.epochs_per_historical_vector,
+    )
+    state.eth1_data = Eth1Data(
+        deposit_root=cache.deposit_root(),
+        deposit_count=cache.count(),
+        block_hash=service.blocks[-1].hash if service.blocks else bytes(32),
+    )
+    for dd in cache.deposits:
+        apply_deposit(state, dd, spec)
+        state.eth1_deposit_index += 1
+    # genesis activations: all deposited validators with max balance
+    for v in state.validators:
+        if v.effective_balance == spec.max_effective_balance:
+            v.activation_eligibility_epoch = 0
+            v.activation_epoch = 0
+    gvr_field = type(state)._fields["validators"]
+    state.genesis_validators_root = gvr_field.hash_tree_root(state.validators)
+    if hasattr(state, "previous_epoch_participation"):
+        n = len(state.validators)
+        state.previous_epoch_participation = [0] * n
+        state.current_epoch_participation = [0] * n
+        state.inactivity_scores = [0] * n
+    return state

@@ -1,0 +1,150 @@
+"""Execution-layer boundary: Engine API client, engine watchdog, mock EL.
+
+Twin of beacon_node/execution_layer (Engine-API JSON-RPC client with JWT
+auth src/engine_api/http.rs + auth.rs, engine state machine + watchdog
+src/engines.rs, and the comprehensive mock EL the tests run against,
+src/test_utils/).  The consensus side only needs three verbs —
+new_payload, forkchoice_updated, get_payload — plus health tracking;
+payload VALID/INVALID/SYNCING statuses feed the fork choice's
+execution-status invalidation (proto_array EXEC_* codes).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class PayloadStatus(Enum):
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+
+
+class EngineState(Enum):
+    ONLINE = "online"
+    OFFLINE = "offline"
+    SYNCING = "syncing"
+    AUTH_FAILED = "auth_failed"
+
+
+def jwt_token(secret: bytes, now: float | None = None) -> str:
+    """Engine-API JWT (HS256, iat claim) — auth.rs."""
+    header = base64.urlsafe_b64encode(
+        json.dumps({"alg": "HS256", "typ": "JWT"}).encode()
+    ).rstrip(b"=")
+    claims = base64.urlsafe_b64encode(
+        json.dumps({"iat": int(now or time.time())}).encode()
+    ).rstrip(b"=")
+    signing_input = header + b"." + claims
+    sig = base64.urlsafe_b64encode(
+        hmac.new(secret, signing_input, hashlib.sha256).digest()
+    ).rstrip(b"=")
+    return (signing_input + b"." + sig).decode()
+
+
+class EngineApiClient:
+    """JSON-RPC over HTTP with JWT bearer auth (engine_api/http.rs)."""
+
+    def __init__(self, url: str, jwt_secret: bytes, timeout: float = 8.0):
+        self.url = url
+        self.jwt_secret = jwt_secret
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, params: list) -> dict:
+        self._id += 1
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        ).encode()
+        req = urllib.request.Request(
+            self.url,
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {jwt_token(self.jwt_secret)}",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            out = json.loads(r.read())
+        if "error" in out:
+            raise IOError(f"engine error: {out['error']}")
+        return out["result"]
+
+    def new_payload(self, payload_json: dict) -> PayloadStatus:
+        res = self.call("engine_newPayloadV2", [payload_json])
+        return PayloadStatus(res["status"])
+
+    def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes,
+                           payload_attributes: dict | None = None) -> dict:
+        state = {
+            "headBlockHash": "0x" + head.hex(),
+            "safeBlockHash": "0x" + safe.hex(),
+            "finalizedBlockHash": "0x" + finalized.hex(),
+        }
+        return self.call(
+            "engine_forkchoiceUpdatedV2", [state, payload_attributes]
+        )
+
+
+class MockExecutionEngine:
+    """In-process EL double (execution_layer/src/test_utils analog): serves
+    the three verbs directly (no HTTP), with fault injection — mark block
+    hashes INVALID to drive the payload-invalidation path
+    (beacon_chain/tests/payload_invalidation.rs pattern)."""
+
+    def __init__(self):
+        self.invalid_hashes: set[bytes] = set()
+        self.syncing = False
+        self.calls: list[tuple[str, object]] = []
+        self._head: bytes = b"\x00" * 32
+
+    def inject_invalid(self, block_hash: bytes) -> None:
+        self.invalid_hashes.add(block_hash)
+
+    def new_payload(self, block_hash: bytes) -> PayloadStatus:
+        self.calls.append(("new_payload", block_hash))
+        if self.syncing:
+            return PayloadStatus.SYNCING
+        if block_hash in self.invalid_hashes:
+            return PayloadStatus.INVALID
+        return PayloadStatus.VALID
+
+    def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes):
+        self.calls.append(("forkchoice_updated", head))
+        self._head = head
+        return {"payloadStatus": {"status": "VALID"}, "payloadId": "0x01"}
+
+
+@dataclass
+class EngineWatchdog:
+    """Engine health state machine (engines.rs): periodic upcheck flips
+    ONLINE/OFFLINE/SYNCING; consumers gate optimistic import on it."""
+
+    engine: object
+    state: EngineState = EngineState.OFFLINE
+    consecutive_failures: int = 0
+    failure_threshold: int = 3
+    history: list = field(default_factory=list)
+
+    def upcheck(self) -> EngineState:
+        try:
+            status = self.engine.new_payload(b"\x00" * 32)
+            if status == PayloadStatus.SYNCING:
+                self.state = EngineState.SYNCING
+            else:
+                self.state = EngineState.ONLINE
+            self.consecutive_failures = 0
+        except Exception:
+            self.consecutive_failures += 1
+            if self.consecutive_failures >= self.failure_threshold:
+                self.state = EngineState.OFFLINE
+        self.history.append(self.state)
+        return self.state

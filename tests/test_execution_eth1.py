@@ -1,0 +1,145 @@
+"""Execution-layer boundary + eth1/genesis: JWT auth, watchdog state
+machine, payload invalidation into fork choice, deposit cache proofs,
+eth1 vote selection, eth1-genesis construction."""
+
+import pytest
+
+from lighthouse_tpu.beacon.eth1 import (
+    DepositCache,
+    Eth1Block,
+    Eth1Service,
+    eth1_genesis_state,
+)
+from lighthouse_tpu.beacon.execution import (
+    EngineState,
+    EngineWatchdog,
+    MockExecutionEngine,
+    PayloadStatus,
+    jwt_token,
+)
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.containers import DepositData, DepositMessage
+from lighthouse_tpu.consensus.merkle import verify_merkle_proof
+from lighthouse_tpu.consensus.testing import interop_keypairs, phase0_spec
+
+
+def test_jwt_token_shape():
+    tok = jwt_token(b"\x11" * 32, now=1700000000)
+    parts = tok.split(".")
+    assert len(parts) == 3
+    import base64, json
+
+    claims = json.loads(base64.urlsafe_b64decode(parts[1] + "=="))
+    assert claims == {"iat": 1700000000}
+
+
+def test_mock_engine_and_watchdog():
+    el = MockExecutionEngine()
+    wd = EngineWatchdog(engine=el)
+    assert wd.upcheck() == EngineState.ONLINE
+    el.syncing = True
+    assert wd.upcheck() == EngineState.SYNCING
+    el.syncing = False
+    el.inject_invalid(b"\xbb" * 32)
+    assert el.new_payload(b"\xbb" * 32) == PayloadStatus.INVALID
+    assert el.new_payload(b"\xcc" * 32) == PayloadStatus.VALID
+
+
+def test_invalid_payload_flows_into_fork_choice():
+    """The INVALID status drives proto-array invalidation (the
+    payload_invalidation.rs pattern)."""
+    import numpy as np
+
+    from lighthouse_tpu.consensus.fork_choice import ForkChoice
+    from lighthouse_tpu.consensus.fork_choice.proto_array import (
+        Block,
+        EXEC_OPTIMISTIC,
+    )
+
+    spec = phase0_spec(S.MINIMAL)
+    el = MockExecutionEngine()
+
+    def blk(r, p, s, h):
+        b = Block(slot=s, root=r, parent_root=p, state_root=b"\x00" * 32,
+                  justified_epoch=0, finalized_epoch=0,
+                  execution_block_hash=h, execution_status=EXEC_OPTIMISTIC)
+        return b
+
+    fc = ForkChoice(spec, Block(0, b"\x00" * 32, None, b"\x00" * 32, 0, 0))
+    fc.proto.blocks[0].root = b"\x00" * 32
+    fc.on_block(blk(b"\x01" * 32, b"\x00" * 32, 1, b"\xe1" * 32))
+    fc.on_block(blk(b"\x02" * 32, b"\x00" * 32, 1, b"\xe2" * 32))
+    el.inject_invalid(b"\xe1" * 32)
+    # the EL verdict arrives: invalidate the subtree
+    if el.new_payload(b"\xe1" * 32) == PayloadStatus.INVALID:
+        fc.proto.propagate_execution_invalidation(b"\x01" * 32)
+    head = fc.get_head(np.array([32], dtype=np.int64))
+    assert head == b"\x02" * 32
+
+
+def _deposit(i, spec):
+    sk = interop_keypairs(i + 1)[i][0]
+    dd = DepositData(
+        pubkey=sk.public_key().to_bytes(),
+        withdrawal_credentials=b"\x00" * 32,
+        amount=spec.max_effective_balance,
+    )
+    msg = DepositMessage(
+        pubkey=dd.pubkey,
+        withdrawal_credentials=dd.withdrawal_credentials,
+        amount=dd.amount,
+    )
+    domain = S.compute_domain(S.DOMAIN_DEPOSIT, spec.genesis_fork_version, bytes(32))
+    dd.signature = sk.sign(S.compute_signing_root(msg, domain)).to_bytes()
+    return dd
+
+
+def test_deposit_cache_proofs():
+    spec = phase0_spec(S.MINIMAL)
+    cache = DepositCache()
+    for i in range(4):
+        cache.insert_log(i, _deposit(i, spec))
+    with pytest.raises(ValueError, match="non-contiguous"):
+        cache.insert_log(9, _deposit(5, spec))
+    root = cache.deposit_root()
+    deps = cache.deposits_for_block(0, 4)
+    for i, dep in enumerate(deps):
+        assert verify_merkle_proof(
+            dep.data.root(), [bytes(p) for p in dep.proof], 33, i, root
+        )
+
+
+def test_eth1_vote_selection():
+    spec = phase0_spec(S.MINIMAL)
+    svc = Eth1Service(spec)
+    for n in range(spec.eth1_follow_distance + 5):
+        svc.insert_block(
+            Eth1Block(number=n, hash=bytes([n % 256]) * 32, timestamp=n,
+                      deposit_count=0, deposit_root=b"\x00" * 32)
+        )
+    from lighthouse_tpu.consensus.containers import types_for
+
+    state = types_for(spec.preset).BeaconState()
+    vote = svc.eth1_data_for_vote(state)
+    assert vote.block_hash == bytes([4]) * 32  # follow distance back
+
+
+@pytest.mark.slow
+def test_eth1_genesis_from_deposits():
+    import dataclasses
+
+    spec = dataclasses.replace(
+        phase0_spec(S.MINIMAL), min_genesis_active_validator_count=8
+    )
+    svc = Eth1Service(spec)
+    for i in range(8):
+        svc.deposit_cache.insert_log(i, _deposit(i, spec))
+    svc.insert_block(
+        Eth1Block(number=0, hash=b"\x42" * 32, timestamp=0,
+                  deposit_count=8, deposit_root=svc.deposit_cache.deposit_root())
+    )
+    state = eth1_genesis_state(svc, spec)
+    assert state is not None
+    assert len(state.validators) == 8
+    assert all(v.activation_epoch == 0 for v in state.validators)
+    assert state.eth1_data.deposit_count == 8
