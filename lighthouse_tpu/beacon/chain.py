@@ -150,15 +150,20 @@ class BeaconChain:
 
     # -------------------------------------------------------- block import
 
-    def process_block(self, signed_block, verify_signatures: bool = True) -> bytes:
+    def process_block(self, signed_block, verify_signatures: bool = True,
+                      from_rpc: bool = False) -> bytes:
         """The full ladder (block_verification.rs:20-44):
         SignedBeaconBlock -> gossip checks -> bulk signature verify ->
         state transition -> fork choice + store import.  Returns the block
-        root."""
+        root.  ``from_rpc``: sync/RPC imports skip the gossip-tier clock
+        check (the reference's gossip vs rpc block entry distinction)."""
         with BLOCK_TIMES.timer():
-            return self._process_block_inner(signed_block, verify_signatures)
+            return self._process_block_inner(
+                signed_block, verify_signatures, from_rpc
+            )
 
-    def _process_block_inner(self, signed_block, verify_signatures) -> bytes:
+    def _process_block_inner(self, signed_block, verify_signatures,
+                             from_rpc=False) -> bytes:
         block = signed_block.message
         block_root = block.root()
         # --- gossip-tier structural checks ---------------------------------
@@ -167,7 +172,7 @@ class BeaconChain:
         parent_state = self._states.get(bytes(block.parent_root))
         if parent_state is None:
             raise BlockError(f"unknown parent {bytes(block.parent_root).hex()}")
-        if self.slot_clock is not None:
+        if self.slot_clock is not None and not from_rpc:
             if block.slot > self.slot_clock.current_slot() + 1:
                 raise BlockError("block from the future")
         # --- advance parent state to the block's slot ----------------------

