@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Instrumented state-transition benchmark — the `lcli transition_blocks`
+analog (lcli/src/transition_blocks.rs:99,314-401: times cache build, tree
+hash, slot processing, batch signature verify, block processing).
+
+Builds an interop state (default: BASELINE config 2's 128-validator minimal
+state), produces a fully-loaded signed block (attestations from every
+committee), and reports per-phase timings as JSON.
+
+Usage: python tools/transition_bench.py [--validators 128] [--backend python|jax]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=128)
+    ap.add_argument("--backend", default="python", choices=["python", "jax", "fake"])
+    ap.add_argument("--spec", default="minimal", choices=["minimal", "mainnet"])
+    args = ap.parse_args()
+
+    if args.backend == "jax":
+        # CPU mesh unless the relay is healthy; the TPU path is bench.py's job
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+
+    from lighthouse_tpu.beacon import BeaconChainHarness
+    from lighthouse_tpu.consensus import spec as S
+    from lighthouse_tpu.consensus.state_processing.block_signature_verifier import (
+        BlockSignatureVerifier,
+    )
+    from lighthouse_tpu.consensus.state_processing.per_block import process_block
+    from lighthouse_tpu.consensus.state_processing.per_slot import process_slots
+    from lighthouse_tpu.consensus import committees as cm
+    from lighthouse_tpu.consensus.testing import phase0_spec, pubkey_getter
+    from lighthouse_tpu.crypto.bls import api as bls
+
+    if args.backend != "python":
+        bls.set_backend(args.backend)
+
+    timings: dict[str, float] = {}
+
+    def timed(name):
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *a):
+                timings[name] = round(time.perf_counter() - self.t0, 4)
+
+        return _T()
+
+    spec = phase0_spec(S.PRESETS[args.spec])
+    with timed("harness_setup"):
+        h = BeaconChainHarness(n_validators=args.validators, spec=spec)
+        h.extend_chain(2)
+
+    slot = int(h.head_state().slot) + 1
+    h.set_slot(slot - 1)
+    h.attest_to_head(slot - 1)
+    with timed("block_production"):
+        signed = h.chain.produce_block(slot, h.keypairs)
+
+    state = h.head_state().copy()
+    with timed("committee_cache_build"):
+        cache = cm.CommitteeCache(state, slot // spec.preset.slots_per_epoch,
+                                  spec.preset)
+    with timed("per_slot_processing"):
+        process_slots(state, slot, spec)
+    with timed("tree_hash_state_root"):
+        state.root()
+    with timed("batch_signature_verify"):
+        v = BlockSignatureVerifier(state, pubkey_getter(state), spec)
+        v.include_all(signed, lambda e: cache)
+        ok = v.verify()
+    n_sets = len(v.sets)
+    with timed("per_block_processing"):
+        process_block(state, signed, spec, committee_cache=cache,
+                      verify_signatures=False)
+
+    print(
+        json.dumps(
+            {
+                "validators": args.validators,
+                "backend": args.backend,
+                "spec": args.spec,
+                "block_attestations": len(signed.message.body.attestations),
+                "signature_sets": n_sets,
+                "signatures_valid": bool(ok),
+                "timings_sec": timings,
+                "sets_per_sec_signature_verify": round(
+                    n_sets / timings["batch_signature_verify"], 1
+                )
+                if timings["batch_signature_verify"]
+                else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
