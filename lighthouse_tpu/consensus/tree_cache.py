@@ -1,0 +1,77 @@
+"""Incremental Merkle (tree-hash) caching for large state fields.
+
+Twin of consensus/cached_tree_hash (`TreeHashCache`): recomputing a
+1M-validator registry root from scratch is ~2M hashes; between two slots
+only a handful of validators change, so the cache retains every tree level
+and rehashes just the dirty root-paths (batched per level — the same wide
+SHA passes the full merkleizer uses, over far fewer nodes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import sha256_many
+from .ssz import BYTES_PER_CHUNK, _mix_in_length, _zero_hashes
+
+
+class ListTreeHashCache:
+    """Cache for an SSZ List's chunk tree (limit fixed at construction).
+
+    `update(i, chunk)` marks a leaf dirty; `root(length)` rehashes dirty
+    paths level by level and mixes in the length.
+    """
+
+    def __init__(self, limit_chunks: int):
+        self.depth = max(limit_chunks - 1, 0).bit_length()
+        self.levels: list[dict[int, bytes]] = [dict() for _ in range(self.depth + 1)]
+        self._dirty: set[int] = set()
+        self._root: bytes | None = None
+
+    # ------------------------------------------------------------- leaves
+
+    def set_leaf(self, index: int, chunk: bytes) -> None:
+        assert len(chunk) == BYTES_PER_CHUNK
+        lvl = self.levels[0]
+        if lvl.get(index) != chunk:
+            lvl[index] = chunk
+            self._dirty.add(index)
+            self._root = None
+
+    def bulk_load(self, chunks: list[bytes]) -> None:
+        """(Re)load the whole leaf set; any prior contents are discarded
+        (a stale interior node or leaf would silently poison the root)."""
+        self.levels = [dict() for _ in range(self.depth + 1)]
+        for i, c in enumerate(chunks):
+            self.levels[0][i] = c
+        self._dirty = set(range(len(chunks)))
+        self._root = None
+
+    # -------------------------------------------------------------- root
+
+    def _node(self, level: int, index: int) -> bytes:
+        return self.levels[level].get(index, _zero_hashes[level])
+
+    def root(self, length: int) -> bytes:
+        if self._root is None:
+            dirty = self._dirty
+            for level in range(self.depth):
+                parents = {i >> 1 for i in dirty}
+                if not parents:
+                    break
+                plist = sorted(parents)
+                pairs = np.frombuffer(
+                    b"".join(
+                        self._node(level, 2 * p) + self._node(level, 2 * p + 1)
+                        for p in plist
+                    ),
+                    dtype=np.uint8,
+                ).reshape(len(plist), 2 * BYTES_PER_CHUNK)
+                hashed = sha256_many(pairs)
+                nxt = self.levels[level + 1]
+                for j, p in enumerate(plist):
+                    nxt[p] = hashed[j].tobytes()
+                dirty = parents
+            self._dirty = set()
+            self._root = self._node(self.depth, 0)
+        return _mix_in_length(self._root, length)

@@ -171,6 +171,13 @@ def _aggregate_verify_kernel(pk_aff, h_aff, sig_aff):
     return ok_pair & ok_sub
 
 
+def _pack_wbits(weights: list[int]) -> np.ndarray:
+    """(64, B) MSB-first weight bits, vectorized (was a 64xB Python loop)."""
+    w = np.array(weights, dtype=np.uint64)
+    shifts = np.arange(63, -1, -1, dtype=np.uint64)[:, None]
+    return ((w[None, :] >> shifts) & np.uint64(1)).astype(np.uint32)
+
+
 def _neg_gen_const():
     """-G1 generator as a batch-1 device constant."""
     ng = affine_neg(G1_GENERATOR)
@@ -246,12 +253,17 @@ class JaxBackend:
                 return False
             if not s.signing_keys:
                 return False
-            # Aggregate the set's pubkeys host-side (cheap affine adds over
-            # cached decompressed keys — the ValidatorPubkeyCache analog).
-            acc = to_jacobian(None, Fp)
-            for pk in s.signing_keys:
-                acc = jac_add(acc, to_jacobian(pk.point, Fp), Fp)
-            agg = from_jacobian(acc, Fp)
+            if len(s.signing_keys) == 1:
+                # the dominant gossip case: nothing to aggregate
+                agg = s.signing_keys[0].point
+            else:
+                # Aggregate the set's pubkeys host-side (cheap affine adds
+                # over cached decompressed keys — the ValidatorPubkeyCache
+                # analog).
+                acc = to_jacobian(None, Fp)
+                for pk in s.signing_keys:
+                    acc = jac_add(acc, to_jacobian(pk.point, Fp), Fp)
+                agg = from_jacobian(acc, Fp)
             if agg is None:
                 return False
             h = hash_to_g2(s.message)
@@ -278,12 +290,9 @@ class JaxBackend:
         pk_aff = P.g1_encode(pk_pts)
         sig_aff = P.g2_encode(sig_pts)
         h_aff = P.g2_encode(h_pts)
-        wbits = np.zeros((64, B), dtype=np.uint32)
-        for j, r in enumerate(weights):
-            for i in range(64):
-                wbits[i, j] = (r >> (63 - i)) & 1
+        wbits = _pack_wbits(weights)
 
-        ok = self._kernel(B)(pk_aff, sig_aff, h_aff, np.asarray(wbits))
+        ok = self._kernel(B)(pk_aff, sig_aff, h_aff, wbits)
         return bool(ok)
 
     def _padded_size(self, n: int) -> int:

@@ -205,7 +205,9 @@ class BeaconApiServer:
             return
         if path.startswith("/eth/v1/beacon/headers"):
             root = self._resolve_block_root(path.split("/")[-1])
-            blk = chain.store.get_block(root)
+            blk = chain.store.get_block(
+                root, chain.types.SignedBeaconBlock_BY_FORK[chain.fork_name]
+            )
             if blk is None:
                 raise KeyError("block not found")
             msg = blk.message
@@ -232,7 +234,9 @@ class BeaconApiServer:
             return
         if path.startswith("/eth/v2/beacon/blocks/"):
             root = self._resolve_block_root(path.split("/")[-1])
-            blk = chain.store.get_block(root)
+            blk = chain.store.get_block(
+                root, chain.types.SignedBeaconBlock_BY_FORK[chain.fork_name]
+            )
             if blk is None:
                 raise KeyError("block not found")
             h._send(
@@ -280,6 +284,11 @@ class BeaconApiServer:
                 if isinstance(v, int):
                     flat[f.name.upper()] = str(v)
             h._send(200, {"data": flat})
+            return
+        if path.startswith("/eth/v2/debug/beacon/states/"):
+            state = self._resolve_state(path.split("/")[-1])
+            h._send(200, None, raw=state.encode(),
+                    content_type="application/octet-stream")
             return
         if path == "/metrics":
             h._send(200, None, raw=render_metrics().encode(),
@@ -348,6 +357,11 @@ class BeaconApiServer:
     def _resolve_block_root(self, block_id: str) -> bytes:
         if block_id == "head":
             return self.chain.head_root
+        if block_id == "finalized":
+            root = self.chain.fork_choice.finalized_checkpoint[1]
+            if root == self.chain.genesis_block_root or root in self.chain._states:
+                return root
+            return root
         if block_id == "genesis":
             return self.chain.genesis_block_root
         if block_id.startswith("0x"):
@@ -420,6 +434,13 @@ class BeaconApiClient:
 
     def get_block_json(self, block_id: str = "head") -> dict:
         return self._get(f"/eth/v2/beacon/blocks/{block_id}")
+
+    def get_state_ssz(self, state_id: str = "finalized") -> bytes:
+        with urllib.request.urlopen(
+            self.base + f"/eth/v2/debug/beacon/states/{state_id}",
+            timeout=self.timeout,
+        ) as r:
+            return r.read()
 
     def proposer_duties(self, epoch: int) -> list[dict]:
         return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]

@@ -1,0 +1,48 @@
+"""Incremental tree-hash cache vs from-scratch merkleization."""
+
+import time
+
+from lighthouse_tpu.consensus.ssz import SSZList, U64
+from lighthouse_tpu.consensus.tree_cache import ListTreeHashCache
+
+
+def _balances_chunks(balances):
+    data = b"".join(int(b).to_bytes(8, "little") for b in balances)
+    if len(data) % 32:
+        data += b"\x00" * (32 - len(data) % 32)
+    return [data[i : i + 32] for i in range(0, len(data), 32)]
+
+
+def test_matches_full_merkleization():
+    limit = 2**40
+    per_chunk = 4  # uint64s per 32-byte chunk
+    lst = SSZList(U64, limit)
+    balances = [32_000_000_000 + i for i in range(1000)]
+    cache = ListTreeHashCache((limit + per_chunk - 1) // per_chunk)
+    cache.bulk_load(_balances_chunks(balances))
+    assert cache.root(len(balances)) == lst.hash_tree_root(balances)
+    # mutate a few entries: cache root must track the full recompute
+    balances[17] += 5
+    balances[998] -= 9
+    chunks = _balances_chunks(balances)
+    cache.set_leaf(17 // 4, chunks[17 // 4])
+    cache.set_leaf(998 // 4, chunks[998 // 4])
+    assert cache.root(len(balances)) == lst.hash_tree_root(balances)
+
+
+def test_incremental_is_cheaper():
+    limit_chunks = 2**18
+    cache = ListTreeHashCache(limit_chunks)
+    chunks = [i.to_bytes(32, "little") for i in range(100_000)]
+    cache.bulk_load(chunks)
+    cache.root(400_000)
+    t0 = time.perf_counter()
+    cache.set_leaf(12345, b"\xaa" * 32)
+    cache.root(400_000)
+    dt_inc = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cache2 = ListTreeHashCache(limit_chunks)
+    cache2.bulk_load(chunks)
+    cache2.root(400_000)
+    dt_full = time.perf_counter() - t0
+    assert dt_inc < dt_full / 50  # one dirty path vs the whole tree
