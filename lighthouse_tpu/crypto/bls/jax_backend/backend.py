@@ -172,10 +172,15 @@ def _aggregate_verify_kernel(pk_aff, h_aff, sig_aff):
 
 
 def _pack_wbits(weights: list[int]) -> np.ndarray:
-    """(64, B) MSB-first weight bits, vectorized (was a 64xB Python loop)."""
-    w = np.array(weights, dtype=np.uint64)
-    shifts = np.arange(63, -1, -1, dtype=np.uint64)[:, None]
-    return ((w[None, :] >> shifts) & np.uint64(1)).astype(np.uint32)
+    """(64, B) MSB-first weight bits, vectorized (was a 64xB Python loop).
+    Ingested as two uint32 halves: numpy rejects Python ints >= 2^63 when
+    building a uint64 array directly."""
+    w_hi = np.array([(w >> 32) & 0xFFFFFFFF for w in weights], dtype=np.uint32)
+    w_lo = np.array([w & 0xFFFFFFFF for w in weights], dtype=np.uint32)
+    shifts = np.arange(31, -1, -1, dtype=np.uint32)[:, None]
+    hi_bits = (w_hi[None, :] >> shifts) & np.uint32(1)
+    lo_bits = (w_lo[None, :] >> shifts) & np.uint32(1)
+    return np.concatenate([hi_bits, lo_bits], axis=0)
 
 
 def _neg_gen_const():
