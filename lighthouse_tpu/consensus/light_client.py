@@ -1,0 +1,151 @@
+"""Light-client sync protocol: bootstrap/update containers + branch proofs.
+
+Twin of the reference's light-client surface (consensus/types light-client
+containers; beacon_node light-client server feeding the
+light_client_{finality,optimistic}_update gossip topics in topics.rs).
+
+Proof machinery: a container's fields form the leaves of its Merkle tree
+(padded to a power of two), so any field has a generalized index
+``2^depth + field_index``; `field_proof` produces the branch and
+`verify_merkle_proof` (consensus.merkle) checks it — e.g. the altair
+BeaconState's next_sync_committee sits at gindex 55 (field 23 of 24, depth
+5), matching the spec constant because the field ORDER here matches the
+spec order.
+"""
+
+from __future__ import annotations
+
+from .containers import BeaconBlockHeader, Container, F
+from .merkle import merkle_root_from_branch
+from .ssz import SSZList, U64, Vector, _merkleize_chunks, _zero_hashes
+
+
+def _field_roots(obj) -> list[bytes]:
+    cls = type(obj)
+    return [t.hash_tree_root(getattr(obj, f)) for f, t in cls._fields.items()]
+
+
+def field_index(cls, field_name: str) -> int:
+    return list(cls._fields).index(field_name)
+
+
+def field_gindex(cls, field_name: str) -> int:
+    n = len(cls._fields)
+    depth = max(n - 1, 0).bit_length()
+    return (1 << depth) + field_index(cls, field_name)
+
+
+def field_proof(obj, field_name: str) -> tuple[bytes, list[bytes], int]:
+    """(leaf_root, branch, depth) for one field of a container instance.
+    Branch is bottom-up, suitable for merkle.verify_merkle_proof with
+    index = field_index."""
+    cls = type(obj)
+    leaves = _field_roots(obj)
+    n = len(leaves)
+    depth = max(n - 1, 0).bit_length()
+    size = 1 << depth
+    nodes = leaves + [_zero_hashes[0]] * (size - n)
+    idx = field_index(cls, field_name)
+    branch: list[bytes] = []
+    from ..ops import sha256
+
+    level_nodes = nodes
+    i = idx
+    for level in range(depth):
+        sibling = i ^ 1
+        branch.append(
+            level_nodes[sibling]
+            if sibling < len(level_nodes)
+            else _zero_hashes[level]
+        )
+        level_nodes = [
+            sha256(level_nodes[2 * k] + level_nodes[2 * k + 1])
+            for k in range(len(level_nodes) // 2)
+        ]
+        i //= 2
+    return leaves[idx], branch, depth
+
+
+# ---------------------------------------------------------------------------
+# containers (per-preset family would only vary SyncCommittee size; built
+# against a supplied types family)
+# ---------------------------------------------------------------------------
+
+
+class LightClientHeader(Container):
+    fields = {
+        "beacon": F(BeaconBlockHeader),
+    }
+
+
+def light_client_types(T):
+    """Build the preset-shaped light-client containers over a TypesFamily."""
+
+    class LightClientBootstrap(Container):
+        fields = {
+            "header": F(LightClientHeader),
+            "current_sync_committee": F(T.SyncCommittee),
+            "current_sync_committee_branch": SSZList(
+                __import__(
+                    "lighthouse_tpu.consensus.containers", fromlist=["Root"]
+                ).Root,
+                16,
+            ),
+        }
+
+    class LightClientUpdate(Container):
+        fields = {
+            "attested_header": F(LightClientHeader),
+            "next_sync_committee": F(T.SyncCommittee),
+            "next_sync_committee_branch": SSZList(
+                __import__(
+                    "lighthouse_tpu.consensus.containers", fromlist=["Root"]
+                ).Root,
+                16,
+            ),
+            "finalized_header": F(LightClientHeader),
+            "finality_branch": SSZList(
+                __import__(
+                    "lighthouse_tpu.consensus.containers", fromlist=["Root"]
+                ).Root,
+                16,
+            ),
+            "sync_aggregate": F(T.SyncAggregate),
+            "signature_slot": U64,
+        }
+
+    return LightClientBootstrap, LightClientUpdate
+
+
+# ---------------------------------------------------------------------------
+# server + verifier
+# ---------------------------------------------------------------------------
+
+
+def build_bootstrap(state, header: BeaconBlockHeader, T):
+    """The light-client server half: prove current_sync_committee into the
+    state root the header commits to."""
+    Bootstrap, _ = light_client_types(T)
+    leaf, branch, depth = field_proof(state, "current_sync_committee")
+    return Bootstrap(
+        header=LightClientHeader(beacon=header),
+        current_sync_committee=state.current_sync_committee,
+        current_sync_committee_branch=branch,
+    )
+
+
+def verify_bootstrap(bootstrap, T) -> bool:
+    """Client half: the committee must prove into the header's state root."""
+    state_cls = T.BeaconState_BY_FORK["altair"]
+    idx = field_index(state_cls, "current_sync_committee")
+    depth = max(len(state_cls._fields) - 1, 0).bit_length()
+    leaf = T.SyncCommittee.hash_tree_root_value(
+        bootstrap.current_sync_committee
+    )
+    root = merkle_root_from_branch(
+        leaf,
+        [bytes(b) for b in bootstrap.current_sync_committee_branch],
+        depth,
+        idx,
+    )
+    return root == bytes(bootstrap.header.beacon.state_root)

@@ -1,0 +1,43 @@
+"""Light-client: field proofs (incl. the spec gindex-55 identity for
+next_sync_committee), bootstrap build/verify round trip, tamper rejection."""
+
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.light_client import (
+    build_bootstrap,
+    field_gindex,
+    field_proof,
+    verify_bootstrap,
+)
+from lighthouse_tpu.consensus.containers import BeaconBlockHeader, types_for
+from lighthouse_tpu.consensus.merkle import verify_merkle_proof
+from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+
+
+def test_altair_state_gindices_match_spec():
+    T = types_for(S.MINIMAL)
+    cls = T.BeaconState_BY_FORK["altair"]
+    # spec constants: CURRENT_SYNC_COMMITTEE_GINDEX=54, NEXT=55, FINALIZED_ROOT=105
+    assert field_gindex(cls, "current_sync_committee") == 54
+    assert field_gindex(cls, "next_sync_committee") == 55
+    assert field_gindex(cls, "finalized_checkpoint") * 2 + 1 == 105  # .root leaf
+
+
+def test_field_proof_verifies_against_state_root():
+    spec = phase0_spec(S.MINIMAL)
+    state, _ = interop_state(16, spec, fork="altair")
+    leaf, branch, depth = field_proof(state, "next_sync_committee")
+    cls = type(state)
+    idx = list(cls._fields).index("next_sync_committee")
+    assert verify_merkle_proof(leaf, branch, depth, idx, state.root())
+
+
+def test_bootstrap_roundtrip_and_tamper():
+    spec = phase0_spec(S.MINIMAL)
+    state, _ = interop_state(16, spec, fork="altair")
+    T = types_for(S.MINIMAL)
+    header = BeaconBlockHeader(slot=0, state_root=state.root())
+    bootstrap = build_bootstrap(state, header, T)
+    assert verify_bootstrap(bootstrap, T) is True
+    # tamper with the committee: proof must fail
+    bootstrap.current_sync_committee.aggregate_pubkey = b"\xc0" + b"\x00" * 47
+    assert verify_bootstrap(bootstrap, T) is False
