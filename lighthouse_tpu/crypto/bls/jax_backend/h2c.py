@@ -1,0 +1,251 @@
+"""Device-side map-to-curve: SSWU + 3-isogeny + cofactor clearing in JAX.
+
+The round-2/3 profile showed host hash_to_curve is THE end-to-end
+bottleneck (~30 ms of Python bigint math per set caps the pipeline at
+~30 sets/s/core while the device kernel scales with batch).  This module
+moves everything after the SHA-256 expansion onto the batch axis:
+
+    host:   expand_message_xmd (hashlib; ~10 us) -> u0, u1 in Fp2
+    device: SSWU map (branchless, constant-exponent sqrt candidates),
+            derived 3-isogeny, Jacobian add, Budroni-Pintore cofactor
+            clearing via the psi endomorphism
+
+Math follows RFC 9380 §6.6.2 (simplified SWU) with the q ≡ 9 (mod 16)
+square-root method of appendix F (candidate roots t^((q+7)/16) · {1, c1,
+c2, c3} with c1 = sqrt(-1), c2 = sqrt(c1), c3 = sqrt(-c1)) — the same
+pipeline the host oracle implements (hash_to_curve.py), differentially
+tested against it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import params
+from ..fields import Fp2 as OFp2
+from ..hash_to_curve import A_PRIME, B_PRIME, Z
+from .. import g2_isogeny
+from . import fp as F
+from . import points as P
+from . import tower as T
+
+_P2 = params.P * params.P
+SQRT_EXP = (_P2 + 7) // 16
+_SQRT_EXP_BITS = [int(b) for b in bin(SQRT_EXP)[2:]]
+
+# sqrt candidate constants (oracle-computed at import, self-checked)
+_C1 = OFp2(0, 1)  # sqrt(-1): u^2 = -1 in Fp[u]/(u^2+1)
+assert _C1.square() == OFp2(-1 % params.P, 0)
+_C2 = _C1.sqrt()
+_C3 = (-_C1).sqrt()
+assert _C2 is not None and _C3 is not None
+assert _C2.square() == _C1 and _C3.square() == -_C1
+
+# SSWU selection constants
+_NEG_B_OVER_A = (-B_PRIME) * A_PRIME.inv()
+_B_OVER_ZA = B_PRIME * (Z * A_PRIME).inv()
+
+_ISO_X_NUM = [OFp2(c0, c1) for c0, c1 in g2_isogeny.X_NUM]
+_ISO_X_DEN = [OFp2(c0, c1) for c0, c1 in g2_isogeny.X_DEN]
+_ISO_Y_NUM = [-OFp2(c0, c1) for c0, c1 in g2_isogeny.Y_NUM]
+_ISO_Y_DEN = [OFp2(c0, c1) for c0, c1 in g2_isogeny.Y_DEN]
+
+_X_ABS_BITS = [int(c) for c in bin(abs(params.X))[2:]]
+assert params.X < 0  # BLS12-381: the BLS parameter is negative
+
+
+def _stable(a):
+    """Reduce both coords to the stable bound class (scan-carry safe)."""
+    return (F.relabel(F.guard_le(a[0], 2.0), 2.0), F.relabel(F.guard_le(a[1], 2.0), 2.0))
+
+
+def fp2_pow_static(a, bits: list[int]):
+    """a^e for a static exponent (MSB-first bits), batched."""
+    a = _stable(a)
+    bit_arr = jnp.array(bits, dtype=jnp.uint32)
+
+    def step(acc, bit):
+        acc = _stable(T.fp2_sqr(acc))
+        withmul = _stable(T.fp2_mul(acc, a))
+        out = T.fp2_select(bit == 1, withmul, acc)
+        out = (F.relabel(out[0], 2.0), F.relabel(out[1], 2.0))
+        return out, None
+
+    one = tuple(F.relabel(c, 2.0) for c in T.fp2_one_like(a))
+    acc, _ = lax.scan(step, one, bit_arr)
+    return acc
+
+
+def fp2_sqrt_or_flag(gx):
+    """(y, is_square): y^2 == gx where is_square, via the q ≡ 9 (mod 16)
+    candidate method — ONE big exponentiation + three constant muls."""
+    gx = _stable(gx)
+    bshape = F.batch_shape(gx[0])
+    t = fp2_pow_static(gx, _SQRT_EXP_BITS)
+    cands = [t]
+    for c in (_C1, _C2, _C3):
+        cc = T.fp2_const(c, bshape)
+        cands.append(T.fp2_mul(t, cc))
+    y = cands[0]
+    ok = T.fp2_eq(T.fp2_sqr(cands[0]), gx)
+    for cand in cands[1:]:
+        match = T.fp2_eq(T.fp2_sqr(cand), gx)
+        y = T.fp2_select(match & ~ok, cand, y)
+        ok = ok | match
+    return _stable(y), ok
+
+
+def fp2_sgn0(a):
+    """RFC 9380 sgn0 for Fp2: parity of c0, tie-broken by c1 when c0 = 0."""
+    c0 = F.fp_canon(a[0])
+    c1 = F.fp_canon(a[1])
+    c0_zero = jnp.all(c0 == 0, axis=0)
+    return jnp.where(c0_zero, c1[0] & 1, c0[0] & 1)
+
+
+def _gx(x, A, B):
+    """x^3 + A x + B on the auxiliary curve."""
+    x2 = T.fp2_sqr(x)
+    (x3,) = T.fp2_mul_many([x2], [x])
+    (ax,) = T.fp2_mul_many([A], [x])
+    return T.fp2_add(T.fp2_add(x3, ax), B)
+
+
+def sswu_g2(u):
+    """Batched branchless simplified-SWU onto E' (affine)."""
+    u = _stable(u)
+    bshape = F.batch_shape(u[0])
+    Zc = T.fp2_const(Z, bshape)
+    Ac = T.fp2_const(A_PRIME, bshape)
+    Bc = T.fp2_const(B_PRIME, bshape)
+    (u2,) = [T.fp2_sqr(u)]
+    (tv,) = T.fp2_mul_many([Zc], [u2])
+    tv2 = T.fp2_add(T.fp2_sqr(tv), tv)
+    tv2_zero = T.fp2_is_zero(tv2)
+    # guard the inversion against the zero case (select afterwards)
+    one = T.fp2_one_like(u)
+    safe_tv2 = T.fp2_select(tv2_zero, one, tv2)
+    inv_tv2 = T.fp2_inv(safe_tv2)
+    nboa = T.fp2_const(_NEG_B_OVER_A, bshape)
+    (x1_main,) = T.fp2_mul_many([nboa], [T.fp2_add(one, inv_tv2)])
+    x1 = T.fp2_select(tv2_zero, T.fp2_const(_B_OVER_ZA, bshape), x1_main)
+    x1 = _stable(x1)
+    gx1 = _gx(x1, Ac, Bc)
+    y1, sq1 = fp2_sqrt_or_flag(gx1)
+    (x2,) = T.fp2_mul_many([tv], [x1])
+    x2 = _stable(x2)
+    gx2 = _gx(x2, Ac, Bc)
+    y2, _sq2 = fp2_sqrt_or_flag(gx2)
+    x = T.fp2_select(sq1, x1, x2)
+    y = T.fp2_select(sq1, y1, y2)
+    # sign fix: sgn0(y) must equal sgn0(u)
+    flip = fp2_sgn0(u) != fp2_sgn0(y)
+    y = T.fp2_select(flip, T.fp2_neg(y), y)
+    return _stable(x), _stable(y)
+
+
+def _horner(coeffs, x, bshape):
+    acc = T.fp2_const(coeffs[-1], bshape)
+    for c in reversed(coeffs[:-1]):
+        (acc_x,) = T.fp2_mul_many([acc], [x])
+        acc = T.fp2_add(acc_x, T.fp2_const(c, bshape))
+    return acc
+
+
+def iso_map_g2(xy):
+    """The derived 3-isogeny E' -> E2, batched (denominators of hash
+    outputs are nonzero with overwhelming probability; the kernel case maps
+    through garbage guarded upstream by on-curve construction)."""
+    x, y = xy
+    bshape = F.batch_shape(x[0])
+    xn = _horner(_ISO_X_NUM, x, bshape)
+    xd = _horner(_ISO_X_DEN, x, bshape)
+    yn = _horner(_ISO_Y_NUM, x, bshape)
+    yd = _horner(_ISO_Y_DEN, x, bshape)
+    inv_xd = T.fp2_inv(xd)
+    inv_yd = T.fp2_inv(yd)
+    (X,) = T.fp2_mul_many([xn], [inv_xd])
+    (yfrac,) = T.fp2_mul_many([yn], [inv_yd])
+    (Y,) = T.fp2_mul_many([y], [yfrac])
+    return _stable(X), _stable(Y)
+
+
+def _pt_stable(p):
+    """Reduce every Jacobian coordinate to the stable bound class (point
+    negation/addition inflate bounds past scalar_mul_bits' 2.0 pin)."""
+
+    def red(c):
+        if isinstance(c, F.LFp):
+            return F.relabel(F.guard_le(c, 2.0), 2.0)
+        return tuple(red(x) for x in c)
+
+    return tuple(red(c) for c in p[:3]) + (p[3],)
+
+
+def _bits_for(bshape, bits):
+    return jnp.broadcast_to(
+        jnp.array(bits, dtype=jnp.uint32).reshape((len(bits),) + (1,) * len(bshape)),
+        (len(bits),) + tuple(bshape),
+    )
+
+
+def clear_cofactor_g2(xy):
+    """Budroni-Pintore via psi (endo.clear_cofactor_fast's device twin):
+    h_eff · P = [x^2 - x - 1]P + [x - 1]psi(P) + psi^2([2]P), computed with
+    |x| scalar ladders and sign-corrected adds (x < 0).  Input affine on
+    E2, output Jacobian in G2."""
+    xy = (_stable(xy[0]), _stable(xy[1]))
+    bshape = F.batch_shape(xy[0][0])
+    bits = _bits_for(bshape, _X_ABS_BITS)
+    Pj = P.from_affine(P.FP2_OPS, xy)
+    absxP = P.scalar_mul_bits(P.FP2_OPS, Pj, bits)  # [|x|]P
+    xP = _pt_stable(P.pt_neg(P.FP2_OPS, absxP))  # [x]P (x < 0)
+    absx_xP = P.scalar_mul_bits(P.FP2_OPS, xP, bits)  # [|x|][x]P
+    x2P = P.pt_neg(P.FP2_OPS, absx_xP)  # [x^2]P
+    acc = P.jac_add(P.FP2_OPS, x2P, P.pt_neg(P.FP2_OPS, xP))  # [x^2 - x]P
+    acc = P.jac_add(P.FP2_OPS, acc, P.pt_neg(P.FP2_OPS, Pj))  # - P
+    # [x-1] psi(P) = [x]psi(P) - psi(P)
+    psiP_aff = P.psi_affine(xy)
+    psiPj = _pt_stable(P.from_affine(P.FP2_OPS, psiP_aff))
+    abs_psi = P.scalar_mul_bits(P.FP2_OPS, psiPj, bits)
+    x_psi = P.pt_neg(P.FP2_OPS, abs_psi)
+    acc = P.jac_add(P.FP2_OPS, acc, x_psi)
+    acc = P.jac_add(P.FP2_OPS, acc, P.pt_neg(P.FP2_OPS, psiPj))
+    # psi^2([2]P): psi twice on affine 2P — need 2P affine; compute in
+    # Jacobian then affinize (one fp2 inversion, batched)
+    twoP = P.jac_double(P.FP2_OPS, Pj)
+    twoP_aff = P.to_affine(P.FP2_OPS, twoP, T.fp2_inv)
+    psi2_aff = P.psi_affine(P.psi_affine(twoP_aff))
+    acc = P.jac_add(P.FP2_OPS, acc, P.from_affine(P.FP2_OPS, psi2_aff))
+    return acc
+
+
+def map_to_g2(u0, u1):
+    """Device hash_to_curve minus the hashing: (u0, u1) Fp2 batches ->
+    affine G2 points (the kernel's h_aff input)."""
+    q0 = iso_map_g2(sswu_g2(u0))
+    q1 = iso_map_g2(sswu_g2(u1))
+    s = P.jac_add(
+        P.FP2_OPS, P.from_affine(P.FP2_OPS, q0), P.from_affine(P.FP2_OPS, q1)
+    )
+    s_aff = P.to_affine(P.FP2_OPS, s, T.fp2_inv)
+    g = clear_cofactor_g2(s_aff)
+    return P.to_affine(P.FP2_OPS, g, T.fp2_inv)
+
+
+# ---------------------------------------------------------------------------
+# host codec: messages -> u-value limbs
+# ---------------------------------------------------------------------------
+
+
+def encode_u_values(msgs: list[bytes], dst: bytes = params.DST):
+    """Host: SHA-256 expansion only (fast), -> two Fp2 limb batches."""
+    from ..hash_to_curve import hash_to_field_fp2
+
+    u0s, u1s = [], []
+    for m in msgs:
+        u0, u1 = hash_to_field_fp2(m, 2, dst)
+        u0s.append(u0)
+        u1s.append(u1)
+    return T.fp2_encode(u0s), T.fp2_encode(u1s)

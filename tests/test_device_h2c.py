@@ -1,0 +1,70 @@
+"""Device map-to-curve vs the host oracle: SSWU, isogeny, cofactor
+clearing — full differential over random messages on the CPU mesh."""
+
+import pytest
+
+from lighthouse_tpu.crypto.bls import params
+from lighthouse_tpu.crypto.bls.hash_to_curve import (
+    hash_to_field_fp2,
+    hash_to_g2,
+    iso_map,
+    sswu,
+)
+from lighthouse_tpu.crypto.bls.jax_backend import h2c, points as P, tower as T
+
+MSGS = [b"", b"abc", b"\x42" * 32, b"device-h2c-differential"]
+
+
+@pytest.fixture(scope="module")
+def u_values():
+    u0s, u1s = [], []
+    for m in MSGS:
+        u0, u1 = hash_to_field_fp2(m, 2)
+        u0s.append(u0)
+        u1s.append(u1)
+    return u0s, u1s
+
+
+def _decode_fp2_pair(xy):
+    xs = T.fp2_decode(xy[0]) if hasattr(T, "fp2_decode") else None
+    return xs
+
+
+def _fp2_to_ints(x2):
+    from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+
+    c0 = F.decode_mont(x2[0])
+    c1 = F.decode_mont(x2[1])
+    return list(zip(c0, c1))
+
+
+def test_sswu_matches_oracle(u_values):
+    u0s, _ = u_values
+    enc = T.fp2_encode(u0s)
+    x_dev, y_dev = h2c.sswu_g2(enc)
+    xs = _fp2_to_ints(x_dev)
+    ys = _fp2_to_ints(y_dev)
+    for i, u in enumerate(u0s):
+        ox, oy = sswu(u)
+        assert xs[i] == (ox.c0, ox.c1), f"sswu x mismatch msg {i}"
+        assert ys[i] == (oy.c0, oy.c1), f"sswu y mismatch msg {i}"
+
+
+def test_full_map_matches_oracle(u_values):
+    u0s, u1s = u_values
+    h_dev = h2c.map_to_g2(T.fp2_encode(u0s), T.fp2_encode(u1s))
+    xs = _fp2_to_ints(h_dev[0])
+    ys = _fp2_to_ints(h_dev[1])
+    for i, m in enumerate(MSGS):
+        hx, hy = hash_to_g2(m)
+        assert xs[i] == (hx.c0, hx.c1), f"H(m) x mismatch msg {i}"
+        assert ys[i] == (hy.c0, hy.c1), f"H(m) y mismatch msg {i}"
+
+
+def test_host_u_encoding_is_cheap():
+    import time
+
+    t0 = time.perf_counter()
+    h2c.encode_u_values([bytes([i]) * 32 for i in range(64)])
+    per_msg = (time.perf_counter() - t0) / 64
+    assert per_msg < 0.005, f"u-value encode too slow: {per_msg*1000:.2f} ms"

@@ -1,0 +1,36 @@
+"""Watch analytics + monitoring push against a live harness chain."""
+
+from lighthouse_tpu.beacon import BeaconChainHarness
+from lighthouse_tpu.beacon.watch import WatchService
+from lighthouse_tpu.utils.monitoring import MonitoringService, SystemHealth
+
+
+def test_watch_records_slots_and_rates():
+    h = BeaconChainHarness(n_validators=16)
+    h.extend_chain(5)
+    w = WatchService(h.chain)
+    n = w.update()
+    assert n == 6  # slots 0..5
+    assert w.block_production_rate(first_slot=1) == 1.0
+    assert sum(w.proposer_counts().values()) == 5
+    # idempotent cursor
+    h.extend_chain(1)
+    assert w.update() == 1
+    assert "block_root" in w.export_json()
+
+
+def test_monitoring_snapshot_and_push():
+    h = BeaconChainHarness(n_validators=16)
+    h.extend_chain(2)
+    sent = []
+    svc = MonitoringService("http://example.invalid", chain=h.chain,
+                            post=sent.append)
+    payload = svc.tick()
+    assert svc.sent == 1 and sent[0] is payload
+    assert payload["beacon"]["head_slot"] == 2
+    assert payload["system"]["cpu_count"] >= 1
+
+
+def test_system_health_observe():
+    sh = SystemHealth.observe()
+    assert sh.mem_total_kb > 0 and sh.disk_free_kb > 0
