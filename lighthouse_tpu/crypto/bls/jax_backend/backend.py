@@ -171,6 +171,17 @@ def _aggregate_verify_kernel(pk_aff, h_aff, sig_aff):
     return ok_pair & ok_sub
 
 
+def _verify_kernel_h2c(pk_aff, sig_aff, u0, u1, wbits):
+    """_verify_kernel with DEVICE-SIDE map-to-curve: takes the hash-to-field
+    outputs (u0, u1 Fp2 batches) instead of precomputed H(m) points, so the
+    host's per-set cost drops to SHA-256 expansion (~10 us vs ~30 ms of
+    bigint SSWU).  See jax_backend/h2c.py."""
+    from . import h2c
+
+    h_aff = h2c.map_to_g2(u0, u1)
+    return _verify_kernel(pk_aff, sig_aff, h_aff, wbits)
+
+
 def _pack_wbits(weights: list[int]) -> np.ndarray:
     """(64, B) MSB-first weight bits, vectorized (was a 64xB Python loop).
     Ingested as two uint32 halves: numpy rejects Python ints >= 2^63 when
@@ -194,16 +205,22 @@ class JaxBackend:
 
     name = "jax"
 
-    def __init__(self, min_batch: int = 8):
+    def __init__(self, min_batch: int = 8, device_h2c: bool = False):
         self._kernels = {}
         self.min_batch = min_batch
+        # device_h2c: map messages to G2 ON DEVICE (host only hashes).
+        # Removes the dominant host cost; off by default until profiled on
+        # the real chip (it grows the compiled graph by ~2 sqrt chains).
+        self.device_h2c = device_h2c
 
     def _kernel(self, B: int):
-        if B not in self._kernels:
+        key = (B, self.device_h2c)
+        if key not in self._kernels:
             import jax
 
-            self._kernels[B] = jax.jit(_verify_kernel)
-        return self._kernels[B]
+            fn = _verify_kernel_h2c if self.device_h2c else _verify_kernel
+            self._kernels[key] = jax.jit(fn)
+        return self._kernels[key]
 
     # -- single/aggregate verification reuses the set machinery ------------
 
@@ -271,15 +288,16 @@ class JaxBackend:
                 agg = from_jacobian(acc, Fp)
             if agg is None:
                 return False
-            h = hash_to_g2(s.message)
-            if h is None:  # probability-zero, but keep the host total
-                return False
+            if not self.device_h2c:
+                h = hash_to_g2(s.message)
+                if h is None:  # probability-zero, but keep the host total
+                    return False
+                h_pts.append(h)
             r = 0
             while r == 0:
                 r = secrets.randbits(params.RAND_BITS)
             pk_pts.append(agg)
             sig_pts.append(s.signature.point)
-            h_pts.append(h)
             weights.append(r)
 
         # Pad to the kernel batch size by replicating entry 0: a valid
@@ -289,15 +307,25 @@ class JaxBackend:
         reps = B - n
         pk_pts += [pk_pts[0]] * reps
         sig_pts += [sig_pts[0]] * reps
-        h_pts += [h_pts[0]] * reps
         weights += [weights[0]] * reps
 
         pk_aff = P.g1_encode(pk_pts)
         sig_aff = P.g2_encode(sig_pts)
-        h_aff = P.g2_encode(h_pts)
         wbits = _pack_wbits(weights)
+        if self.device_h2c:
+            from ..hash_to_curve import hash_to_field_fp2
 
-        ok = self._kernel(B)(pk_aff, sig_aff, h_aff, wbits)
+            from . import h2c as _h2c  # noqa: F401 (kernel-side import)
+
+            us = [hash_to_field_fp2(s.message, 2) for s in sets]
+            us += [us[0]] * reps  # replicate computed u-values, not hashes
+            u0 = T.fp2_encode([u[0] for u in us])
+            u1 = T.fp2_encode([u[1] for u in us])
+            ok = self._kernel(B)(pk_aff, sig_aff, u0, u1, wbits)
+        else:
+            h_pts += [h_pts[0]] * reps
+            h_aff = P.g2_encode(h_pts)
+            ok = self._kernel(B)(pk_aff, sig_aff, h_aff, wbits)
         return bool(ok)
 
     def _padded_size(self, n: int) -> int:

@@ -96,10 +96,20 @@ def fp2_sqrt_or_flag(gx):
     return _stable(y), ok
 
 
+def _demont(c):
+    """Montgomery -> standard-domain limbs: mont_mul by the literal 1
+    (a * 1 * R^-1 = a_std).  Parity/sign live in the STANDARD domain; the
+    Montgomery residue's parity is uncorrelated garbage."""
+    bshape = F.batch_shape(c)
+    one_raw = F.LFp(F.bcast(jnp.asarray(F.int_to_limbs(1)), bshape), 1.0)
+    return F.mont_mul(F.guard_le(c, 4.0), one_raw)
+
+
 def fp2_sgn0(a):
-    """RFC 9380 sgn0 for Fp2: parity of c0, tie-broken by c1 when c0 = 0."""
-    c0 = F.fp_canon(a[0])
-    c1 = F.fp_canon(a[1])
+    """RFC 9380 sgn0 for Fp2: parity of c0, tie-broken by c1 when c0 = 0 —
+    computed on the standard-domain values."""
+    c0 = F.fp_canon(_demont(a[0]))
+    c1 = F.fp_canon(_demont(a[1]))
     c0_zero = jnp.all(c0 == 0, axis=0)
     return jnp.where(c0_zero, c1[0] & 1, c0[0] & 1)
 

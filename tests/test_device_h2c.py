@@ -68,3 +68,28 @@ def test_host_u_encoding_is_cheap():
     h2c.encode_u_values([bytes([i]) * 32 for i in range(64)])
     per_msg = (time.perf_counter() - t0) / 64
     assert per_msg < 0.005, f"u-value encode too slow: {per_msg*1000:.2f} ms"
+
+
+@pytest.mark.slow
+def test_backend_device_h2c_end_to_end():
+    """Full verify_signature_sets with device-side map-to-curve: valid
+    batch accepted, poisoned batch rejected, and agreement with the
+    host-hash backend on the same sets."""
+    from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import JaxBackend
+
+    be = JaxBackend(min_batch=4, device_h2c=True)
+    sets = []
+    for i in range(3):
+        sk = SecretKey(500 + i)
+        msg = bytes([i]) * 32
+        sets.append(SignatureSet(sk.sign(msg), [sk.public_key()], msg))
+    assert be.verify_signature_sets(sets) is True
+    # agreement with the host-hash path on the same inputs
+    assert JaxBackend(min_batch=4).verify_signature_sets(sets) is True
+    bad = list(sets)
+    sk_evil = SecretKey(999)
+    bad[1] = SignatureSet(
+        sk_evil.sign(b"\x01" * 32), [SecretKey(501).public_key()], b"\x01" * 32
+    )
+    assert be.verify_signature_sets(bad) is False
