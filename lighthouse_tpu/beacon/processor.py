@@ -271,3 +271,73 @@ class DeadlineBatcher:
             if s >= n:
                 return s
         return self.sizes[-1]
+
+
+# ---------------------------------------------------------------------------
+# Reprocess / delay queue
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Delayed:
+    ready_at: float
+    event: WorkEvent
+
+
+class ReprocessQueue:
+    """Delayed re-delivery — twin of beacon_processor/src/
+    work_reprocessing_queue.rs (DelayQueue-based): blocks that arrive early
+    wait for their slot; attestations referencing an unknown block wait for
+    the block to land (or expire).  Drained by the manager loop each tick.
+    """
+
+    def __init__(self, now: Callable[[], float] = time.monotonic,
+                 attestation_ttl: float = 12.0):
+        self.now = now
+        self.attestation_ttl = attestation_ttl
+        self._timed: list[_Delayed] = []
+        self._awaiting_block: dict[bytes, list[tuple[float, WorkEvent]]] = {}
+        self.expired = 0
+
+    def defer_until(self, ev: WorkEvent, ready_at: float) -> None:
+        """Early block: park until its slot starts."""
+        self._timed.append(_Delayed(ready_at=ready_at, event=ev))
+
+    def defer_for_block(self, ev: WorkEvent, block_root: bytes) -> None:
+        """Unknown-block attestation: park keyed by the missing root."""
+        self._awaiting_block.setdefault(block_root, []).append(
+            (self.now() + self.attestation_ttl, ev)
+        )
+
+    def block_imported(self, block_root: bytes) -> list[WorkEvent]:
+        """The missing block arrived: release its waiters (unexpired)."""
+        waiters = self._awaiting_block.pop(block_root, [])
+        now = self.now()
+        out = []
+        for deadline, ev in waiters:
+            if deadline >= now:
+                out.append(ev)
+            else:
+                self.expired += 1
+        return out
+
+    def poll(self) -> list[WorkEvent]:
+        """Release everything whose time has come; expire stale waiters."""
+        now = self.now()
+        ready = [d.event for d in self._timed if d.ready_at <= now]
+        self._timed = [d for d in self._timed if d.ready_at > now]
+        for root in list(self._awaiting_block):
+            alive = [
+                (dl, ev) for dl, ev in self._awaiting_block[root] if dl >= now
+            ]
+            self.expired += len(self._awaiting_block[root]) - len(alive)
+            if alive:
+                self._awaiting_block[root] = alive
+            else:
+                del self._awaiting_block[root]
+        return ready
+
+    def __len__(self):
+        return len(self._timed) + sum(
+            len(v) for v in self._awaiting_block.values()
+        )

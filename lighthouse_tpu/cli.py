@@ -34,12 +34,38 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
     vc.add_argument("--keys", type=int, default=8, help="interop key count")
 
-    acct = sub.add_parser("account", help="keystore operations")
+    acct = sub.add_parser("account", help="keystore/wallet operations")
     acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
     new = acct_sub.add_parser("new", help="create an EIP-2335 keystore")
     new.add_argument("--password", required=True)
     new.add_argument("--index", type=int, default=0, help="EIP-2334 index")
     new.add_argument("--seed-hex", default=None)
+    wallet = acct_sub.add_parser("wallet", help="create an EIP-2386 HD wallet")
+    wallet.add_argument("--name", required=True)
+    wallet.add_argument("--password", required=True)
+    wallet.add_argument("--seed-hex", default=None)
+
+    vm = sub.add_parser(
+        "validator-manager", help="bulk validator operations"
+    )
+    vm_sub = vm.add_subparsers(dest="vm_cmd", required=True)
+    create = vm_sub.add_parser("create", help="derive N validator keystores")
+    create.add_argument("--count", type=int, required=True)
+    create.add_argument("--wallet-password", required=True)
+    create.add_argument("--keystore-password", required=True)
+    create.add_argument("--seed-hex", default=None)
+    create.add_argument("--deposit-gwei", type=int, default=32_000_000_000)
+
+    lcli = sub.add_parser("lcli", help="dev/ops utilities (lcli analog)")
+    lcli_sub = lcli.add_subparsers(dest="lcli_cmd", required=True)
+    skip = lcli_sub.add_parser("skip-slots", help="advance a state N slots")
+    skip.add_argument("--slots", type=int, required=True)
+    skip.add_argument("--validators", type=int, default=16)
+    parse = lcli_sub.add_parser("parse-ssz", help="decode an SSZ file")
+    parse.add_argument("--type", dest="ssz_type", required=True,
+                       choices=["BeaconState", "SignedBeaconBlock"])
+    parse.add_argument("--fork", default="base")
+    parse.add_argument("path")
 
     db = sub.add_parser("db", help="database tools (database_manager analog)")
     db_sub = db.add_subparsers(dest="db_cmd", required=True)
@@ -120,6 +146,13 @@ def run_vc(args) -> int:
 
 
 def run_account(args) -> int:
+    if args.account_cmd == "wallet":
+        from .crypto import wallet as wlt
+
+        seed = bytes.fromhex(args.seed_hex) if args.seed_hex else None
+        print(json.dumps(wlt.create_wallet(args.name, args.password, seed=seed),
+                         indent=2))
+        return 0
     from .crypto import keys as kd
     from .crypto import keystore as ks
     from .crypto.bls.api import SecretKey
@@ -138,6 +171,66 @@ def run_account(args) -> int:
     )
     print(json.dumps(store, indent=2))
     return 0
+
+
+def run_validator_manager(args) -> int:
+    from .crypto import wallet as wlt
+
+    seed = bytes.fromhex(args.seed_hex) if args.seed_hex else None
+    w = wlt.create_wallet("vm", args.wallet_password, seed=seed)
+    out = []
+    for _ in range(args.count):
+        signing, withdrawal = wlt.next_validator(
+            w, args.wallet_password, args.keystore_password
+        )
+        out.append(
+            {
+                "voting_pubkey": "0x" + signing["pubkey"],
+                "withdrawal_pubkey": "0x" + withdrawal["pubkey"],
+                "deposit_gwei": args.deposit_gwei,
+                "keystore": signing,
+            }
+        )
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def run_lcli(args) -> int:
+    if args.lcli_cmd == "skip-slots":
+        import time as _t
+
+        from .consensus import spec as S
+        from .consensus.state_processing.per_slot import process_slots
+        from .consensus.testing import interop_state, phase0_spec
+
+        spec = phase0_spec(S.PRESETS[args.spec])
+        state, _ = interop_state(args.validators, spec, fork="altair")
+        t0 = _t.perf_counter()
+        process_slots(state, args.slots, spec)
+        dt = _t.perf_counter() - t0
+        print(json.dumps({
+            "slots": args.slots,
+            "validators": args.validators,
+            "seconds": round(dt, 3),
+            "slots_per_sec": round(args.slots / dt, 1),
+            "state_root": "0x" + state.root().hex(),
+        }))
+        return 0
+    if args.lcli_cmd == "parse-ssz":
+        from .consensus import spec as S
+        from .consensus.containers import types_for
+        from .network.api import to_json
+
+        T = types_for(S.PRESETS[args.spec])
+        cls = {
+            "BeaconState": T.BeaconState_BY_FORK,
+            "SignedBeaconBlock": T.SignedBeaconBlock_BY_FORK,
+        }[args.ssz_type][args.fork]
+        with open(args.path, "rb") as f:
+            obj = cls.deserialize_value(f.read())
+        print(json.dumps(to_json(cls, obj))[:100000])
+        return 0
+    return 2
 
 
 def run_db(args) -> int:
@@ -169,6 +262,8 @@ def main(argv=None) -> int:
         "bn": run_bn,
         "vc": run_vc,
         "account": run_account,
+        "validator-manager": run_validator_manager,
+        "lcli": run_lcli,
         "db": run_db,
     }[args.command](args)
 

@@ -1,0 +1,72 @@
+"""EIP-2386 hierarchical-deterministic wallets over EIP-2335 keystores.
+
+Twin of crypto/eth2_wallet (`Wallet`, src/wallet.rs): a wallet encrypts its
+seed with the same KDF/cipher/checksum module as keystores, tracks a
+`nextaccount` counter, and derives per-validator keys along EIP-2334 paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid as uuid_mod
+
+from . import keys as kd
+from . import keystore as ks
+from .bls.api import SecretKey
+
+
+class WalletError(ValueError):
+    pass
+
+
+def create_wallet(
+    name: str, password: str, seed: bytes | None = None, kdf: str = "pbkdf2"
+) -> dict:
+    """EIP-2386 wallet JSON (type hierarchical deterministic)."""
+    seed = seed if seed is not None else os.urandom(32)
+    if len(seed) < 32:
+        raise WalletError("seed must be at least 32 bytes")
+    crypto = ks.encrypt(seed, password, kdf=kdf)["crypto"]
+    return {
+        "crypto": crypto,
+        "name": name,
+        "nextaccount": 0,
+        "type": "hierarchical deterministic",
+        "uuid": str(uuid_mod.uuid4()),
+        "version": 1,
+    }
+
+
+def decrypt_seed(wallet: dict | str, password: str) -> bytes:
+    w = json.loads(wallet) if isinstance(wallet, str) else wallet
+    if w.get("type") != "hierarchical deterministic" or w.get("version") != 1:
+        raise WalletError("not an EIP-2386 HD wallet")
+    # reuse the keystore decryptor by re-wrapping the crypto section
+    shim = {"version": 4, "crypto": w["crypto"]}
+    return ks.decrypt(shim, password)
+
+
+def next_validator(
+    wallet: dict, wallet_password: str, keystore_password: str
+) -> tuple[dict, dict]:
+    """Derive the wallet's next validator: returns (signing_keystore,
+    withdrawal_keystore) and bumps `nextaccount` (wallet.rs semantics)."""
+    seed = decrypt_seed(wallet, wallet_password)
+    index = wallet["nextaccount"]
+    out = []
+    for path in (
+        kd.validator_signing_path(index),
+        kd.validator_withdrawal_path(index),
+    ):
+        sk = SecretKey(kd.derive_path(seed, path))
+        out.append(
+            ks.encrypt(
+                sk.to_bytes(),
+                keystore_password,
+                path=path,
+                pubkey=sk.public_key().to_bytes(),
+            )
+        )
+    wallet["nextaccount"] = index + 1
+    return out[0], out[1]

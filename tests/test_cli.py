@@ -44,3 +44,52 @@ def test_bn_short_run(capsys):
         "--slots", "3", "--auto-propose",
     ])
     assert rc == 0
+
+
+def test_wallet_and_validator_manager(capsys):
+    import json as _json
+
+    assert main([
+        "account", "wallet", "--name", "w1", "--password", "pw",
+        "--seed-hex", "22" * 32,
+    ]) == 0
+    w = _json.loads(capsys.readouterr().out)
+    assert w["type"] == "hierarchical deterministic" and w["nextaccount"] == 0
+    from lighthouse_tpu.crypto.wallet import decrypt_seed, next_validator
+
+    assert decrypt_seed(w, "pw") == bytes.fromhex("22" * 32)
+    s1, _ = next_validator(w, "pw", "kpw")
+    s2, _ = next_validator(w, "pw", "kpw")
+    assert w["nextaccount"] == 2 and s1["pubkey"] != s2["pubkey"]
+
+    assert main([
+        "validator-manager", "create", "--count", "2",
+        "--wallet-password", "pw", "--keystore-password", "kpw",
+        "--seed-hex", "33" * 32,
+    ]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert len(out) == 2
+    assert out[0]["voting_pubkey"] != out[1]["voting_pubkey"]
+
+
+def test_lcli_skip_slots_and_parse_ssz(tmp_path, capsys):
+    import json as _json
+
+    assert main(["--spec", "minimal", "lcli", "skip-slots", "--slots", "9",
+                 "--validators", "16"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["slots"] == 9 and out["state_root"].startswith("0x")
+
+    # round-trip a block file through parse-ssz
+    from lighthouse_tpu.consensus.containers import types_for
+    from lighthouse_tpu.consensus.spec import MINIMAL
+
+    T = types_for(MINIMAL)
+    blk = T.SignedBeaconBlock_BY_FORK["altair"]()
+    blk.message.slot = 77
+    p = tmp_path / "block.ssz"
+    p.write_bytes(blk.encode())
+    assert main(["--spec", "minimal", "lcli", "parse-ssz", "--type",
+                 "SignedBeaconBlock", "--fork", "altair", str(p)]) == 0
+    parsed = _json.loads(capsys.readouterr().out)
+    assert parsed["message"]["slot"] == "77"

@@ -134,3 +134,36 @@ def test_deadline_batcher():
     t[0] = 5.0
     assert b.poll() == [99]  # deadline flush
     assert b.snap_size(3) == 8 and b.snap_size(9) == 16
+
+
+def test_reprocess_queue_early_block_and_unknown_attestation():
+    from lighthouse_tpu.beacon.processor import ReprocessQueue
+
+    t = [100.0]
+    q = ReprocessQueue(now=lambda: t[0], attestation_ttl=12.0)
+    early = mk(WorkKind.GOSSIP_BLOCK, "early-block")
+    q.defer_until(early, ready_at=112.0)
+    att = mk(WorkKind.GOSSIP_ATTESTATION, "att-unknown")
+    q.defer_for_block(att, b"\xaa" * 32)
+    assert len(q) == 2
+    assert q.poll() == []  # nothing ready yet
+    # the block arrives over sync: its waiter is released immediately
+    released = q.block_imported(b"\xaa" * 32)
+    assert [e.item for e in released] == ["att-unknown"]
+    # slot arrives: early block released
+    t[0] = 112.5
+    assert [e.item for e in q.poll()] == ["early-block"]
+    assert len(q) == 0
+
+
+def test_reprocess_queue_expiry():
+    from lighthouse_tpu.beacon.processor import ReprocessQueue
+
+    t = [0.0]
+    q = ReprocessQueue(now=lambda: t[0], attestation_ttl=12.0)
+    q.defer_for_block(mk(WorkKind.GOSSIP_ATTESTATION, "a"), b"\x01" * 32)
+    t[0] = 30.0  # past ttl
+    assert q.poll() == []
+    assert q.expired == 1 and len(q) == 0
+    # late-arriving block finds nothing
+    assert q.block_imported(b"\x01" * 32) == []
