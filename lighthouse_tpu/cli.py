@@ -73,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
         d = db_sub.add_parser(name)
         d.add_argument("path")
 
+    boot = sub.add_parser(
+        "boot-node", help="run a standalone discv5 boot node (boot_node analog)"
+    )
+    boot.add_argument("--ip", default="127.0.0.1")
+    boot.add_argument("--port", type=int, default=9000)
+    boot.add_argument(
+        "--run-secs", type=float, default=None, help="exit after N seconds (tests)"
+    )
+
     sub.add_parser("version")
     return p
 
@@ -251,6 +260,29 @@ def run_db(args) -> int:
     return 0
 
 
+def run_boot_node(args) -> int:
+    """Standalone discovery bootstrap server (boot_node/src/server.rs:
+    serve FINDNODE from a table fed only by inbound traffic)."""
+    import time as _time
+
+    from .network.discv5 import BootNode
+
+    node = BootNode(ip=args.ip, port=args.port)
+    node.start()
+    print(node.enr.to_text(), flush=True)
+    try:
+        if args.run_secs is not None:
+            _time.sleep(args.run_secs)
+        else:
+            while True:
+                _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        node.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "version":
@@ -265,6 +297,7 @@ def main(argv=None) -> int:
         "validator-manager": run_validator_manager,
         "lcli": run_lcli,
         "db": run_db,
+        "boot-node": run_boot_node,
     }[args.command](args)
 
 

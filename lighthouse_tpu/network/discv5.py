@@ -1,0 +1,747 @@
+"""Node Discovery Protocol v5 (discv5 v5.1) over UDP.
+
+Peer discovery for the beacon node and the standalone boot node —
+the role the `discv5` crate plays for the reference
+(`beacon_node/lighthouse_network/src/discovery/mod.rs:3`,
+`boot_node/src/server.rs`).  Implements the wire protocol from the
+devp2p discv5-wire spec:
+
+* packet masking: AES-128-CTR keyed by the destination node-id prefix,
+* three packet flavors — ordinary message, WHOAREYOU, handshake,
+* session keys from an ECDH(secp256k1) + HKDF-SHA256 handshake bound to
+  the WHOAREYOU challenge, messages sealed with AES-128-GCM,
+* PING/PONG/FINDNODE/NODES/TALKREQ/TALKRESP message bodies (RLP),
+* a 256-bucket XOR routing table and iterative lookups
+  (`discovery/mod.rs` find-node queries, subnet predicates applied by
+  the caller), and
+* `BootNode` — the answer-only server of `boot_node/src/server.rs`.
+
+Host-side networking only; nothing here touches the device.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import selectors
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, utils as asn1_utils
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from ..utils.logging import get_logger
+from . import rlp
+from .enr import Enr, build_enr, node_id_of
+
+log = get_logger("discv5")
+
+PROTOCOL_ID = b"discv5"
+VERSION = b"\x00\x01"
+FLAG_MESSAGE = 0
+FLAG_WHOAREYOU = 1
+FLAG_HANDSHAKE = 2
+
+ID_SIGNATURE_TEXT = b"discovery v5 identity proof"
+KDF_INFO_TEXT = b"discovery v5 key agreement"
+
+MSG_PING = 0x01
+MSG_PONG = 0x02
+MSG_FINDNODE = 0x03
+MSG_NODES = 0x04
+MSG_TALKREQ = 0x05
+MSG_TALKRESP = 0x06
+
+BUCKET_SIZE = 16  # spec k
+LOOKUP_ALPHA = 3
+REQUEST_TIMEOUT = 1.0
+MAX_NODES_PER_MSG = 4  # ENRs per NODES response (fits one UDP datagram)
+
+# secp256k1 curve params for the compressed-point ECDH the spec requires
+_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _pt_decompress(comp: bytes) -> tuple[int, int]:
+    x = int.from_bytes(comp[1:], "big")
+    y2 = (pow(x, 3, _P) + 7) % _P
+    y = pow(y2, (_P + 1) // 4, _P)
+    if (y & 1) != (comp[0] & 1):
+        y = _P - y
+    return x, y
+
+
+def _pt_mul(k: int, pt: tuple[int, int]) -> tuple[int, int]:
+    """Affine double-and-add (handshake-rate only, not a hot path)."""
+    rx, ry, present = 0, 0, False
+    ax, ay = pt
+    while k:
+        if k & 1:
+            if not present:
+                rx, ry, present = ax, ay, True
+            elif rx == ax:
+                if (ry + ay) % _P == 0:
+                    present = False
+                else:
+                    lam = (3 * ax * ax) * pow(2 * ay, -1, _P) % _P
+                    nx = (lam * lam - 2 * ax) % _P
+                    rx, ry = nx, (lam * (ax - nx) - ay) % _P
+            else:
+                lam = (ay - ry) * pow(ax - rx, -1, _P) % _P
+                nx = (lam * lam - rx - ax) % _P
+                rx, ry = nx, (lam * (rx - nx) - ry) % _P
+        # double the addend
+        lam = (3 * ax * ax) * pow(2 * ay, -1, _P) % _P
+        nx = (lam * lam - 2 * ax) % _P
+        ax, ay = nx, (lam * (ax - nx) - ay) % _P
+        k >>= 1
+    if not present:
+        raise ValueError("ECDH with zero scalar")
+    return rx, ry
+
+
+def _ecdh_compressed(priv: ec.EllipticCurvePrivateKey, pub_comp: bytes) -> bytes:
+    """discv5 ecdh(): the COMPRESSED shared point (33 bytes), not just x."""
+    k = priv.private_numbers().private_value
+    x, y = _pt_mul(k, _pt_decompress(pub_comp))
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _compressed_pub(key: ec.EllipticCurvePrivateKey) -> bytes:
+    return key.public_key().public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+    )
+
+
+def log2_distance(a: bytes, b: bytes) -> int:
+    """XOR log-distance in [0, 256]; 0 iff a == b."""
+    d = int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+    return d.bit_length()
+
+
+# ---------------------------------------------------------------------------
+# Packet codec
+# ---------------------------------------------------------------------------
+
+
+def _ctr_mask(dest_id: bytes, iv: bytes, data: bytes) -> bytes:
+    c = Cipher(algorithms.AES(dest_id[:16]), modes.CTR(iv)).encryptor()
+    return c.update(data) + c.finalize()
+
+
+def _header(flag: int, nonce: bytes, authdata: bytes) -> bytes:
+    return (
+        PROTOCOL_ID
+        + VERSION
+        + bytes([flag])
+        + nonce
+        + len(authdata).to_bytes(2, "big")
+        + authdata
+    )
+
+
+def encode_packet(
+    dest_id: bytes, flag: int, nonce: bytes, authdata: bytes, message_ct: bytes
+) -> bytes:
+    iv = secrets.token_bytes(16)
+    header = _header(flag, nonce, authdata)
+    return iv + _ctr_mask(dest_id, iv, header) + message_ct
+
+
+def decode_packet(local_id: bytes, datagram: bytes):
+    """-> (flag, nonce, authdata, header_bytes, masking_iv, message_ct)."""
+    if len(datagram) < 16 + 23:
+        raise ValueError("short packet")
+    iv, rest = datagram[:16], datagram[16:]
+    # unmask the static header first to learn authdata-size
+    static = _ctr_mask(local_id, iv, rest[:23])
+    if static[:6] != PROTOCOL_ID or static[6:8] != VERSION:
+        raise ValueError("bad protocol id")
+    flag = static[8]
+    nonce = static[9:21]
+    authdata_size = int.from_bytes(static[21:23], "big")
+    full = _ctr_mask(local_id, iv, rest[: 23 + authdata_size])
+    if len(full) < 23 + authdata_size:
+        raise ValueError("truncated authdata")
+    authdata = full[23:]
+    message_ct = rest[23 + authdata_size :]
+    return flag, nonce, authdata, full, iv, message_ct
+
+
+def derive_keys(
+    secret: bytes, challenge_data: bytes, initiator_id: bytes, recipient_id: bytes
+) -> tuple[bytes, bytes]:
+    """HKDF-SHA256 -> (initiator_key, recipient_key), 16 bytes each."""
+    okm = HKDF(
+        algorithm=hashes.SHA256(),
+        length=32,
+        salt=challenge_data,
+        info=KDF_INFO_TEXT + initiator_id + recipient_id,
+    ).derive(secret)
+    return okm[:16], okm[16:]
+
+
+def id_sign(
+    key: ec.EllipticCurvePrivateKey,
+    challenge_data: bytes,
+    eph_pubkey: bytes,
+    dest_id: bytes,
+) -> bytes:
+    digest = hashes.Hash(hashes.SHA256())
+    digest.update(ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_id)
+    der = key.sign(digest.finalize(), ec.ECDSA(asn1_utils.Prehashed(hashes.SHA256())))
+    r, s = asn1_utils.decode_dss_signature(der)
+    if s > _N // 2:
+        s = _N - s
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def id_verify(
+    static_pubkey: bytes,
+    sig: bytes,
+    challenge_data: bytes,
+    eph_pubkey: bytes,
+    dest_id: bytes,
+) -> bool:
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), static_pubkey
+        )
+        der = asn1_utils.encode_dss_signature(
+            int.from_bytes(sig[:32], "big"), int.from_bytes(sig[32:], "big")
+        )
+        digest = hashes.Hash(hashes.SHA256())
+        digest.update(ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_id)
+        pub.verify(
+            der, digest.finalize(), ec.ECDSA(asn1_utils.Prehashed(hashes.SHA256()))
+        )
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Messages
+# ---------------------------------------------------------------------------
+
+
+def encode_message(msg_type: int, fields: list) -> bytes:
+    return bytes([msg_type]) + rlp.encode(fields)
+
+
+def decode_message(data: bytes) -> tuple[int, list]:
+    if not data:
+        raise ValueError("empty message")
+    body = rlp.decode(data[1:])
+    if not isinstance(body, list):
+        raise ValueError("message body not a list")
+    return data[0], body
+
+
+def _ip_bytes(ip: str) -> bytes:
+    return bytes(int(p) for p in ip.split("."))
+
+
+def ping(req_id: bytes, enr_seq: int) -> bytes:
+    return encode_message(MSG_PING, [req_id, enr_seq])
+
+
+def pong(req_id: bytes, enr_seq: int, ip: str, port: int) -> bytes:
+    return encode_message(MSG_PONG, [req_id, enr_seq, _ip_bytes(ip), port])
+
+
+def findnode(req_id: bytes, distances: list[int]) -> bytes:
+    return encode_message(MSG_FINDNODE, [req_id, [d for d in distances]])
+
+
+def nodes(req_id: bytes, total: int, enrs: list[Enr]) -> bytes:
+    # each record embeds as its RLP *list* structure, not as a byte blob
+    return encode_message(
+        MSG_NODES, [req_id, total, [rlp.decode(e.to_rlp()) for e in enrs]]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Routing table
+# ---------------------------------------------------------------------------
+
+
+class KBuckets:
+    """256 XOR-distance buckets of size k=16, LRU within a bucket."""
+
+    def __init__(self, local_id: bytes):
+        self.local_id = local_id
+        self.buckets: list[list[Enr]] = [[] for _ in range(257)]
+        self.lock = threading.Lock()
+
+    def insert(self, enr: Enr) -> bool:
+        nid = enr.node_id
+        d = log2_distance(self.local_id, nid)
+        if d == 0:
+            return False
+        with self.lock:
+            bucket = self.buckets[d]
+            for i, existing in enumerate(bucket):
+                if existing.node_id == nid:
+                    if enr.seq >= existing.seq:
+                        bucket.pop(i)
+                        bucket.append(enr)
+                    return True
+            if len(bucket) >= BUCKET_SIZE:
+                bucket.pop(0)  # evict least-recently seen
+            bucket.append(enr)
+            return True
+
+    def at_distance(self, d: int, limit: int = BUCKET_SIZE) -> list[Enr]:
+        if not 0 <= d <= 256:
+            return []
+        with self.lock:
+            return list(self.buckets[d][-limit:]) if d else []
+
+    def closest(self, target_id: bytes, limit: int = BUCKET_SIZE) -> list[Enr]:
+        with self.lock:
+            allnodes = [e for b in self.buckets for e in b]
+        allnodes.sort(key=lambda e: log2_distance(target_id, e.node_id))
+        return allnodes[:limit]
+
+    def __len__(self):
+        with self.lock:
+            return sum(len(b) for b in self.buckets)
+
+
+# ---------------------------------------------------------------------------
+# Sessions
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Session:
+    send_key: bytes
+    recv_key: bytes
+    created: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _Challenge:
+    """Outstanding WHOAREYOU we issued (keyed by peer addr)."""
+
+    challenge_data: bytes
+    nonce: bytes  # the nonce of the packet that triggered it
+    created: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _PendingSend:
+    """Message stashed until the handshake completes."""
+
+    msg_plain: bytes
+    created: float = field(default_factory=time.monotonic)
+
+
+# ---------------------------------------------------------------------------
+# Service
+# ---------------------------------------------------------------------------
+
+
+class Discv5Service:
+    """A full discv5 node: socket loop, sessions, routing table, lookups.
+
+    Mirrors the role of lighthouse_network's Discovery behaviour
+    (`src/discovery/mod.rs`): maintain a table of ENRs, answer
+    PING/FINDNODE, and run iterative lookups to harvest peers.  The
+    caller filters harvested ENRs (e.g. by eth2 fork digest / attnets —
+    `subnet_predicate.rs`).
+    """
+
+    def __init__(
+        self,
+        key: ec.EllipticCurvePrivateKey | None = None,
+        ip: str = "127.0.0.1",
+        port: int = 0,
+        enr_extra: dict | None = None,
+    ):
+        self.key = key or ec.generate_private_key(ec.SECP256K1())
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((ip, port))
+        self.port = self.sock.getsockname()[1]
+        self.enr = build_enr(self.key, seq=1, ip4=ip, udp=self.port, extra=enr_extra)
+        self.node_id = self.enr.node_id
+        self.table = KBuckets(self.node_id)
+        self.sessions: dict[bytes, Session] = {}
+        self.known_enrs: dict[bytes, Enr] = {}  # node-id -> freshest record
+        self.addr_of: dict[bytes, tuple[str, int]] = {}
+        self._challenges: dict[tuple[str, int], _Challenge] = {}
+        self._pending: dict[bytes, list[_PendingSend]] = {}
+        self._requests: dict[bytes, dict] = {}  # req-id -> waiter state
+        self._lock = threading.Lock()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self.talk_handlers: dict[bytes, callable] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._recv_loop, name=f"discv5-{self.port}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        try:
+            # unblock the selector with a self-send
+            self.sock.sendto(b"", ("127.0.0.1", self.port))
+        except OSError:
+            pass
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self.sock.close()
+
+    # -- low-level send ----------------------------------------------------
+
+    def _seal_and_send(self, dest: Enr, msg_plain: bytes):
+        """Send under an existing session, or kick off a handshake."""
+        nid = dest.node_id
+        addr = dest.udp_endpoint() or self.addr_of.get(nid)
+        if addr is None:
+            return
+        self.addr_of[nid] = addr
+        sess = self.sessions.get(nid)
+        nonce = secrets.token_bytes(12)
+        if sess is not None:
+            authdata = self.node_id
+            header = _header(FLAG_MESSAGE, nonce, authdata)
+            iv = secrets.token_bytes(16)
+            ct = AESGCM(sess.send_key).encrypt(nonce, msg_plain, iv + header)
+            self.sock.sendto(iv + _ctr_mask(nid, iv, header) + ct, addr)
+            return
+        # No session: send a random-content message packet to elicit
+        # WHOAREYOU (spec: the initiator cannot encrypt yet), park the real
+        # message for the handshake completion.
+        with self._lock:
+            self._pending.setdefault(nid, []).append(_PendingSend(msg_plain))
+        authdata = self.node_id
+        self.sock.sendto(
+            encode_packet(nid, FLAG_MESSAGE, nonce, authdata, secrets.token_bytes(20)),
+            addr,
+        )
+
+    # -- receive path ------------------------------------------------------
+
+    def _recv_loop(self):
+        sel = selectors.DefaultSelector()
+        sel.register(self.sock, selectors.EVENT_READ)
+        while self._running:
+            if not sel.select(timeout=0.2):
+                continue
+            try:
+                datagram, addr = self.sock.recvfrom(2048)
+            except OSError:
+                break
+            if not datagram:
+                continue
+            try:
+                self._handle_datagram(datagram, addr)
+            except Exception as exc:  # noqa: BLE001 — drop malformed traffic
+                log.debug("discv5 drop from %s: %s", addr, exc)
+        sel.close()
+
+    def _handle_datagram(self, datagram: bytes, addr):
+        flag, nonce, authdata, header, iv, message_ct = decode_packet(
+            self.node_id, datagram
+        )
+        if flag == FLAG_WHOAREYOU:
+            self._on_whoareyou(nonce, authdata, header, iv, addr)
+        elif flag == FLAG_MESSAGE:
+            self._on_message(nonce, authdata, header, iv, message_ct, addr)
+        elif flag == FLAG_HANDSHAKE:
+            self._on_handshake(nonce, authdata, header, iv, message_ct, addr)
+
+    def _on_message(self, nonce, authdata, header, iv, message_ct, addr):
+        if len(authdata) != 32:
+            raise ValueError("bad ordinary authdata")
+        src_id = authdata
+        sess = self.sessions.get(src_id)
+        if sess is not None:
+            try:
+                plain = AESGCM(sess.recv_key).decrypt(nonce, message_ct, iv + header)
+                self.addr_of[src_id] = addr
+                self._dispatch(src_id, addr, plain)
+                return
+            except Exception:
+                del self.sessions[src_id]  # stale keys: fall through
+        # Unreadable: challenge the sender (spec: respond WHOAREYOU).
+        known = self.known_enrs.get(src_id)
+        id_nonce = secrets.token_bytes(16)
+        enr_seq = known.seq if known else 0
+        authdata_w = id_nonce + enr_seq.to_bytes(8, "big")
+        iv2 = secrets.token_bytes(16)
+        header_w = _header(FLAG_WHOAREYOU, nonce, authdata_w)
+        self._challenges[addr] = _Challenge(iv2 + header_w, nonce)
+        self.sock.sendto(iv2 + _ctr_mask(src_id, iv2, header_w), addr)
+
+    def _on_whoareyou(self, nonce, authdata, header, iv, addr):
+        if len(authdata) != 24:
+            raise ValueError("bad WHOAREYOU authdata")
+        enr_seq = int.from_bytes(authdata[16:], "big")
+        # find who we were talking to at this address
+        nid = next((n for n, a in self.addr_of.items() if a == addr), None)
+        if nid is None:
+            return
+        dest = self.known_enrs.get(nid)
+        if dest is None:
+            return
+        challenge_data = iv + header
+        eph = ec.generate_private_key(ec.SECP256K1())
+        eph_pub = _compressed_pub(eph)
+        secret = _ecdh_compressed(eph, dest.pubkey)
+        send_key, recv_key = derive_keys(secret, challenge_data, self.node_id, nid)
+        self.sessions[nid] = Session(send_key, recv_key)
+        sig = id_sign(self.key, challenge_data, eph_pub, nid)
+        record = b"" if enr_seq >= self.enr.seq else self.enr.to_rlp()
+        authdata_h = (
+            self.node_id + bytes([len(sig)]) + bytes([len(eph_pub)])
+            + sig + eph_pub + record
+        )
+        with self._lock:
+            queued = self._pending.pop(nid, [])
+        if not queued:
+            queued = [_PendingSend(ping(secrets.token_bytes(8), self.enr.seq))]
+        first, rest = queued[0], queued[1:]
+        new_nonce = secrets.token_bytes(12)
+        header_h = _header(FLAG_HANDSHAKE, new_nonce, authdata_h)
+        iv2 = secrets.token_bytes(16)
+        ct = AESGCM(send_key).encrypt(new_nonce, first.msg_plain, iv2 + header_h)
+        self.sock.sendto(iv2 + _ctr_mask(nid, iv2, header_h) + ct, addr)
+        for p in rest:  # session is up now; send the remainder normally
+            if (e := self.known_enrs.get(nid)) is not None:
+                self._seal_and_send(e, p.msg_plain)
+
+    def _on_handshake(self, nonce, authdata, header, iv, message_ct, addr):
+        if len(authdata) < 34:
+            raise ValueError("short handshake authdata")
+        src_id = authdata[:32]
+        sig_size, eph_size = authdata[32], authdata[33]
+        sig = authdata[34 : 34 + sig_size]
+        eph_pub = authdata[34 + sig_size : 34 + sig_size + eph_size]
+        record_rlp = authdata[34 + sig_size + eph_size :]
+        chal = self._challenges.pop(addr, None)
+        if chal is None:
+            raise ValueError("handshake without challenge")
+        if record_rlp:
+            rec = Enr.from_rlp(record_rlp)
+            if rec.node_id != src_id:
+                raise ValueError("handshake record id mismatch")
+            self.known_enrs[src_id] = rec
+            self.table.insert(rec)
+        known = self.known_enrs.get(src_id)
+        if known is None or known.pubkey is None:
+            raise ValueError("no record for handshake peer")
+        if not id_verify(known.pubkey, sig, chal.challenge_data, eph_pub, self.node_id):
+            raise ValueError("bad id signature")
+        secret = _ecdh_compressed(self.key, eph_pub)
+        # peer is the initiator: their send key is our recv key
+        their_send, our_send = derive_keys(
+            secret, chal.challenge_data, src_id, self.node_id
+        )
+        sess = Session(our_send, their_send)
+        self.sessions[src_id] = sess
+        self.addr_of[src_id] = addr
+        plain = AESGCM(sess.recv_key).decrypt(nonce, message_ct, iv + header)
+        self._dispatch(src_id, addr, plain)
+
+    # -- message dispatch --------------------------------------------------
+
+    def _dispatch(self, src_id: bytes, addr, plain: bytes):
+        msg_type, body = decode_message(plain)
+        if msg_type == MSG_PING:
+            req_id, enr_seq = body[0], rlp.decode_uint(body[1])
+            known = self.known_enrs.get(src_id)
+            if known is not None and enr_seq > known.seq:
+                self._request_enr_refresh(src_id)
+            self._send_to_id(src_id, pong(req_id, self.enr.seq, addr[0], addr[1]))
+        elif msg_type == MSG_PONG:
+            self._complete(body[0], ("pong", body))
+        elif msg_type == MSG_FINDNODE:
+            req_id, distances = body[0], [rlp.decode_uint(d) for d in body[1]]
+            found: list[Enr] = []
+            for d in distances:
+                if d == 0:
+                    found.append(self.enr)
+                else:
+                    found.extend(self.table.at_distance(d))
+            found = found[: 3 * BUCKET_SIZE]
+            chunks = [
+                found[i : i + MAX_NODES_PER_MSG]
+                for i in range(0, len(found), MAX_NODES_PER_MSG)
+            ] or [[]]
+            for chunk in chunks:
+                self._send_to_id(src_id, nodes(req_id, len(chunks), chunk))
+        elif msg_type == MSG_NODES:
+            req_id, total = body[0], rlp.decode_uint(body[1])
+            recs = []
+            for item in body[2]:
+                try:
+                    rec = Enr.from_rlp(rlp.encode(item))
+                    recs.append(rec)
+                    self.known_enrs[rec.node_id] = rec
+                except ValueError:
+                    continue
+            self._accumulate_nodes(req_id, total, recs)
+        elif msg_type == MSG_TALKREQ:
+            req_id, protocol, request = body[0], body[1], body[2]
+            handler = self.talk_handlers.get(protocol)
+            resp = handler(src_id, request) if handler else b""
+            self._send_to_id(
+                src_id, encode_message(MSG_TALKRESP, [req_id, resp])
+            )
+        elif msg_type == MSG_TALKRESP:
+            self._complete(body[0], ("talkresp", body))
+
+    def _send_to_id(self, nid: bytes, msg_plain: bytes):
+        enr = self.known_enrs.get(nid)
+        if enr is not None:
+            self._seal_and_send(enr, msg_plain)
+
+    def _request_enr_refresh(self, nid: bytes):
+        req_id = secrets.token_bytes(8)
+        with self._lock:
+            self._requests[req_id] = {
+                "event": threading.Event(), "nodes": [], "total": None, "kind": "nodes",
+            }
+        self._send_to_id(nid, findnode(req_id, [0]))
+
+    # -- request/response plumbing ----------------------------------------
+
+    def _complete(self, req_id: bytes, result):
+        with self._lock:
+            st = self._requests.get(bytes(req_id))
+        if st is None:
+            return
+        st["result"] = result
+        st["event"].set()
+
+    def _accumulate_nodes(self, req_id: bytes, total: int, recs: list[Enr]):
+        with self._lock:
+            st = self._requests.get(bytes(req_id))
+        if st is None:
+            return
+        st["nodes"].extend(recs)
+        st["total"] = total
+        st["got"] = st.get("got", 0) + 1
+        if st["got"] >= total:
+            st["event"].set()
+
+    def _request(self, dest: Enr, msg_builder, timeout=REQUEST_TIMEOUT):
+        req_id = secrets.token_bytes(8)
+        st = {"event": threading.Event(), "nodes": [], "total": None}
+        with self._lock:
+            self._requests[req_id] = st
+        self.known_enrs.setdefault(dest.node_id, dest)
+        self._seal_and_send(dest, msg_builder(req_id))
+        st["event"].wait(timeout)
+        with self._lock:
+            self._requests.pop(req_id, None)
+        return st
+
+    # -- public API --------------------------------------------------------
+
+    def ping(self, dest: Enr, timeout=REQUEST_TIMEOUT) -> bool:
+        st = self._request(dest, lambda rid: ping(rid, self.enr.seq), timeout)
+        ok = "result" in st
+        if ok:
+            self.table.insert(dest)
+        return ok
+
+    def find_node(
+        self, dest: Enr, distances: list[int], timeout=REQUEST_TIMEOUT
+    ) -> list[Enr]:
+        st = self._request(dest, lambda rid: findnode(rid, distances), timeout)
+        return st["nodes"]
+
+    def talk_req(
+        self, dest: Enr, protocol: bytes, request: bytes, timeout=REQUEST_TIMEOUT
+    ) -> bytes | None:
+        st = self._request(
+            dest,
+            lambda rid: encode_message(MSG_TALKREQ, [rid, protocol, request]),
+            timeout,
+        )
+        res = st.get("result")
+        return bytes(res[1][1]) if res else None
+
+    def bootstrap(self, boot_enrs: list[Enr]):
+        for e in boot_enrs:
+            self.known_enrs[e.node_id] = e
+            if self.ping(e):
+                self.table.insert(e)
+
+    def _query_peer(self, peer: Enr, target: bytes) -> list[Enr]:
+        """FINDNODE ``peer`` for nodes near ``target``, widening the
+        distance window until something comes back (random node ids
+        cluster at high log-distances, so a fixed d±1 window misses)."""
+        d = log2_distance(target, peer.node_id) or 256
+        ordered, lo, hi = [d], d, d
+        while lo > 1 or hi < 256:
+            if hi < 256:
+                hi += 1
+                ordered.append(hi)
+            if lo > 1:
+                lo -= 1
+                ordered.append(lo)
+        found: list[Enr] = []
+        for i in range(0, min(len(ordered), 32), 8):
+            found = self.find_node(peer, ordered[i : i + 8])
+            if found:
+                break
+        return found
+
+    def lookup(self, target_id: bytes | None = None, rounds: int = 3) -> list[Enr]:
+        """Iterative FINDNODE toward ``target_id`` (default: self — the
+        table-refresh lookup discovery runs continuously)."""
+        target = target_id or self.node_id
+        seen: set[bytes] = {self.node_id}
+        results: dict[bytes, Enr] = {}
+        frontier = self.table.closest(target, LOOKUP_ALPHA) or list(
+            self.known_enrs.values()
+        )
+        for _ in range(rounds):
+            nxt: list[Enr] = []
+            for peer in frontier[:LOOKUP_ALPHA]:
+                if peer.node_id in seen:
+                    continue
+                seen.add(peer.node_id)
+                for rec in self._query_peer(peer, target):
+                    if rec.node_id not in results and rec.node_id != self.node_id:
+                        results[rec.node_id] = rec
+                        self.table.insert(rec)
+                        nxt.append(rec)
+            if not nxt:
+                break
+            nxt.sort(key=lambda e: log2_distance(target, e.node_id))
+            frontier = nxt
+        return list(results.values())
+
+
+class BootNode:
+    """Answer-only discv5 server (boot_node/src/server.rs): maintains a
+    table from inbound traffic and serves FINDNODE, never dials out."""
+
+    def __init__(self, ip: str = "127.0.0.1", port: int = 0, key=None):
+        self.service = Discv5Service(key=key, ip=ip, port=port)
+
+    @property
+    def enr(self) -> Enr:
+        return self.service.enr
+
+    def start(self):
+        self.service.start()
+
+    def stop(self):
+        self.service.stop()

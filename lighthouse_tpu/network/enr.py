@@ -1,0 +1,212 @@
+"""Ethereum Node Records (EIP-778) with the "v4" identity scheme.
+
+The node identity format carried by discv5 and embedded in network configs
+(reference: `beacon_node/lighthouse_network/src/discovery/enr.rs` — eth2
+fork-digest field, attestation/sync-committee bitfield fields —
+and `enr_ext.rs`).  A record is an RLP list
+
+    [signature, seq, k1, v1, k2, v2, ...]
+
+with keys sorted, signed by the node's secp256k1 key over
+``keccak256(rlp([seq, k1, v1, ...]))``, and textual form
+``enr:<base64url(rlp)>``.  Node id = keccak256(uncompressed pubkey x||y).
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass, field
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, utils as asn1_utils
+
+from ..crypto.keccak import keccak256
+from . import rlp
+
+MAX_ENR_SIZE = 300  # EIP-778 hard cap
+
+# eth2-specific keys (enr.rs: ETH2_ENR_KEY, ATTESTATION_BITFIELD_ENR_KEY, ...)
+ETH2_KEY = b"eth2"
+ATTNETS_KEY = b"attnets"
+SYNCNETS_KEY = b"syncnets"
+
+
+def _pubkey_to_compressed(pub: ec.EllipticCurvePublicKey) -> bytes:
+    return pub.public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+    )
+
+
+def _pubkey_to_uncompressed_xy(pub: ec.EllipticCurvePublicKey) -> bytes:
+    raw = pub.public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+    )
+    return raw[1:]  # strip 0x04
+
+
+def node_id_of(pubkey_compressed: bytes) -> bytes:
+    pub = ec.EllipticCurvePublicKey.from_encoded_point(
+        ec.SECP256K1(), pubkey_compressed
+    )
+    return keccak256(_pubkey_to_uncompressed_xy(pub))
+
+
+def _sig_to_raw64(der_sig: bytes) -> bytes:
+    r, s = asn1_utils.decode_dss_signature(der_sig)
+    # low-s normalization (the v4 scheme stores 64-byte r||s)
+    n = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+    if s > n // 2:
+        s = n - s
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def _raw64_to_der(sig: bytes) -> bytes:
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    return asn1_utils.encode_dss_signature(r, s)
+
+
+def sign_keccak(key: ec.EllipticCurvePrivateKey, msg: bytes) -> bytes:
+    """64-byte r||s ECDSA signature over keccak256(msg) (v4 scheme)."""
+    digest = keccak256(msg)
+    der = key.sign(digest, ec.ECDSA(asn1_utils.Prehashed(hashes.SHA256())))
+    return _sig_to_raw64(der)
+
+
+def verify_keccak(pubkey_compressed: bytes, msg: bytes, sig: bytes) -> bool:
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), pubkey_compressed
+        )
+        pub.verify(
+            _raw64_to_der(sig),
+            keccak256(msg),
+            ec.ECDSA(asn1_utils.Prehashed(hashes.SHA256())),
+        )
+        return True
+    except Exception:
+        return False
+
+
+@dataclass
+class Enr:
+    """A decoded node record; ``kv`` holds raw value bytes per key."""
+
+    seq: int = 1
+    kv: dict = field(default_factory=dict)
+    signature: bytes = b""
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def pubkey(self) -> bytes | None:
+        return self.kv.get(b"secp256k1")
+
+    @property
+    def node_id(self) -> bytes:
+        pk = self.pubkey
+        if pk is None:
+            raise ValueError("ENR has no secp256k1 key")
+        return node_id_of(pk)
+
+    @property
+    def ip4(self) -> str | None:
+        raw = self.kv.get(b"ip")
+        if raw is None or len(raw) != 4:
+            return None
+        return ".".join(str(b) for b in raw)
+
+    @property
+    def udp_port(self) -> int | None:
+        raw = self.kv.get(b"udp")
+        return rlp.decode_uint(raw) if raw is not None else None
+
+    @property
+    def tcp_port(self) -> int | None:
+        raw = self.kv.get(b"tcp")
+        return rlp.decode_uint(raw) if raw is not None else None
+
+    def udp_endpoint(self) -> tuple[str, int] | None:
+        ip, port = self.ip4, self.udp_port
+        if ip is None or port is None:
+            return None
+        return (ip, port)
+
+    # -- codec -------------------------------------------------------------
+
+    def _content(self) -> list:
+        items: list = [rlp.encode_uint(self.seq)]
+        for k in sorted(self.kv):
+            items += [k, self.kv[k]]
+        return items
+
+    def signing_payload(self) -> bytes:
+        return rlp.encode(self._content())
+
+    def to_rlp(self) -> bytes:
+        out = rlp.encode([self.signature] + self._content())
+        if len(out) > MAX_ENR_SIZE:
+            raise ValueError(f"ENR exceeds {MAX_ENR_SIZE} bytes")
+        return out
+
+    def to_text(self) -> str:
+        return "enr:" + base64.urlsafe_b64encode(self.to_rlp()).rstrip(b"=").decode()
+
+    def verify(self) -> bool:
+        pk = self.pubkey
+        if pk is None or self.kv.get(b"id") != b"v4":
+            return False
+        return verify_keccak(pk, self.signing_payload(), self.signature)
+
+    @classmethod
+    def from_rlp(cls, raw: bytes) -> "Enr":
+        if len(raw) > MAX_ENR_SIZE:
+            raise ValueError("oversized ENR")
+        items = rlp.decode(raw)
+        if not isinstance(items, list) or len(items) < 2 or len(items) % 2 != 0:
+            raise ValueError("malformed ENR")
+        sig, seq, *pairs = items
+        kv = {}
+        prev = None
+        for i in range(0, len(pairs), 2):
+            k, v = pairs[i], pairs[i + 1]
+            if prev is not None and k <= prev:
+                raise ValueError("ENR keys not sorted/unique")
+            prev = k
+            kv[k] = v
+        rec = cls(seq=rlp.decode_uint(seq), kv=kv, signature=sig)
+        if not rec.verify():
+            raise ValueError("ENR signature invalid")
+        return rec
+
+    @classmethod
+    def from_text(cls, text: str) -> "Enr":
+        if not text.startswith("enr:"):
+            raise ValueError("missing enr: prefix")
+        b64 = text[4:]
+        b64 += "=" * (-len(b64) % 4)
+        return cls.from_rlp(base64.urlsafe_b64decode(b64))
+
+
+def build_enr(
+    key: ec.EllipticCurvePrivateKey,
+    seq: int = 1,
+    ip4: str | None = None,
+    udp: int | None = None,
+    tcp: int | None = None,
+    extra: dict | None = None,
+) -> Enr:
+    """Create and sign a record for ``key`` (v4 identity scheme)."""
+    kv: dict = {b"id": b"v4", b"secp256k1": _pubkey_to_compressed(key.public_key())}
+    if ip4 is not None:
+        kv[b"ip"] = bytes(int(p) for p in ip4.split("."))
+    if udp is not None:
+        kv[b"udp"] = rlp.encode_uint(udp)
+    if tcp is not None:
+        kv[b"tcp"] = rlp.encode_uint(tcp)
+    for k, v in (extra or {}).items():
+        kv[k] = v
+    rec = Enr(seq=seq, kv=kv)
+    rec.signature = sign_keccak(key, rec.signing_payload())
+    assert rec.verify()
+    return rec

@@ -1,0 +1,102 @@
+"""Recursive Length Prefix (RLP) codec.
+
+The serialization under ENRs and every discv5 message body (reference:
+discv5/enr crates pulled in by `beacon_node/lighthouse_network`, e.g.
+`src/discovery/enr.rs`).  Items are ``bytes`` or (nested) lists of items;
+integers are encoded big-endian with no leading zeros per the Ethereum
+convention (0 encodes as the empty byte string).
+"""
+
+from __future__ import annotations
+
+Item = "bytes | int | list"
+
+
+def encode_uint(n: int) -> bytes:
+    if n == 0:
+        return b""
+    return n.to_bytes((n.bit_length() + 7) // 8, "big")
+
+
+def decode_uint(b: bytes) -> int:
+    return int.from_bytes(b, "big")
+
+
+def _encode_length(length: int, base: int) -> bytes:
+    if length < 56:
+        return bytes([base + length])
+    ln = encode_uint(length)
+    return bytes([base + 55 + len(ln)]) + ln
+
+
+def encode(item) -> bytes:
+    if isinstance(item, int):
+        item = encode_uint(item)
+    if isinstance(item, (bytes, bytearray)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _encode_length(len(item), 0x80) + item
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(encode(x) for x in item)
+        return _encode_length(len(payload), 0xC0) + payload
+    raise TypeError(f"cannot RLP-encode {type(item)}")
+
+
+def _decode_at(data: bytes, pos: int):
+    """-> (item, next_pos).  Strings decode to bytes, lists to list."""
+    if pos >= len(data):
+        raise ValueError("RLP: truncated input")
+    b0 = data[pos]
+    if b0 < 0x80:
+        return data[pos : pos + 1], pos + 1
+    if b0 < 0xB8:  # short string
+        n = b0 - 0x80
+        end = pos + 1 + n
+        s = data[pos + 1 : end]
+        if len(s) != n:
+            raise ValueError("RLP: truncated string")
+        if n == 1 and s[0] < 0x80:
+            raise ValueError("RLP: non-canonical single byte")
+        return s, end
+    if b0 < 0xC0:  # long string
+        ll = b0 - 0xB7
+        n = decode_uint(data[pos + 1 : pos + 1 + ll])
+        if ll > 1 and data[pos + 1] == 0 or n < 56:
+            raise ValueError("RLP: non-canonical length")
+        end = pos + 1 + ll + n
+        s = data[pos + 1 + ll : end]
+        if len(s) != n:
+            raise ValueError("RLP: truncated string")
+        return s, end
+    if b0 < 0xF8:  # short list
+        n = b0 - 0xC0
+        end = pos + 1 + n
+        if end > len(data):
+            raise ValueError("RLP: truncated list")
+        return _decode_list(data, pos + 1, end), end
+    ll = b0 - 0xF7
+    n = decode_uint(data[pos + 1 : pos + 1 + ll])
+    if ll > 1 and data[pos + 1] == 0 or n < 56:
+        raise ValueError("RLP: non-canonical length")
+    end = pos + 1 + ll + n
+    if end > len(data):
+        raise ValueError("RLP: truncated list")
+    return _decode_list(data, pos + 1 + ll, end), end
+
+
+def _decode_list(data: bytes, start: int, end: int) -> list:
+    out, pos = [], start
+    while pos < end:
+        item, pos = _decode_at(data, pos)
+        out.append(item)
+    if pos != end:
+        raise ValueError("RLP: list payload overrun")
+    return out
+
+
+def decode(data: bytes):
+    item, pos = _decode_at(bytes(data), 0)
+    if pos != len(data):
+        raise ValueError("RLP: trailing bytes")
+    return item

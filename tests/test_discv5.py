@@ -1,0 +1,262 @@
+"""Discovery stack: keccak, RLP, ENR (EIP-778), discv5 wire + service.
+
+Covers the role of the discv5/enr crates in the reference
+(`beacon_node/lighthouse_network/src/discovery/`, `boot_node/`):
+external KATs for the primitives, packet-codec round trips, and live
+two-node + bootnode UDP exchanges on localhost.
+"""
+
+import secrets
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from lighthouse_tpu.crypto.keccak import keccak256
+from lighthouse_tpu.network import rlp
+from lighthouse_tpu.network.discv5 import (
+    BootNode,
+    Discv5Service,
+    KBuckets,
+    decode_packet,
+    derive_keys,
+    encode_packet,
+    id_sign,
+    id_verify,
+    log2_distance,
+    _compressed_pub,
+    _ecdh_compressed,
+    FLAG_MESSAGE,
+)
+from lighthouse_tpu.network.enr import Enr, build_enr
+
+# EIP-778 example record and its published node id / key
+EIP778_ENR = (
+    "enr:-IS4QHCYrYZbAKWCBRlAy5zzaDZXJBGkcnh4MHcBFZntXNFrdvJjX04jRzjzCBOonrkTfj499SZu"
+    "Oh8R33Ls8RRcy5wBgmlkgnY0gmlwhH8AAAGJc2VjcDI1NmsxoQPKY0yuDUmstAHYpMa2_oxVtw0RW_QA"
+    "dpzBQA8yWM0xOIN1ZHCCdl8"
+)
+EIP778_NODE_ID = "a448f24c6d18e575453db13171562b71999873db5b286df957af199ec94617f7"
+EIP778_PRIVKEY = 0xB71C71A67E1177AD4E901695E1B4B9EE17AE16C6668D313EAC2F96DBCDA3F291
+
+
+class TestKeccak:
+    def test_known_vectors(self):
+        assert (
+            keccak256(b"").hex()
+            == "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+        )
+        assert (
+            keccak256(b"abc").hex()
+            == "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+        )
+
+    def test_multiblock_and_boundary_lengths(self):
+        # pad path at rate-1 (135) and exact-rate (136) inputs
+        for n in (134, 135, 136, 137, 271, 272, 273):
+            d = keccak256(b"q" * n)
+            assert len(d) == 32
+            assert d != keccak256(b"q" * (n + 1))
+
+
+class TestRlp:
+    def test_scalar_vectors(self):
+        # canonical vectors from the Ethereum RLP spec
+        assert rlp.encode(b"dog") == b"\x83dog"
+        assert rlp.encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+        assert rlp.encode(b"") == b"\x80"
+        assert rlp.encode(0) == b"\x80"
+        assert rlp.encode(15) == b"\x0f"
+        assert rlp.encode(1024) == b"\x82\x04\x00"
+        assert rlp.encode([]) == b"\xc0"
+        assert rlp.encode([[], [[]], [[], [[]]]]).hex() == "c7c0c1c0c3c0c1c0"
+
+    def test_long_string_and_roundtrip(self):
+        s = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+        enc = rlp.encode(s)
+        assert enc[0] == 0xB8 and rlp.decode(enc) == s
+        nested = [b"a", [b"bb", [b"ccc", 7 * b"d"], b""], b"\x01"]
+        assert rlp.decode(rlp.encode(nested)) == [
+            b"a", [b"bb", [b"ccc", 7 * b"d"], b""], b"\x01",
+        ]
+
+    def test_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            rlp.decode(b"\x83do")  # truncated
+        with pytest.raises(ValueError):
+            rlp.decode(b"\x81\x05")  # non-canonical single byte
+        with pytest.raises(ValueError):
+            rlp.decode(b"\x83dog!")  # trailing bytes
+
+
+class TestEnr:
+    def test_eip778_vector(self):
+        rec = Enr.from_text(EIP778_ENR)
+        assert rec.node_id.hex() == EIP778_NODE_ID
+        assert rec.seq == 1
+        assert rec.ip4 == "127.0.0.1"
+        assert rec.udp_port == 30303
+        assert rec.verify()
+
+    def test_resign_with_published_key_matches(self):
+        key = ec.derive_private_key(EIP778_PRIVKEY, ec.SECP256K1())
+        mine = build_enr(key, seq=1, ip4="127.0.0.1", udp=30303)
+        ref = Enr.from_text(EIP778_ENR)
+        assert mine.node_id == ref.node_id
+        assert mine.kv == ref.kv
+        assert Enr.from_text(mine.to_text()).verify()
+
+    def test_tampered_record_rejected(self):
+        rec = Enr.from_text(EIP778_ENR)
+        rec.kv[b"udp"] = rlp.encode_uint(31313)
+        assert not rec.verify()
+        with pytest.raises(ValueError):
+            Enr.from_rlp(rec.to_rlp())
+
+    def test_eth2_extra_fields_roundtrip(self):
+        key = ec.generate_private_key(ec.SECP256K1())
+        rec = build_enr(
+            key, ip4="10.0.0.2", udp=9000, tcp=9001,
+            extra={b"eth2": b"\xaa" * 16, b"attnets": b"\xff" * 8},
+        )
+        back = Enr.from_text(rec.to_text())
+        assert back.kv[b"eth2"] == b"\xaa" * 16
+        assert back.kv[b"attnets"] == b"\xff" * 8
+        assert back.tcp_port == 9001
+
+
+class TestPacketCodec:
+    def test_mask_roundtrip_all_flags(self):
+        dest = secrets.token_bytes(32)
+        for flag, authdata in (
+            (0, secrets.token_bytes(32)),
+            (1, secrets.token_bytes(24)),
+            (2, secrets.token_bytes(34 + 64 + 33)),
+        ):
+            nonce = secrets.token_bytes(12)
+            ct = secrets.token_bytes(40) if flag != 1 else b""
+            pkt = encode_packet(dest, flag, nonce, authdata, ct)
+            f2, n2, a2, _hdr, _iv, m2 = decode_packet(dest, pkt)
+            assert (f2, n2, a2, m2) == (flag, nonce, authdata, ct)
+
+    def test_wrong_destination_cannot_unmask(self):
+        dest = secrets.token_bytes(32)
+        pkt = encode_packet(dest, FLAG_MESSAGE, secrets.token_bytes(12),
+                            secrets.token_bytes(32), b"x")
+        with pytest.raises(ValueError):
+            decode_packet(secrets.token_bytes(32), pkt)
+
+    def test_key_derivation_symmetry(self):
+        a = ec.generate_private_key(ec.SECP256K1())
+        b = ec.generate_private_key(ec.SECP256K1())
+        sec_ab = _ecdh_compressed(a, _compressed_pub(b))
+        sec_ba = _ecdh_compressed(b, _compressed_pub(a))
+        assert sec_ab == sec_ba and len(sec_ab) == 33 and sec_ab[0] in (2, 3)
+        cd = secrets.token_bytes(63)
+        ids = (secrets.token_bytes(32), secrets.token_bytes(32))
+        assert derive_keys(sec_ab, cd, *ids) == derive_keys(sec_ba, cd, *ids)
+
+    def test_id_signature(self):
+        key = ec.generate_private_key(ec.SECP256K1())
+        static_pub = _compressed_pub(key)
+        cd, eph, dest = (secrets.token_bytes(n) for n in (60, 33, 32))
+        sig = id_sign(key, cd, eph, dest)
+        assert id_verify(static_pub, sig, cd, eph, dest)
+        assert not id_verify(static_pub, sig, cd, eph, secrets.token_bytes(32))
+
+
+class TestKBuckets:
+    def test_distance(self):
+        a = bytes(32)
+        assert log2_distance(a, a) == 0
+        assert log2_distance(a, bytes(31) + b"\x01") == 1
+        assert log2_distance(a, b"\x80" + bytes(31)) == 256
+
+    def test_insert_evict_and_closest(self):
+        local = bytes(32)
+        table = KBuckets(local)
+        key = ec.generate_private_key(ec.SECP256K1())
+        recs = [build_enr(key, seq=i + 1, ip4="127.0.0.1", udp=1000 + i)
+                for i in range(3)]
+        # same key -> same node id: seq update replaces, no duplicates
+        for r in recs:
+            table.insert(r)
+        assert len(table) == 1
+        d = log2_distance(local, recs[0].node_id)
+        assert table.at_distance(d)[0].seq == 3
+        assert table.closest(recs[0].node_id)[0].node_id == recs[0].node_id
+        assert table.insert(build_enr(key, seq=9)) and len(table) == 1
+        # fill a bucket past k to exercise LRU eviction
+        many = [build_enr(ec.generate_private_key(ec.SECP256K1()), udp=2000 + i)
+                for i in range(40)]
+        for r in many:
+            table.insert(r)
+        for b in table.buckets:
+            assert len(b) <= 16
+
+
+@pytest.fixture
+def three_nodes():
+    boot = BootNode()
+    a = Discv5Service()
+    b = Discv5Service()
+    boot.start(); a.start(); b.start()
+    yield boot, a, b
+    a.stop(); b.stop(); boot.stop()
+
+
+class TestLiveService:
+    def test_handshake_ping_lookup_talk(self, three_nodes):
+        boot, a, b = three_nodes
+        a.bootstrap([boot.enr])
+        b.bootstrap([boot.enr])
+        assert a.ping(boot.enr)
+        found = a.lookup()
+        assert any(e.node_id == b.node_id for e in found)
+        bt = next(e for e in found if e.node_id == b.node_id)
+        assert a.ping(bt)
+        # sessions established in both directions survive reuse
+        assert a.ping(bt) and a.ping(boot.enr)
+        b.talk_handlers[b"lh"] = lambda src, req: b"ok:" + req
+        assert a.talk_req(bt, b"lh", b"x") == b"ok:x"
+
+    def test_findnode_distance_zero_returns_self(self, three_nodes):
+        boot, a, _b = three_nodes
+        a.known_enrs[boot.enr.node_id] = boot.enr
+        recs = a.find_node(boot.enr, [0])
+        assert [r.node_id for r in recs] == [boot.enr.node_id]
+
+    def test_unreachable_peer_times_out(self):
+        a = Discv5Service()
+        a.start()
+        try:
+            ghost = build_enr(
+                ec.generate_private_key(ec.SECP256K1()),
+                ip4="127.0.0.1", udp=1,  # nothing listens there
+            )
+            assert not a.ping(ghost, timeout=0.3)
+        finally:
+            a.stop()
+
+
+class TestBootNodeCli:
+    def test_cli_prints_enr_and_serves(self):
+        import subprocess, sys
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "lighthouse_tpu.cli", "boot-node",
+             "--port", "0", "--run-secs", "5"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            line = proc.stdout.readline().strip()
+            rec = Enr.from_text(line)
+            assert rec.udp_port is not None
+            a = Discv5Service()
+            a.start()
+            try:
+                assert a.ping(rec, timeout=2.0)
+            finally:
+                a.stop()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
