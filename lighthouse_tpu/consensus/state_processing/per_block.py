@@ -174,13 +174,21 @@ def slash_validator(
     s = list(state.slashings)
     s[epoch % preset.epochs_per_slashings_vector] += v.effective_balance
     state.slashings = s
-    # altair MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR = 64 (= phase0 128 / 2)
-    penalty = v.effective_balance // (preset.min_slashing_penalty_quotient // 2)
+    is_base = hasattr(state, "previous_epoch_attestations")
+    if is_base:
+        # phase0 MIN_SLASHING_PENALTY_QUOTIENT = 128
+        penalty = v.effective_balance // preset.min_slashing_penalty_quotient
+    else:
+        # altair MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR = 64 (= phase0 128 / 2)
+        penalty = v.effective_balance // (preset.min_slashing_penalty_quotient // 2)
     _decrease_balance(state, slashed_index, penalty)
     proposer = get_beacon_proposer_index(state, state.slot, preset)
     whistleblower = whistleblower if whistleblower is not None else proposer
     wb_reward = v.effective_balance // preset.whistleblower_reward_quotient
-    proposer_reward = wb_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
+    if is_base:
+        proposer_reward = wb_reward // preset.proposer_reward_quotient
+    else:
+        proposer_reward = wb_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
     _increase_balance(state, proposer, proposer_reward)
     _increase_balance(state, whistleblower, wb_reward - proposer_reward)
 
@@ -351,8 +359,31 @@ def process_attestation(
         _err(s.verify(), "attestation signature invalid")
 
     inclusion_delay = state.slot - data.slot
-    flags = get_attestation_participation_flags(state, data, inclusion_delay, spec)
     which = "current" if data.target.epoch == current else "previous"
+    if hasattr(state, "previous_epoch_attestations"):
+        # phase0 path (base::process_attestation): record a
+        # PendingAttestation; rewards happen at the epoch boundary.
+        justified = (
+            state.current_justified_checkpoint
+            if data.target.epoch == current
+            else state.previous_justified_checkpoint
+        )
+        _err(data.source == justified, "attestation source does not match justified")
+        # phase0 keeps the upper inclusion window (dropped in deneb)
+        _err(
+            state.slot <= data.slot + preset.slots_per_epoch,
+            "attestation past the phase0 inclusion window",
+        )
+        pending = PendingAttestation(
+            aggregation_bits=list(attestation.aggregation_bits),
+            data=data,
+            inclusion_delay=inclusion_delay,
+            proposer_index=get_beacon_proposer_index(state, state.slot, preset),
+        )
+        lst = list(getattr(state, f"{which}_epoch_attestations"))
+        setattr(state, f"{which}_epoch_attestations", lst + [pending])
+        return
+    flags = get_attestation_participation_flags(state, data, inclusion_delay, spec)
     participation = list(getattr(state, f"{which}_epoch_participation"))
     if len(participation) < len(state.validators):
         participation += [0] * (len(state.validators) - len(participation))

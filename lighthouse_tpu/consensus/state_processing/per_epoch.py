@@ -46,6 +46,18 @@ def get_current_epoch(state, preset) -> int:
 
 
 def process_epoch(state, spec: ChainSpec) -> None:
+    """Per-epoch dispatch (per_epoch_processing/mod.rs): base states (the
+    PendingAttestation forks) replay attestations; altair-line states use
+    participation flags."""
+    if hasattr(state, "previous_epoch_attestations"):
+        from .per_epoch_phase0 import process_epoch_phase0
+
+        process_epoch_phase0(state, spec)
+        return
+    process_epoch_altair(state, spec)
+
+
+def process_epoch_altair(state, spec: ChainSpec) -> None:
     """The full altair per-epoch pipeline in spec order
     (per_epoch_processing/altair/mod.rs)."""
     preset = spec.preset
@@ -99,6 +111,16 @@ def process_justification_and_finalization(
         ].sum()
     )
 
+    process_justification_with_balances(
+        state, total, prev_target, curr_target, current, previous, preset
+    )
+
+
+def process_justification_with_balances(
+    state, total, prev_target, curr_target, current, previous, preset
+):
+    """The fork-independent checkpoint math both pipelines share
+    (weigh_justification_and_finalization)."""
     old_prev = state.previous_justified_checkpoint
     old_curr = state.current_justified_checkpoint
     bits = list(state.justification_bits)
@@ -215,8 +237,10 @@ def process_rewards_and_penalties(state, va, prev_flags, current, previous, spec
     va.balances = np.maximum(va.balances + delta, 0)
 
 
-def process_registry_updates(state, va, current, spec):
-    """registry_updates.rs: eligibility, ejection, churn-limited activation."""
+def process_registry_updates(state, va, current, spec, activation_cap: bool = True):
+    """registry_updates.rs: eligibility, ejection, churn-limited activation.
+    ``activation_cap`` applies the deneb EIP-7514 cap (off on the phase0
+    path)."""
     preset = spec.preset
     # eligibility
     newly_eligible = (va.activation_eligibility_epoch == FAR) & (
@@ -242,7 +266,11 @@ def process_registry_updates(state, va, current, spec):
     )
     queue = np.nonzero(queue_mask)[0]
     order = np.lexsort((queue, va.activation_eligibility_epoch[queue]))
-    churn = _activation_churn_limit(va, current, spec)
+    churn = (
+        _activation_churn_limit(va, current, spec)
+        if activation_cap
+        else _churn_limit(va, current, spec)
+    )
     delay_epoch = _activation_exit_epoch(current, preset)
     for i in queue[order][:churn]:
         va.activation_epoch[i] = delay_epoch
@@ -277,8 +305,9 @@ def _initiate_exit(va, index: int, current: int, spec) -> None:
     )
 
 
-def process_slashings(state, va, current, spec):
-    """slashings.rs: proportional penalty at the halfway point."""
+def process_slashings(state, va, current, spec, multiplier: int = 2):
+    """slashings.rs: proportional penalty at the halfway point.
+    ``multiplier`` scales the phase0 base (1): altair 2, bellatrix+ 3."""
     preset = spec.preset
     epoch_to_penalize = current + preset.epochs_per_slashings_vector // 2
     targeted = va.slashed & (va.withdrawable_epoch == epoch_to_penalize)
@@ -286,8 +315,7 @@ def process_slashings(state, va, current, spec):
         return
     incr = spec.effective_balance_increment
     total = va.total_active_balance(current, incr)
-    # altair multiplier = 2 (bellatrix+: 3); keep the altair-line value x2
-    mult = preset.proportional_slashing_multiplier * 2
+    mult = preset.proportional_slashing_multiplier * multiplier
     total_slashings = int(np.asarray(state.slashings, dtype=np.int64).sum())
     adj = min(total_slashings * mult, total)
     # spec: penalty_numerator = eb // incr * adj; penalty = num // total * incr

@@ -21,7 +21,6 @@ Host-side networking only; nothing here touches the device.
 
 from __future__ import annotations
 
-import os
 import secrets
 import selectors
 import socket
@@ -37,7 +36,7 @@ from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
 from ..utils.logging import get_logger
 from . import rlp
-from .enr import Enr, build_enr, node_id_of
+from .enr import Enr, _raw64_to_der, _sig_to_raw64, build_enr
 
 log = get_logger("discv5")
 
@@ -64,7 +63,6 @@ MAX_NODES_PER_MSG = 4  # ENRs per NODES response (fits one UDP datagram)
 
 # secp256k1 curve params for the compressed-point ECDH the spec requires
 _P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
-_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
 
 
 def _pt_decompress(comp: bytes) -> tuple[int, int]:
@@ -195,10 +193,7 @@ def id_sign(
     digest = hashes.Hash(hashes.SHA256())
     digest.update(ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_id)
     der = key.sign(digest.finalize(), ec.ECDSA(asn1_utils.Prehashed(hashes.SHA256())))
-    r, s = asn1_utils.decode_dss_signature(der)
-    if s > _N // 2:
-        s = _N - s
-    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    return _sig_to_raw64(der)
 
 
 def id_verify(
@@ -212,9 +207,7 @@ def id_verify(
         pub = ec.EllipticCurvePublicKey.from_encoded_point(
             ec.SECP256K1(), static_pubkey
         )
-        der = asn1_utils.encode_dss_signature(
-            int.from_bytes(sig[:32], "big"), int.from_bytes(sig[32:], "big")
-        )
+        der = _raw64_to_der(sig)
         digest = hashes.Hash(hashes.SHA256())
         digest.update(ID_SIGNATURE_TEXT + challenge_data + eph_pubkey + dest_id)
         pub.verify(
@@ -471,11 +464,13 @@ class Discv5Service:
         if sess is not None:
             try:
                 plain = AESGCM(sess.recv_key).decrypt(nonce, message_ct, iv + header)
+            except Exception:
+                del self.sessions[src_id]  # stale keys: fall through
+                plain = None
+            if plain is not None:
                 self.addr_of[src_id] = addr
                 self._dispatch(src_id, addr, plain)
                 return
-            except Exception:
-                del self.sessions[src_id]  # stale keys: fall through
         # Unreadable: challenge the sender (spec: respond WHOAREYOU).
         known = self.known_enrs.get(src_id)
         id_nonce = secrets.token_bytes(16)
@@ -589,10 +584,12 @@ class Discv5Service:
             for item in body[2]:
                 try:
                     rec = Enr.from_rlp(rlp.encode(item))
-                    recs.append(rec)
-                    self.known_enrs[rec.node_id] = rec
                 except ValueError:
                     continue
+                recs.append(rec)
+                known = self.known_enrs.get(rec.node_id)
+                if known is None or rec.seq >= known.seq:
+                    self.known_enrs[rec.node_id] = rec
             self._accumulate_nodes(req_id, total, recs)
         elif msg_type == MSG_TALKREQ:
             req_id, protocol, request = body[0], body[1], body[2]
@@ -610,12 +607,9 @@ class Discv5Service:
             self._seal_and_send(enr, msg_plain)
 
     def _request_enr_refresh(self, nid: bytes):
-        req_id = secrets.token_bytes(8)
-        with self._lock:
-            self._requests[req_id] = {
-                "event": threading.Event(), "nodes": [], "total": None, "kind": "nodes",
-            }
-        self._send_to_id(nid, findnode(req_id, [0]))
+        # fire-and-forget: the MSG_NODES handler records any returned
+        # record into known_enrs without needing a registered waiter
+        self._send_to_id(nid, findnode(secrets.token_bytes(8), [0]))
 
     # -- request/response plumbing ----------------------------------------
 

@@ -1,0 +1,205 @@
+"""Phase0 (base fork) state transition: PendingAttestation replay.
+
+Twin of consensus/state_processing/src/per_epoch_processing/base/ tests:
+pending attestations accumulate at block processing and are replayed at
+the epoch boundary for justification, rewards (incl. inclusion-delay and
+proposer components), penalties, and the attestation rotation.
+"""
+
+import pytest
+
+from lighthouse_tpu.consensus import committees as cm
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.containers import (
+    Attestation,
+    AttestationData,
+    Checkpoint,
+    PendingAttestation,
+)
+from lighthouse_tpu.consensus.state_processing.per_block import (
+    process_attestation,
+    slash_validator,
+)
+from lighthouse_tpu.consensus.state_processing.per_epoch_phase0 import (
+    EpochAttestations,
+)
+from lighthouse_tpu.consensus.state_processing.per_slot import process_slots
+from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+
+N = 16
+
+
+@pytest.fixture()
+def base():
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(N, spec, fork="base")
+    return spec, state, keys
+
+
+def _attest_epoch_fully(state, epoch: int, spec, proposer: int = 0):
+    """Synthesize full-committee PendingAttestations for ``epoch`` (state
+    must already be past it so roots are in the history vectors)."""
+    preset = spec.preset
+    cache = cm.CommitteeCache(state, epoch, preset)
+    shr = preset.slots_per_historical_root
+    target_root = bytes(state.block_roots[(epoch * preset.slots_per_epoch) % shr])
+    pending = []
+    for slot in range(
+        epoch * preset.slots_per_epoch, (epoch + 1) * preset.slots_per_epoch
+    ):
+        for index in range(cache.committees_per_slot):
+            committee = cache.committee(slot, index)
+            data = AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=bytes(state.block_roots[slot % shr]),
+                source=state.previous_justified_checkpoint
+                if epoch < state.slot // preset.slots_per_epoch
+                else state.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=target_root),
+            )
+            pending.append(
+                PendingAttestation(
+                    aggregation_bits=[True] * len(committee),
+                    data=data,
+                    inclusion_delay=1,
+                    proposer_index=proposer,
+                )
+            )
+    return pending
+
+
+def test_base_state_epoch_advance(base):
+    spec, state, _ = base
+    per_epoch = spec.preset.slots_per_epoch
+    process_slots(state, per_epoch + 1, spec)
+    assert state.slot == per_epoch + 1
+    # pending attestations rotated (empty -> empty, but fields exist)
+    assert list(state.current_epoch_attestations) == []
+
+
+def test_full_participation_justifies_and_finalizes(base):
+    spec, state, _ = base
+    per_epoch = spec.preset.slots_per_epoch
+    # run several epochs with full previous-epoch participation
+    for epoch in range(1, 5):
+        process_slots(state, epoch * per_epoch, spec)
+        state.previous_epoch_attestations = _attest_epoch_fully(
+            state, epoch - 1, spec
+        )
+    process_slots(state, 5 * per_epoch, spec)
+    assert state.current_justified_checkpoint.epoch > 0
+    assert state.finalized_checkpoint.epoch > 0, (
+        "sustained supermajority must finalize on the phase0 path"
+    )
+
+
+def test_rewards_and_inclusion_delay_proposer(base):
+    spec, state, _ = base
+    per_epoch = spec.preset.slots_per_epoch
+    proposer = 3
+    process_slots(state, per_epoch, spec)
+    state.previous_epoch_attestations = _attest_epoch_fully(
+        state, 0, spec, proposer=proposer
+    )
+    before = list(state.balances)
+    process_slots(state, 2 * per_epoch, spec)
+    gained = [a - b for a, b in zip(state.balances, before)]
+    assert all(g > 0 for g in gained), "full participation must reward everyone"
+    # the inclusion proposer collects one proposer reward per attester
+    assert gained[proposer] == max(gained), "proposer collects inclusion rewards"
+
+
+def test_nonparticipation_penalized(base):
+    spec, state, _ = base
+    per_epoch = spec.preset.slots_per_epoch
+    process_slots(state, per_epoch, spec)
+    before = list(state.balances)
+    process_slots(state, 2 * per_epoch, spec)
+    assert all(a < b for a, b in zip(state.balances, before))
+
+
+def test_leak_penalizes_nontarget(base):
+    spec, state, _ = base
+    preset = spec.preset
+    per_epoch = preset.slots_per_epoch
+    leak_start = preset.min_epochs_to_inactivity_penalty + 2
+    process_slots(state, leak_start * per_epoch, spec)
+    before = list(state.balances)
+    # half the committee attests, half does not, while unfinalized (leak)
+    pending = _attest_epoch_fully(state, leak_start - 1, spec)
+    for p in pending:
+        bits = list(p.aggregation_bits)
+        p.aggregation_bits = [b and i % 2 == 0 for i, b in enumerate(bits)]
+    state.previous_epoch_attestations = pending
+    process_slots(state, (leak_start + 1) * per_epoch, spec)
+    deltas = [a - b for a, b in zip(state.balances, before)]
+    # leak: even attesters at best break even; absentees lose quadratically
+    attesters = {
+        int(v)
+        for p in pending
+        for i, v in enumerate(
+            cm.CommitteeCache(state, leak_start - 1, preset).committee(
+                p.data.slot, p.data.index
+            )
+        )
+        if p.aggregation_bits[i]
+    }
+    absent = set(range(N)) - attesters
+    assert all(deltas[i] < 0 for i in absent)
+    assert sum(deltas[i] for i in absent) < sum(deltas[i] for i in attesters)
+
+
+def test_process_attestation_appends_pending(base):
+    spec, state, keys = base
+    preset = spec.preset
+    process_slots(state, 1, spec)
+    cache = cm.CommitteeCache(state, 0, preset)
+    committee = cache.committee(0, 0)
+    data = AttestationData(
+        slot=0,
+        index=0,
+        beacon_block_root=bytes(state.block_roots[0]),
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(epoch=0, root=bytes(state.block_roots[0])),
+    )
+    att = Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=b"\x00" * 96,
+    )
+    balances_before = list(state.balances)
+    process_attestation(
+        state, att, spec, cache, verify_signatures=False, get_pubkey=None
+    )
+    assert len(state.current_epoch_attestations) == 1
+    rec = state.current_epoch_attestations[0]
+    assert rec.inclusion_delay == 1
+    # phase0: no immediate proposer reward — balances untouched at block time
+    assert list(state.balances) == balances_before
+
+
+def test_epoch_attestations_masks(base):
+    spec, state, _ = base
+    preset = spec.preset
+    process_slots(state, preset.slots_per_epoch, spec)
+    pending = _attest_epoch_fully(state, 0, spec)
+    atts = EpochAttestations(state, 0, pending, preset)
+    assert atts.source.all() and atts.target.all() and atts.head.all()
+    assert (atts.inclusion_delay == 1).all()
+    # wrong target root: target/head masks drop, source stays
+    for p in pending:
+        p.data.target = Checkpoint(epoch=0, root=b"\xaa" * 32)
+    atts2 = EpochAttestations(state, 0, pending, preset)
+    assert atts2.source.all() and not atts2.target.any() and not atts2.head.any()
+
+
+def test_phase0_slashing_quotients(base):
+    spec, state, _ = base
+    process_slots(state, 1, spec)
+    eb = state.validators[5].effective_balance
+    before = state.balances[5]
+    slash_validator(state, 5, spec, whistleblower=None)
+    # phase0 immediate penalty: eb / 128
+    assert before - state.balances[5] == eb // spec.preset.min_slashing_penalty_quotient
+    assert state.validators[5].slashed
