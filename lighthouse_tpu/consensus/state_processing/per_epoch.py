@@ -37,6 +37,15 @@ def _flags(state, which: str, n: int) -> np.ndarray:
     return arr
 
 
+def _scores_array(state, n: int) -> np.ndarray:
+    """Inactivity scores zero-padded to registry length."""
+    arr = np.zeros(n, dtype=np.int64)
+    arr[: len(state.inactivity_scores)] = np.asarray(
+        state.inactivity_scores, dtype=np.int64
+    )
+    return arr
+
+
 def _unslashed_participating(va, flags: np.ndarray, flag_index: int, epoch: int):
     return va.is_active(epoch) & (~va.slashed) & ((flags >> flag_index) & 1 == 1)
 
@@ -57,9 +66,17 @@ def process_epoch(state, spec: ChainSpec) -> None:
     process_epoch_altair(state, spec)
 
 
-def process_epoch_altair(state, spec: ChainSpec) -> None:
+def process_epoch_altair(state, spec: ChainSpec, device: bool | None = None) -> None:
     """The full altair per-epoch pipeline in spec order
-    (per_epoch_processing/altair/mod.rs)."""
+    (per_epoch_processing/altair/mod.rs).
+
+    ``device=True`` (or LIGHTHOUSE_TPU_DEVICE_EPOCH=1) runs the fused XLA
+    balance pipeline (per_epoch_jax) for the O(n) steps — inactivity
+    scores, flag rewards/penalties, slashing penalties, effective-balance
+    hysteresis — in one compiled program; host code keeps the sequential
+    checkpoint/queue/committee steps (SURVEY §7.7 split)."""
+    import os
+
     preset = spec.preset
     va = ValidatorArrays.extract(state)
     n = len(state.validators)
@@ -67,18 +84,40 @@ def process_epoch_altair(state, spec: ChainSpec) -> None:
     previous = max(current, 1) - 1
     prev_flags = _flags(state, "previous", n)
     curr_flags = _flags(state, "current", n)
+    if device is None:
+        device = os.environ.get("LIGHTHOUSE_TPU_DEVICE_EPOCH", "") == "1"
 
     process_justification_and_finalization(
         state, va, prev_flags, curr_flags, current, previous, spec
     )
-    process_inactivity_updates(state, va, prev_flags, current, previous, spec)
-    process_rewards_and_penalties(
-        state, va, prev_flags, current, previous, spec
-    )
-    process_registry_updates(state, va, current, spec)
-    process_slashings(state, va, current, spec)
-    process_eth1_data_reset(state, current, preset)
-    process_effective_balance_updates(va, spec)
+    if device and current > 0:
+        from .per_epoch_jax import epoch_balance_pipeline
+
+        scores = _scores_array(state, n)
+        balances, new_scores, new_eff = epoch_balance_pipeline(
+            va,
+            prev_flags,
+            scores,
+            current,
+            previous,
+            state.finalized_checkpoint.epoch,
+            int(np.asarray(state.slashings, dtype=np.int64).sum()),
+            spec,
+        )
+        state.inactivity_scores = [int(s) for s in new_scores]
+        va.balances = balances
+        process_registry_updates(state, va, current, spec)
+        process_eth1_data_reset(state, current, preset)
+        va.effective_balance = new_eff
+    else:
+        process_inactivity_updates(state, va, prev_flags, current, previous, spec)
+        process_rewards_and_penalties(
+            state, va, prev_flags, current, previous, spec
+        )
+        process_registry_updates(state, va, current, spec)
+        process_slashings(state, va, current, spec)
+        process_eth1_data_reset(state, current, preset)
+        process_effective_balance_updates(va, spec)
     process_slashings_reset(state, current, preset)
     process_randao_mixes_reset(state, current, preset)
     process_historical_summaries_update(state, current, preset)
@@ -158,10 +197,7 @@ def process_inactivity_updates(state, va, prev_flags, current, previous, spec):
         return
     preset = spec.preset
     n = len(state.validators)
-    scores = np.zeros(n, dtype=np.int64)
-    scores[: len(state.inactivity_scores)] = np.asarray(
-        state.inactivity_scores, dtype=np.int64
-    )
+    scores = _scores_array(state, n)
     eligible = va.is_eligible(previous)
     target_ok = _unslashed_participating(
         va, prev_flags, TIMELY_TARGET_FLAG_INDEX, previous
@@ -223,10 +259,7 @@ def process_rewards_and_penalties(state, va, prev_flags, current, previous, spec
         delta -= np.where(eligible & ~participated, penalties, 0)
 
     # inactivity penalties (altair: score-scaled quadratic leak)
-    scores = np.zeros(len(delta), dtype=np.int64)
-    scores[: len(state.inactivity_scores)] = np.asarray(
-        state.inactivity_scores, dtype=np.int64
-    )
+    scores = _scores_array(state, len(delta))
     target_ok = _unslashed_participating(
         va, prev_flags, TIMELY_TARGET_FLAG_INDEX, previous
     )

@@ -1,0 +1,196 @@
+"""Device per-epoch processing — the registry-scale XLA pipeline.
+
+SURVEY §7.7: per-epoch processing over ~1M validators is an
+embarrassingly parallel dense-array workload (the reference walks
+`Vec<Validator>` loops in per_epoch_processing/altair/*.rs; rayon is its
+only parallelism).  Here the balance-moving steps — inactivity score
+drift, the three participation-flag reward components, inactivity-leak
+penalties, slashing penalties, and effective-balance hysteresis — fuse
+into ONE jitted XLA program over int64 columns:
+
+    deltas, new_scores, new_eff_balance = _epoch_kernel(cols..., scalars...)
+
+Everything that is inherently sequential or tiny stays host-side
+(justification checkpoint math, churn-limited activation/exit queues,
+sync-committee sampling) — the same split the reference's rayon loops
+imply.  The kernel is shape-stable in the registry length, so a node
+recompiles only when the registry grows past the padded size.
+
+Padding contract: callers pad columns to a fixed length with
+``effective_balance == 0`` / inactive epochs; padded lanes produce zero
+deltas, preserved scores, and unchanged effective balance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .arrays import (
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    ValidatorArrays,
+    WEIGHT_DENOMINATOR,
+)
+
+_jitted = None
+
+
+def _build_kernel():
+    """Deferred so importing this module never initializes a JAX backend."""
+    global _jitted
+    if _jitted is not None:
+        return _jitted
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(
+        jax.jit,
+        static_argnames=(
+            "inactivity_score_bias",
+            "inactivity_score_recovery_rate",
+            "inactivity_penalty_quotient",
+            "effective_balance_increment",
+            "max_effective_balance",
+        ),
+    )
+    def _epoch_kernel(
+        effective_balance,  # (n,) int64 gwei
+        balances,  # (n,) int64 gwei
+        prev_flags,  # (n,) int64 participation bitmask
+        slashed,  # (n,) bool
+        scores,  # (n,) int64 inactivity scores
+        active_prev,  # (n,) bool — active in previous epoch
+        active_curr,  # (n,) bool — active in current epoch
+        eligible,  # (n,) bool
+        slash_target,  # (n,) bool — withdrawable at the penalty epoch
+        base_reward_per_increment,  # scalar int64
+        in_leak,  # scalar bool
+        adj_total_slashing,  # scalar int64 (min(sum*mult, total))
+        *,
+        inactivity_score_bias: int,
+        inactivity_score_recovery_rate: int,
+        inactivity_penalty_quotient: int,
+        effective_balance_increment: int,
+        max_effective_balance: int,
+    ):
+        incr = effective_balance_increment
+        eb_incr = effective_balance // incr
+        total = jnp.maximum(jnp.sum(jnp.where(active_curr, effective_balance, 0)), incr)
+        total_incr = total // incr
+        base_reward = eb_incr * base_reward_per_increment
+
+        # --- inactivity score updates (altair/inactivity_updates.rs)
+        target_ok = (
+            active_prev & (~slashed) & ((prev_flags >> TIMELY_TARGET_FLAG_INDEX) & 1 == 1)
+        )
+        new_scores = jnp.where(
+            eligible & target_ok, scores - jnp.minimum(1, scores), scores
+        )
+        new_scores = jnp.where(
+            in_leak & eligible & ~target_ok,
+            new_scores + inactivity_score_bias,
+            jnp.where(
+                (~in_leak) & eligible,
+                new_scores - jnp.minimum(inactivity_score_recovery_rate, new_scores),
+                new_scores,
+            ),
+        )
+
+        # --- flag rewards/penalties (altair/rewards_and_penalties.rs)
+        delta = jnp.zeros_like(balances)
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            participated = (
+                active_prev & (~slashed) & ((prev_flags >> flag_index) & 1 == 1)
+            )
+            unslashed_incr = jnp.sum(jnp.where(participated, eb_incr, 0))
+            rewards = (
+                base_reward * weight * unslashed_incr
+                // (total_incr * WEIGHT_DENOMINATOR)
+            )
+            rewards = jnp.where(in_leak, 0, rewards)
+            if flag_index != TIMELY_HEAD_FLAG_INDEX:
+                penalties = base_reward * weight // WEIGHT_DENOMINATOR
+            else:
+                penalties = jnp.zeros_like(base_reward)
+            delta = delta + jnp.where(eligible & participated, rewards, 0)
+            delta = delta - jnp.where(eligible & ~participated, penalties, 0)
+
+        # --- inactivity-leak penalties (score-scaled quadratic; scores are
+        # updated BEFORE rewards read them in the spec's pipeline order)
+        penalty_den = inactivity_score_bias * inactivity_penalty_quotient
+        leak_pen = (effective_balance * new_scores) // penalty_den
+        delta = delta - jnp.where(eligible & ~target_ok, leak_pen, 0)
+
+        # --- slashing penalties (slashings.rs, multiplier pre-applied in
+        # adj_total_slashing): eb_incr * adjusted // total * incr
+        slash_pen = eb_incr * adj_total_slashing // total * incr
+        delta = delta - jnp.where(slash_target & slashed, slash_pen, 0)
+
+        new_balances = jnp.maximum(balances + delta, 0)
+
+        # --- effective-balance hysteresis (effective_balance_updates.rs)
+        hysteresis = incr // 4
+        down = new_balances + hysteresis < effective_balance
+        up = effective_balance + 5 * hysteresis < new_balances
+        retarget = jnp.minimum(
+            new_balances - new_balances % incr, max_effective_balance
+        )
+        new_eff = jnp.where(down | up, retarget, effective_balance)
+
+        return new_balances, new_scores, new_eff
+
+    _jitted = _epoch_kernel
+    return _jitted
+
+
+def epoch_balance_pipeline(
+    va: ValidatorArrays,
+    prev_flags: np.ndarray,
+    scores: np.ndarray,
+    current: int,
+    previous: int,
+    finalized_epoch: int,
+    total_slashings: int,
+    spec,
+    multiplier: int = 2,
+):
+    """Run the fused device pipeline; returns (balances, scores, eff_bal)
+    as numpy arrays.  Mirrors the order inactivity→rewards→slashings→
+    effective-balance of process_epoch_altair."""
+    preset = spec.preset
+    import math
+
+    kernel = _build_kernel()
+    incr = spec.effective_balance_increment
+    total = va.total_active_balance(current, incr)
+    brpi = incr * preset.base_reward_factor // math.isqrt(total)
+    finality_delay = previous - finalized_epoch
+    in_leak = finality_delay > preset.min_epochs_to_inactivity_penalty
+    mult = preset.proportional_slashing_multiplier * multiplier
+    adj = min(total_slashings * mult, total)
+    epoch_to_penalize = current + preset.epochs_per_slashings_vector // 2
+    out = kernel(
+        va.effective_balance,
+        va.balances,
+        prev_flags.astype(np.int64),
+        va.slashed,
+        scores.astype(np.int64),
+        np.asarray(va.is_active(previous)),
+        np.asarray(va.is_active(current)),
+        np.asarray(va.is_eligible(previous)),
+        np.asarray(va.withdrawable_epoch == epoch_to_penalize),
+        np.int64(brpi),
+        bool(in_leak),
+        np.int64(adj),
+        inactivity_score_bias=preset.inactivity_score_bias,
+        inactivity_score_recovery_rate=preset.inactivity_score_recovery_rate,
+        inactivity_penalty_quotient=preset.inactivity_penalty_quotient,
+        effective_balance_increment=incr,
+        max_effective_balance=spec.max_effective_balance,
+    )
+    return tuple(np.asarray(x) for x in out)

@@ -23,7 +23,6 @@ from ..spec import ChainSpec
 from .arrays import ValidatorArrays
 from .per_epoch import (
     _block_root_at_epoch,
-    _churn_limit,
     _is_in_inactivity_leak,
     process_eth1_data_reset,
     process_effective_balance_updates,
@@ -53,7 +52,6 @@ class EpochAttestations:
             return
         cache = CommitteeCache(state, epoch, preset)
         target_root = _block_root_at_epoch(state, epoch, preset)
-        spu = preset.slots_per_epoch
         shr = preset.slots_per_historical_root
         for att in attestations:
             committee = cache.committee(att.data.slot, att.data.index)
@@ -78,7 +76,6 @@ class EpochAttestations:
                 )
                 if bytes(att.data.beacon_block_root) == head_root:
                     self.head[members] = True
-        del spu
 
     def unslashed(self, mask: np.ndarray, va: ValidatorArrays) -> np.ndarray:
         return mask & ~va.slashed
@@ -189,5 +186,4 @@ __all__ = [
     "EpochAttestations",
     "process_epoch_phase0",
     "process_rewards_and_penalties_phase0",
-    "_churn_limit",
 ]

@@ -1,0 +1,179 @@
+#!/usr/bin/env python3
+"""Registry-scale epoch-processing benchmark: numpy host vs fused XLA.
+
+SURVEY §7.7 / §6: Lighthouse's per-epoch processing over the ~1M-validator
+mainnet registry is a multi-hundred-ms rayon workload (BASELINE.md's
+epoch-processing line).  This measures the balance pipeline at mainnet
+scale on both backends and prints one JSON line per backend.
+
+Usage: python tools/epoch_bench.py [n_validators] (default 1_048_576)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_registry(n: int, rng):
+    from lighthouse_tpu.consensus.state_processing.arrays import (
+        FAR,
+        ValidatorArrays,
+    )
+
+    eb = np.full(n, 32 * 10**9, dtype=np.int64)
+    eb[rng.integers(0, n, n // 50)] = 31 * 10**9
+    va = ValidatorArrays(
+        effective_balance=eb,
+        slashed=rng.random(n) < 0.001,
+        activation_eligibility_epoch=np.zeros(n, dtype=np.int64),
+        activation_epoch=np.zeros(n, dtype=np.int64),
+        exit_epoch=np.full(n, FAR),
+        withdrawable_epoch=np.full(n, FAR),
+        balances=eb + rng.integers(-(10**9), 2 * 10**9, n),
+    )
+    flags = rng.integers(0, 8, n).astype(np.int64)
+    flags[rng.random(n) < 0.95] = 7  # ~95% full participation (mainnet-like)
+    scores = np.zeros(n, dtype=np.int64)
+    return va, flags, scores
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    from lighthouse_tpu.consensus import spec as S
+    from lighthouse_tpu.consensus.state_processing.per_epoch_jax import (
+        epoch_balance_pipeline,
+    )
+    from lighthouse_tpu.consensus.testing import phase0_spec
+
+    spec = phase0_spec(S.MAINNET)
+    rng = np.random.default_rng(0)
+    va, flags, scores = build_registry(n, rng)
+    args = dict(
+        current=100_000, previous=99_999, finalized_epoch=99_998,
+        total_slashings=10**12, spec=spec,
+    )
+
+    # device (fused XLA): first call compiles, then steady-state
+    t0 = time.time()
+    out = epoch_balance_pipeline(va, flags, scores, **args)
+    compile_s = time.time() - t0
+    times = []
+    for _ in range(5):
+        t0 = time.time()
+        out = epoch_balance_pipeline(va, flags, scores, **args)
+        times.append(time.time() - t0)
+    dev_s = min(times)
+    import jax
+
+    print(json.dumps({
+        "metric": "epoch_pipeline", "backend": str(jax.devices()[0]),
+        "n_validators": n, "seconds": round(dev_s, 4),
+        "validators_per_s": round(n / dev_s), "compile_sec": round(compile_s, 1),
+        "note": "cold: host arrays shipped every call",
+    }))
+
+    # device-RESIDENT steady state: a long-running node keeps the registry
+    # columns on device between epochs (they change by deltas, not
+    # wholesale), so the per-epoch cost is kernel-only.
+    from lighthouse_tpu.consensus.state_processing.per_epoch_jax import (
+        _build_kernel,
+    )
+
+    kernel = _build_kernel()
+    import math
+
+    preset = spec.preset
+    incr = spec.effective_balance_increment
+    total = va.total_active_balance(args["current"], incr)
+    brpi = incr * preset.base_reward_factor // math.isqrt(total)
+    epoch_to_penalize = (
+        args["current"] + preset.epochs_per_slashings_vector // 2
+    )
+    dev_args = [
+        jax.device_put(x)
+        for x in (
+            va.effective_balance, va.balances, flags, va.slashed, scores,
+            np.asarray(va.is_active(args["previous"])),
+            np.asarray(va.is_active(args["current"])),
+            np.asarray(va.is_eligible(args["previous"])),
+            np.asarray(va.withdrawable_epoch == epoch_to_penalize),
+            np.int64(brpi),
+            (args["previous"] - args["finalized_epoch"])
+            > preset.min_epochs_to_inactivity_penalty,
+            np.int64(
+                min(
+                    args["total_slashings"]
+                    * preset.proportional_slashing_multiplier * 2,
+                    total,
+                )
+            ),
+        )
+    ]
+    static = dict(
+        inactivity_score_bias=preset.inactivity_score_bias,
+        inactivity_score_recovery_rate=preset.inactivity_score_recovery_rate,
+        inactivity_penalty_quotient=preset.inactivity_penalty_quotient,
+        effective_balance_increment=incr,
+        max_effective_balance=spec.max_effective_balance,
+    )
+    jax.block_until_ready(kernel(*dev_args, **static))
+    times = []
+    for _ in range(5):
+        t0 = time.time()
+        jax.block_until_ready(kernel(*dev_args, **static))
+        times.append(time.time() - t0)
+    resident_s = min(times)
+    print(json.dumps({
+        "metric": "epoch_pipeline", "backend": str(jax.devices()[0]),
+        "n_validators": n, "seconds": round(resident_s, 4),
+        "validators_per_s": round(n / resident_s),
+        "note": "device-resident registry (steady-state node)",
+    }))
+
+    # numpy host path equivalent (the same four steps, vectorized)
+    from lighthouse_tpu.consensus.containers import Checkpoint
+    from lighthouse_tpu.consensus.state_processing import per_epoch as pe
+
+    class FakeState:
+        pass
+
+    st = FakeState()
+    st.inactivity_scores = scores.tolist()
+    st.finalized_checkpoint = Checkpoint(epoch=args["finalized_epoch"])
+    st.slot = args["current"] * spec.preset.slots_per_epoch
+    st.slashings = [args["total_slashings"]]
+    st.validators = [None] * n  # host helpers only take len() of this
+    import copy
+
+    times = []
+    for _ in range(3):
+        va2 = copy.deepcopy(va)
+        t0 = time.time()
+        pe.process_inactivity_updates(
+            st, va2, flags, args["current"], args["previous"], spec
+        )
+        pe.process_rewards_and_penalties(
+            st, va2, flags, args["current"], args["previous"], spec
+        )
+        pe.process_slashings(st, va2, args["current"], spec)
+        pe.process_effective_balance_updates(va2, spec)
+        times.append(time.time() - t0)
+    host_s = min(times)
+    print(json.dumps({
+        "metric": "epoch_pipeline", "backend": "numpy-host",
+        "n_validators": n, "seconds": round(host_s, 4),
+        "validators_per_s": round(n / host_s),
+        "speedup_device": round(host_s / dev_s, 2),
+    }))
+    del out
+
+
+if __name__ == "__main__":
+    main()
