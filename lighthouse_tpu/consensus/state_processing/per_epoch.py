@@ -38,11 +38,10 @@ def _flags(state, which: str, n: int) -> np.ndarray:
 
 
 def _scores_array(state, n: int) -> np.ndarray:
-    """Inactivity scores zero-padded to registry length."""
+    """Inactivity scores zero-padded/clipped to registry length."""
     arr = np.zeros(n, dtype=np.int64)
-    arr[: len(state.inactivity_scores)] = np.asarray(
-        state.inactivity_scores, dtype=np.int64
-    )
+    lst = state.inactivity_scores
+    arr[: min(len(lst), n)] = np.asarray(lst, dtype=np.int64)[:n]
     return arr
 
 
@@ -202,13 +201,14 @@ def process_inactivity_updates(state, va, prev_flags, current, previous, spec):
     target_ok = _unslashed_participating(
         va, prev_flags, TIMELY_TARGET_FLAG_INDEX, previous
     )
+    # spec: participants decay by 1; non-participants gain the bias
+    # UNCONDITIONALLY; the recovery rate then applies (to the mid-update
+    # score) only when not in a leak.
     scores = np.where(eligible & target_ok, scores - np.minimum(1, scores), scores)
-    in_leak = _is_in_inactivity_leak(state, current, preset)
-    if in_leak:
-        scores = np.where(
-            eligible & ~target_ok, scores + preset.inactivity_score_bias, scores
-        )
-    else:
+    scores = np.where(
+        eligible & ~target_ok, scores + preset.inactivity_score_bias, scores
+    )
+    if not _is_in_inactivity_leak(state, current, preset):
         scores = np.where(
             eligible,
             scores - np.minimum(preset.inactivity_score_recovery_rate, scores),

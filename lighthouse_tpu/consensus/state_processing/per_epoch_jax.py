@@ -88,17 +88,19 @@ def _build_kernel():
         target_ok = (
             active_prev & (~slashed) & ((prev_flags >> TIMELY_TARGET_FLAG_INDEX) & 1 == 1)
         )
+        # spec: participants decay by 1; non-participants gain the bias
+        # unconditionally; recovery applies to the mid-update score only
+        # outside a leak.
         new_scores = jnp.where(
             eligible & target_ok, scores - jnp.minimum(1, scores), scores
         )
         new_scores = jnp.where(
-            in_leak & eligible & ~target_ok,
-            new_scores + inactivity_score_bias,
-            jnp.where(
-                (~in_leak) & eligible,
-                new_scores - jnp.minimum(inactivity_score_recovery_rate, new_scores),
-                new_scores,
-            ),
+            eligible & ~target_ok, new_scores + inactivity_score_bias, new_scores
+        )
+        new_scores = jnp.where(
+            (~in_leak) & eligible,
+            new_scores - jnp.minimum(inactivity_score_recovery_rate, new_scores),
+            new_scores,
         )
 
         # --- flag rewards/penalties (altair/rewards_and_penalties.rs)
@@ -148,6 +150,56 @@ def _build_kernel():
     return _jitted
 
 
+def kernel_inputs(
+    va: ValidatorArrays,
+    prev_flags: np.ndarray,
+    scores: np.ndarray,
+    current: int,
+    previous: int,
+    finalized_epoch: int,
+    total_slashings: int,
+    spec,
+    multiplier: int = 2,
+) -> tuple[list, dict]:
+    """Marshal host state into the kernel's (positional, static) arguments —
+    the ONE place the scalar prep (base reward per increment, leak flag,
+    adjusted slashings, penalty epoch) lives, shared by the node path and
+    the benchmarks."""
+    import math
+
+    preset = spec.preset
+    incr = spec.effective_balance_increment
+    total = va.total_active_balance(current, incr)
+    brpi = incr * preset.base_reward_factor // math.isqrt(total)
+    finality_delay = previous - finalized_epoch
+    in_leak = finality_delay > preset.min_epochs_to_inactivity_penalty
+    mult = preset.proportional_slashing_multiplier * multiplier
+    adj = min(total_slashings * mult, total)
+    epoch_to_penalize = current + preset.epochs_per_slashings_vector // 2
+    positional = [
+        va.effective_balance,
+        va.balances,
+        prev_flags.astype(np.int64),
+        va.slashed,
+        scores.astype(np.int64),
+        np.asarray(va.is_active(previous)),
+        np.asarray(va.is_active(current)),
+        np.asarray(va.is_eligible(previous)),
+        np.asarray(va.withdrawable_epoch == epoch_to_penalize),
+        np.int64(brpi),
+        bool(in_leak),
+        np.int64(adj),
+    ]
+    static = dict(
+        inactivity_score_bias=preset.inactivity_score_bias,
+        inactivity_score_recovery_rate=preset.inactivity_score_recovery_rate,
+        inactivity_penalty_quotient=preset.inactivity_penalty_quotient,
+        effective_balance_increment=incr,
+        max_effective_balance=spec.max_effective_balance,
+    )
+    return positional, static
+
+
 def epoch_balance_pipeline(
     va: ValidatorArrays,
     prev_flags: np.ndarray,
@@ -162,35 +214,10 @@ def epoch_balance_pipeline(
     """Run the fused device pipeline; returns (balances, scores, eff_bal)
     as numpy arrays.  Mirrors the order inactivity→rewards→slashings→
     effective-balance of process_epoch_altair."""
-    preset = spec.preset
-    import math
-
     kernel = _build_kernel()
-    incr = spec.effective_balance_increment
-    total = va.total_active_balance(current, incr)
-    brpi = incr * preset.base_reward_factor // math.isqrt(total)
-    finality_delay = previous - finalized_epoch
-    in_leak = finality_delay > preset.min_epochs_to_inactivity_penalty
-    mult = preset.proportional_slashing_multiplier * multiplier
-    adj = min(total_slashings * mult, total)
-    epoch_to_penalize = current + preset.epochs_per_slashings_vector // 2
-    out = kernel(
-        va.effective_balance,
-        va.balances,
-        prev_flags.astype(np.int64),
-        va.slashed,
-        scores.astype(np.int64),
-        np.asarray(va.is_active(previous)),
-        np.asarray(va.is_active(current)),
-        np.asarray(va.is_eligible(previous)),
-        np.asarray(va.withdrawable_epoch == epoch_to_penalize),
-        np.int64(brpi),
-        bool(in_leak),
-        np.int64(adj),
-        inactivity_score_bias=preset.inactivity_score_bias,
-        inactivity_score_recovery_rate=preset.inactivity_score_recovery_rate,
-        inactivity_penalty_quotient=preset.inactivity_penalty_quotient,
-        effective_balance_increment=incr,
-        max_effective_balance=spec.max_effective_balance,
+    positional, static = kernel_inputs(
+        va, prev_flags, scores, current, previous, finalized_epoch,
+        total_slashings, spec, multiplier,
     )
+    out = kernel(*positional, **static)
     return tuple(np.asarray(x) for x in out)

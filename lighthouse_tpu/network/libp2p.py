@@ -1,0 +1,468 @@
+"""libp2p transport: TCP + noise + yamux + gossipsub/req-resp wire protocols.
+
+The socket-level counterpart of the reference's lighthouse_network
+service (`src/service/utils.rs:39-48` build_transport: TCP, noise
+encryption, yamux muxing; behaviour composition `src/service/behaviour.rs`):
+
+* multistream-select 1.0 protocol negotiation (uvarint-framed lines),
+* Noise XX channel (noise.py) bound to the node's secp256k1 identity,
+* yamux sessions (yamux.py), one per connection,
+* gossipsub v1.1 wire RPCs (`/meshsub/1.1.0`, protobuf, StrictNoSign as
+  eth2 requires) carrying snappy-compressed payloads with the spec
+  message-id (gossip.py), flood-published to subscribed peers,
+* req/resp: one stream per request negotiated to
+  `/eth2/beacon_chain/req/<name>/<v>/ssz_snappy` (rpc.py chunk codec).
+
+Synchronous, thread-per-connection — the IO layer of a node whose hot
+path is device batches, not packet shuffling.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable
+
+from ..utils.logging import get_logger
+from . import rpc as rpc_mod
+from . import snappy
+from .gossip import PeerManager, SeenCache, message_id
+from .noise import (
+    NoiseError,
+    NoiseSession,
+    _pb_field_bytes,
+    _pb_parse,
+    _pb_read_varint,
+    _pb_varint,
+    initiator_handshake,
+    peer_id_from_pubkey,
+    responder_handshake,
+)
+from .yamux import Session, Stream, YamuxError
+
+log = get_logger("libp2p")
+
+MULTISTREAM = "/multistream/1.0.0"
+NOISE_PROTO = "/noise"
+YAMUX_PROTO = "/yamux/1.0.0"
+GOSSIP_PROTO = "/meshsub/1.1.0"
+# eth2 GOSSIP_MAX_SIZE is 10 MiB; one RPC may carry a few messages
+MAX_GOSSIP_RPC_SIZE = 11 * 1024 * 1024
+
+
+class Libp2pError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# multistream-select over a byte-stream interface
+# ---------------------------------------------------------------------------
+
+
+def _ms_frame(line: str) -> bytes:
+    raw = line.encode() + b"\n"
+    return _pb_varint(len(raw)) + raw
+
+
+class _MsgReader:
+    """Adapts exact-read byte sources to uvarint-framed line reads."""
+
+    def __init__(self, read_exact: Callable[[int], bytes]):
+        self.read_exact = read_exact
+
+    def read_line(self) -> str:
+        n, shift = 0, 0
+        while True:
+            b = self.read_exact(1)[0]
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        raw = self.read_exact(n)
+        return raw.rstrip(b"\n").decode()
+
+
+def ms_negotiate_out(write, reader: _MsgReader, protocol: str) -> None:
+    """Dialer side: propose ``protocol``; raise if the peer says na."""
+    write(_ms_frame(MULTISTREAM) + _ms_frame(protocol))
+    if reader.read_line() != MULTISTREAM:
+        raise Libp2pError("peer is not multistream")
+    got = reader.read_line()
+    if got != protocol:
+        raise Libp2pError(f"peer refused {protocol}: {got}")
+
+
+def ms_negotiate_in(write, reader: _MsgReader, supported) -> str:
+    """Listener side: accept the first supported proposal."""
+    if reader.read_line() != MULTISTREAM:
+        raise Libp2pError("peer is not multistream")
+    write(_ms_frame(MULTISTREAM))
+    while True:
+        proposal = reader.read_line()
+        if proposal in supported:
+            write(_ms_frame(proposal))
+            return proposal
+        write(_ms_frame("na"))
+
+
+# ---------------------------------------------------------------------------
+# gossipsub wire RPCs (protobuf, StrictNoSign)
+# ---------------------------------------------------------------------------
+
+
+def encode_gossip_rpc(
+    subscriptions: list[tuple[bool, str]] | None = None,
+    publish: list[tuple[str, bytes]] | None = None,
+) -> bytes:
+    out = b""
+    for sub, topic in subscriptions or []:
+        opts = _pb_varint(1 << 3 | 0) + _pb_varint(1 if sub else 0)
+        opts += _pb_field_bytes(2, topic.encode())
+        out += _pb_field_bytes(1, opts)
+    for topic, data in publish or []:
+        msg = _pb_field_bytes(2, data) + _pb_field_bytes(4, topic.encode())
+        out += _pb_field_bytes(2, msg)
+    return out
+
+
+def decode_gossip_rpc(raw: bytes):
+    fields = _pb_parse(raw)
+    subs: list[tuple[bool, str]] = []
+    msgs: list[tuple[str, bytes]] = []
+    for sub_raw in fields.get(1, []):
+        f = _pb_parse(sub_raw)
+        subs.append(
+            (bool(f.get(1, [0])[0]), f.get(2, [b""])[0].decode())
+        )
+    for msg_raw in fields.get(2, []):
+        f = _pb_parse(msg_raw)
+        topic = f.get(4, [b""])[0].decode()
+        data = f.get(2, [b""])[0]
+        msgs.append((topic, data))
+    return subs, msgs
+
+
+# ---------------------------------------------------------------------------
+# connections
+# ---------------------------------------------------------------------------
+
+
+class Connection:
+    """One peer connection: noise channel + yamux session + gossip state."""
+
+    def __init__(self, host: "Libp2pHost", sock: socket.socket,
+                 noise: NoiseSession, muxer: Session):
+        self.host = host
+        self.sock = sock
+        self.noise = noise
+        self.muxer = muxer
+        self.peer_id = noise.remote_peer_id
+        self.topics: set[str] = set()  # peer's subscriptions
+        self._gossip_out: Stream | None = None
+        self._lock = threading.Lock()
+        self.alive = True
+
+    # -- gossip ------------------------------------------------------------
+
+    def _ensure_gossip_stream(self) -> Stream:
+        with self._lock:
+            if self._gossip_out is None:
+                st = self.muxer.open_stream()
+                reader = _MsgReader(lambda n: st.read(n, timeout=5.0))
+                ms_negotiate_out(st.write, reader, GOSSIP_PROTO)
+                self._gossip_out = st
+            return self._gossip_out
+
+    def send_gossip_rpc(self, rpc: bytes) -> None:
+        try:
+            st = self._ensure_gossip_stream()
+            st.write(_pb_varint(len(rpc)) + rpc)
+        except (YamuxError, OSError, Libp2pError) as exc:
+            log.debug("gossip send to %s failed: %s", self.peer_id.hex()[:8], exc)
+            self.alive = False
+
+    # -- req/resp ----------------------------------------------------------
+
+    def request(self, name: str, payload_ssz: bytes,
+                timeout: float = 5.0) -> tuple[int, bytes]:
+        """One shot request: returns (result_code, response_ssz)."""
+        st = self.muxer.open_stream()
+        reader = _MsgReader(lambda n: st.read(n, timeout=timeout))
+        ms_negotiate_out(st.write, reader, rpc_mod.protocol_id(name))
+        st.write(rpc_mod.encode_request(payload_ssz))
+        st.close()  # FIN: request fully written
+        body = st.read_until_eof(timeout=timeout)
+        if not body:
+            raise Libp2pError(f"empty response to {name}")
+        return rpc_mod.decode_response_chunk(body)
+
+    def close(self) -> None:
+        self.alive = False
+        self.muxer.stop()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Libp2pHost:
+    """A libp2p node: listener, dialer, gossip pub/sub, req/resp handlers.
+
+    ``rpc_handlers[name] -> (request_ssz, peer_id) -> (code, response_ssz)``
+    ``subscribe(topic, handler)`` with handler(payload, peer_id) -> accept/
+    ignore/reject (MessageAcceptance semantics, gossip.py scoring).
+    """
+
+    def __init__(self, key=None, ip: str = "127.0.0.1", port: int = 0):
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        self.key = key or ec.generate_private_key(ec.SECP256K1())
+        from cryptography.hazmat.primitives import serialization
+
+        pub = self.key.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        )
+        self.peer_id = peer_id_from_pubkey(pub)
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind((ip, port))
+        self.listener.listen(16)
+        self.ip, self.port = self.listener.getsockname()
+        self.connections: dict[bytes, Connection] = {}
+        self.subscriptions: dict[str, Callable] = {}
+        self.rpc_handlers: dict[str, Callable] = {}
+        self.seen = SeenCache()
+        self.peer_manager = PeerManager()
+        self.received: list[tuple[str, bytes]] = []
+        self.rate_limiter = rpc_mod.RateLimiter()
+        self._running = False
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"libp2p-{self.port}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        for conn in list(self.connections.values()):
+            conn.close()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+    # -- socket plumbing ---------------------------------------------------
+
+    @staticmethod
+    def _sock_reader(sock: socket.socket):
+        def read_exact(n: int) -> bytes:
+            buf = b""
+            while len(buf) < n:
+                chunk = sock.recv(n - len(buf))
+                if not chunk:
+                    raise Libp2pError("connection closed")
+                buf += chunk
+            return buf
+
+        return read_exact
+
+    def _upgrade(self, sock: socket.socket, dialer: bool) -> Connection:
+        sock.settimeout(10.0)
+        read_exact = self._sock_reader(sock)
+        reader = _MsgReader(read_exact)
+        if dialer:
+            ms_negotiate_out(sock.sendall, reader, NOISE_PROTO)
+            noise = initiator_handshake(self.key, sock.sendall, read_exact)
+        else:
+            got = ms_negotiate_in(sock.sendall, reader, {NOISE_PROTO})
+            assert got == NOISE_PROTO
+            noise = responder_handshake(self.key, sock.sendall, read_exact)
+
+        # negotiate the muxer INSIDE the noise channel
+        nbuf = [b""]
+
+        def n_read_exact(n: int) -> bytes:
+            while len(nbuf[0]) < n:
+                nbuf[0] += noise.read(read_exact)
+            out, nbuf[0] = nbuf[0][:n], nbuf[0][n:]
+            return out
+
+        def n_write(data: bytes) -> None:
+            noise.write(sock.sendall, data)
+
+        n_reader = _MsgReader(n_read_exact)
+        if dialer:
+            ms_negotiate_out(n_write, n_reader, YAMUX_PROTO)
+        else:
+            ms_negotiate_in(n_write, n_reader, {YAMUX_PROTO})
+
+        def mux_recv() -> bytes:
+            if nbuf[0]:
+                out, nbuf[0] = nbuf[0], b""
+                return out
+            try:
+                return noise.read(read_exact)
+            except (Libp2pError, NoiseError, OSError):
+                return b""
+
+        muxer = Session(n_write, mux_recv, is_dialer=dialer,
+                        on_stream=None)
+        conn = Connection(self, sock, noise, muxer)
+        muxer._on_stream = lambda st: self._spawn_stream_handler(conn, st)
+        muxer._on_close = lambda: self._drop_connection(conn)
+        muxer.start()
+        sock.settimeout(None)
+        self.connections[conn.peer_id] = conn
+        self.peer_manager.connect(conn.peer_id.hex())
+        # announce our subscriptions
+        if self.subscriptions:
+            conn.send_gossip_rpc(encode_gossip_rpc(
+                subscriptions=[(True, t) for t in self.subscriptions]
+            ))
+        return conn
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, _addr = self.listener.accept()
+            except OSError:
+                break
+            threading.Thread(
+                target=self._inbound, args=(sock,), daemon=True
+            ).start()
+
+    def _inbound(self, sock: socket.socket) -> None:
+        try:
+            self._upgrade(sock, dialer=False)
+        except (Libp2pError, NoiseError, OSError) as exc:
+            log.debug("inbound upgrade failed: %s", exc)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def dial(self, ip: str, port: int) -> Connection:
+        sock = socket.create_connection((ip, port), timeout=10.0)
+        return self._upgrade(sock, dialer=True)
+
+    def _drop_connection(self, conn: Connection) -> None:
+        """Muxer died (peer hung up or send failed): forget the connection
+        and record the disconnect, keeping `connections` bounded."""
+        conn.alive = False
+        if self.connections.get(conn.peer_id) is conn:
+            del self.connections[conn.peer_id]
+        info = self.peer_manager.peers.get(conn.peer_id.hex())
+        if info is not None:
+            info.connected = False
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    # -- per-stream server side -------------------------------------------
+
+    def _spawn_stream_handler(self, conn: Connection, st: Stream) -> None:
+        threading.Thread(
+            target=self._serve_stream, args=(conn, st), daemon=True
+        ).start()
+
+    def _serve_stream(self, conn: Connection, st: Stream) -> None:
+        try:
+            reader = _MsgReader(lambda n: st.read(n, timeout=10.0))
+            supported = {GOSSIP_PROTO} | {
+                rpc_mod.protocol_id(n) for n in self.rpc_handlers
+            }
+            proto = ms_negotiate_in(st.write, reader, supported)
+            if proto == GOSSIP_PROTO:
+                self._serve_gossip(conn, st, reader)
+            else:
+                name = proto.split("/")[-3]
+                self._serve_rpc(conn, st, name)
+        except (YamuxError, Libp2pError, NoiseError, OSError, ValueError) as exc:
+            log.debug("stream from %s: %s", conn.peer_id.hex()[:8], exc)
+
+    def _serve_gossip(self, conn: Connection, st: Stream,
+                      reader: _MsgReader) -> None:
+        while self._running and conn.alive:
+            n, shift = 0, 0
+            while True:
+                b = st.read(1, timeout=3600.0)[0]
+                n |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            if n > MAX_GOSSIP_RPC_SIZE:
+                # remote-controlled allocation: drop + penalize, never buffer
+                self.peer_manager.report(
+                    conn.peer_id.hex(), -10.0, "oversized gossip rpc"
+                )
+                st.reset()
+                return
+            raw = st.read(n, timeout=10.0)
+            subs, msgs = decode_gossip_rpc(raw)
+            for subscribed, topic in subs:
+                (conn.topics.add if subscribed else conn.topics.discard)(topic)
+            for topic, data in msgs:
+                self._on_gossip_message(conn, topic, data)
+
+    def _on_gossip_message(self, conn: Connection, topic: str,
+                           data: bytes) -> None:
+        mid = message_id(topic, data)
+        if not self.seen.observe(mid):
+            return
+        handler = self.subscriptions.get(topic)
+        if handler is None:
+            return
+        try:
+            payload = snappy.decompress_block(data)
+        except snappy.SnappyError:
+            self.peer_manager.report(conn.peer_id.hex(), -10.0, "invalid snappy")
+            return
+        outcome = handler(payload, conn.peer_id)
+        if outcome == "accept":
+            self.received.append((topic, payload))
+            self._flood(topic, data, skip=conn.peer_id)
+        elif outcome == "reject":
+            self.peer_manager.report(conn.peer_id.hex(), -10.0, "invalid gossip")
+
+    def _serve_rpc(self, conn: Connection, st: Stream, name: str) -> None:
+        body = st.read_until_eof(timeout=10.0)
+        if not self.rate_limiter.allow(conn.peer_id.hex(), name):
+            st.write(rpc_mod.encode_response_chunk(
+                rpc_mod.RESOURCE_UNAVAILABLE, b""))
+            st.close()
+            return
+        request = rpc_mod.decode_request(body) if body else b""
+        code, resp = self.rpc_handlers[name](request, conn.peer_id)
+        st.write(rpc_mod.encode_response_chunk(code, resp))
+        st.close()
+
+    # -- public API --------------------------------------------------------
+
+    def subscribe(self, topic: str, handler: Callable) -> None:
+        self.subscriptions[topic] = handler
+        rpc = encode_gossip_rpc(subscriptions=[(True, topic)])
+        for conn in list(self.connections.values()):
+            conn.send_gossip_rpc(rpc)
+
+    def publish(self, topic: str, payload: bytes) -> bytes:
+        compressed = snappy.compress_block(payload)
+        mid = message_id(topic, compressed)
+        self.seen.observe(mid)
+        self._flood(topic, compressed, skip=None)
+        return mid
+
+    def _flood(self, topic: str, compressed: bytes, skip: bytes | None) -> None:
+        rpc = encode_gossip_rpc(publish=[(topic, compressed)])
+        for conn in list(self.connections.values()):
+            if not conn.alive:
+                self._drop_connection(conn)
+                continue
+            if conn.peer_id == skip or topic not in conn.topics:
+                continue
+            conn.send_gossip_rpc(rpc)

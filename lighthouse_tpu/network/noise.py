@@ -1,0 +1,371 @@
+"""libp2p-noise: the Noise XX secure channel (Noise_XX_25519_ChaChaPoly_SHA256).
+
+The encryption layer of the reference's transport stack
+(`lighthouse_network/src/service/utils.rs:39-48` — libp2p noise upgrade
+over TCP).  Implements the Noise Protocol Framework primitives (HKDF
+chaining key, mixHash/mixKey symmetric state, CipherState with the
+96-bit little-endian counter nonce) for the XX pattern:
+
+    -> e
+    <- e, ee, s, es
+    -> s, se
+
+plus the libp2p payload: each party proves ownership of its libp2p
+identity key by signing "noise-libp2p-static-key:" || static-noise-key
+and shipping (identity pubkey protobuf, signature) inside the handshake
+payload.  Wire framing: every handshake and transport message is
+``uint16be length || data`` (noise spec §"message format" as used by
+libp2p-noise).
+
+Identity keys are secp256k1 (the same keys ENRs use), so one node key
+drives both discovery and the libp2p transport — as in the reference
+(`discovery/enr.rs` derives the libp2p keypair from the node's secp key).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from cryptography.hazmat.primitives import hashes
+
+PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
+STATIC_KEY_DOMAIN = b"noise-libp2p-static-key:"
+
+
+class NoiseError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# protobuf helpers (libp2p PublicKey + NoiseHandshakePayload are tiny
+# protobufs; encode/decode by hand rather than depending on protoc output)
+# ---------------------------------------------------------------------------
+
+
+def _pb_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    shift = val = 0
+    while True:
+        if pos >= len(data):
+            raise NoiseError("truncated varint")
+        b = data[pos]
+        val |= (b & 0x7F) << shift
+        pos += 1
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+
+
+def _pb_field_bytes(field_no: int, payload: bytes) -> bytes:
+    return _pb_varint(field_no << 3 | 2) + _pb_varint(len(payload)) + payload
+
+
+def _pb_parse(data: bytes) -> dict[int, list]:
+    """Minimal parse: field_no -> list of values (bytes for len-delimited,
+    int for varint)."""
+    out: dict[int, list] = {}
+    pos = 0
+    while pos < len(data):
+        tag, pos = _pb_read_varint(data, pos)
+        field_no, wire = tag >> 3, tag & 7
+        if wire == 2:
+            ln, pos = _pb_read_varint(data, pos)
+            out.setdefault(field_no, []).append(data[pos : pos + ln])
+            pos += ln
+        elif wire == 0:
+            v, pos = _pb_read_varint(data, pos)
+            out.setdefault(field_no, []).append(v)
+        else:
+            raise NoiseError(f"unsupported wire type {wire}")
+    return out
+
+
+KEYTYPE_SECP256K1 = 2
+
+
+def marshal_identity_pubkey(pub_compressed: bytes) -> bytes:
+    """libp2p PublicKey protobuf {key_type=1: enum, data=2: bytes}."""
+    return _pb_varint(1 << 3 | 0) + _pb_varint(KEYTYPE_SECP256K1) + _pb_field_bytes(
+        2, pub_compressed
+    )
+
+
+def unmarshal_identity_pubkey(data: bytes) -> bytes:
+    fields = _pb_parse(data)
+    if fields.get(1, [None])[0] != KEYTYPE_SECP256K1:
+        raise NoiseError("unsupported identity key type")
+    return fields[2][0]
+
+
+def peer_id_from_pubkey(pub_compressed: bytes) -> bytes:
+    """libp2p PeerId: multihash of the marshaled pubkey (identity hash —
+    secp256k1 keys marshal to < 42 bytes)."""
+    marshaled = marshal_identity_pubkey(pub_compressed)
+    if len(marshaled) <= 42:
+        return bytes([0x00, len(marshaled)]) + marshaled
+    digest = hashlib.sha256(marshaled).digest()
+    return bytes([0x12, 0x20]) + digest
+
+
+# ---------------------------------------------------------------------------
+# noise primitives
+# ---------------------------------------------------------------------------
+
+
+def _hkdf2(ck: bytes, ikm: bytes) -> tuple[bytes, bytes]:
+    """Noise HKDF with 2 outputs (HMAC-SHA256 chain)."""
+    prk = hmac_mod.new(ck, ikm, hashlib.sha256).digest()
+    t1 = hmac_mod.new(prk, b"\x01", hashlib.sha256).digest()
+    t2 = hmac_mod.new(prk, t1 + b"\x02", hashlib.sha256).digest()
+    return t1, t2
+
+
+class CipherState:
+    def __init__(self, key: bytes | None = None):
+        self.key = key
+        self.n = 0
+
+    def _nonce(self) -> bytes:
+        return b"\x00" * 4 + self.n.to_bytes(8, "little")
+
+    def encrypt(self, ad: bytes, plaintext: bytes) -> bytes:
+        if self.key is None:
+            return plaintext
+        ct = ChaCha20Poly1305(self.key).encrypt(self._nonce(), plaintext, ad)
+        self.n += 1
+        return ct
+
+    def decrypt(self, ad: bytes, ciphertext: bytes) -> bytes:
+        if self.key is None:
+            return ciphertext
+        try:
+            pt = ChaCha20Poly1305(self.key).decrypt(self._nonce(), ciphertext, ad)
+        except Exception as exc:
+            raise NoiseError(f"decrypt failed at n={self.n}") from exc
+        self.n += 1
+        return pt
+
+
+class SymmetricState:
+    def __init__(self):
+        self.h = hashlib.sha256(PROTOCOL_NAME).digest() if len(
+            PROTOCOL_NAME
+        ) > 32 else PROTOCOL_NAME.ljust(32, b"\x00")
+        self.ck = self.h
+        self.cipher = CipherState()
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = hashlib.sha256(self.h + data).digest()
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, temp_k = _hkdf2(self.ck, ikm)
+        self.cipher = CipherState(temp_k)
+
+    def encrypt_and_hash(self, plaintext: bytes) -> bytes:
+        ct = self.cipher.encrypt(self.h, plaintext)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ciphertext: bytes) -> bytes:
+        pt = self.cipher.decrypt(self.h, ciphertext)
+        self.mix_hash(ciphertext)
+        return pt
+
+    def split(self) -> tuple[CipherState, CipherState]:
+        k1, k2 = _hkdf2(self.ck, b"")
+        return CipherState(k1), CipherState(k2)
+
+
+def _dh(priv: X25519PrivateKey, pub_raw: bytes) -> bytes:
+    return priv.exchange(X25519PublicKey.from_public_bytes(pub_raw))
+
+
+def _x25519_pub_raw(priv: X25519PrivateKey) -> bytes:
+    return priv.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+
+
+# ---------------------------------------------------------------------------
+# identity signatures (secp256k1 over sha256, low-s DER -> raw64 via enr)
+# ---------------------------------------------------------------------------
+
+
+def _sign_identity(identity_key: ec.EllipticCurvePrivateKey, static_pub: bytes) -> bytes:
+    from .enr import _sig_to_raw64
+
+    der = identity_key.sign(
+        STATIC_KEY_DOMAIN + static_pub, ec.ECDSA(hashes.SHA256())
+    )
+    return _sig_to_raw64(der)
+
+
+def _verify_identity(pub_compressed: bytes, static_pub: bytes, sig: bytes) -> bool:
+    from .enr import _raw64_to_der
+
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), pub_compressed
+        )
+        pub.verify(
+            _raw64_to_der(sig),
+            STATIC_KEY_DOMAIN + static_pub,
+            ec.ECDSA(hashes.SHA256()),
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _handshake_payload(identity_key: ec.EllipticCurvePrivateKey,
+                       static_pub: bytes) -> bytes:
+    """NoiseHandshakePayload {identity_key=1, identity_sig=2}."""
+    pub = identity_key.public_key().public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+    )
+    return _pb_field_bytes(1, marshal_identity_pubkey(pub)) + _pb_field_bytes(
+        2, _sign_identity(identity_key, static_pub)
+    )
+
+
+def _check_payload(payload: bytes, static_pub: bytes) -> bytes:
+    """Verify the remote payload; returns the remote identity pubkey."""
+    fields = _pb_parse(payload)
+    try:
+        identity = unmarshal_identity_pubkey(fields[1][0])
+        sig = fields[2][0]
+    except (KeyError, IndexError) as exc:
+        raise NoiseError("handshake payload missing identity") from exc
+    if not _verify_identity(identity, static_pub, sig):
+        raise NoiseError("bad identity signature over static key")
+    return identity
+
+
+# ---------------------------------------------------------------------------
+# the XX handshake over a stream
+# ---------------------------------------------------------------------------
+
+
+def _send(sock_send, data: bytes) -> None:
+    if len(data) > 0xFFFF:
+        raise NoiseError("noise message over 65535 bytes")
+    sock_send(len(data).to_bytes(2, "big") + data)
+
+
+def _recv(sock_recv) -> bytes:
+    hdr = sock_recv(2)
+    n = int.from_bytes(hdr, "big")
+    return sock_recv(n) if n else b""
+
+
+class NoiseSession:
+    """An established channel: encrypt/decrypt transport frames."""
+
+    def __init__(self, send_cs: CipherState, recv_cs: CipherState,
+                 remote_identity: bytes):
+        self.send_cs = send_cs
+        self.recv_cs = recv_cs
+        self.remote_identity = remote_identity  # compressed secp256k1
+        self.remote_peer_id = peer_id_from_pubkey(remote_identity)
+
+    def write(self, sock_send, plaintext: bytes) -> None:
+        # transport frames: chunk to respect the uint16 length bound
+        # (65535 incl. the 16-byte tag)
+        for off in range(0, len(plaintext) or 1, 65519):
+            chunk = plaintext[off : off + 65519]
+            _send(sock_send, self.send_cs.encrypt(b"", chunk))
+
+    def read(self, sock_recv) -> bytes:
+        return self.recv_cs.decrypt(b"", _recv(sock_recv))
+
+
+def initiator_handshake(
+    identity_key: ec.EllipticCurvePrivateKey, sock_send, sock_recv
+) -> NoiseSession:
+    ss = SymmetricState()
+    ss.mix_hash(b"")  # empty prologue
+    s_priv = X25519PrivateKey.generate()
+    s_pub = _x25519_pub_raw(s_priv)
+    e_priv = X25519PrivateKey.generate()
+    e_pub = _x25519_pub_raw(e_priv)
+
+    # -> e
+    ss.mix_hash(e_pub)
+    _send(sock_send, e_pub)
+
+    # <- e, ee, s, es  (+ responder payload)
+    msg = _recv(sock_recv)
+    if len(msg) < 32:
+        raise NoiseError("short handshake message 2")
+    re_pub = msg[:32]
+    ss.mix_hash(re_pub)
+    ss.mix_key(_dh(e_priv, re_pub))
+    enc_rs = msg[32 : 32 + 32 + 16]
+    rs_pub = ss.decrypt_and_hash(enc_rs)
+    ss.mix_key(_dh(e_priv, rs_pub))
+    remote_payload = ss.decrypt_and_hash(msg[32 + 48 :])
+    remote_identity = _check_payload(remote_payload, rs_pub)
+
+    # -> s, se  (+ our payload)
+    enc_s = ss.encrypt_and_hash(s_pub)
+    ss.mix_key(_dh(s_priv, re_pub))
+    enc_payload = ss.encrypt_and_hash(_handshake_payload(identity_key, s_pub))
+    _send(sock_send, enc_s + enc_payload)
+
+    c1, c2 = ss.split()  # initiator sends with c1, receives with c2
+    return NoiseSession(c1, c2, remote_identity)
+
+
+def responder_handshake(
+    identity_key: ec.EllipticCurvePrivateKey, sock_send, sock_recv
+) -> NoiseSession:
+    ss = SymmetricState()
+    ss.mix_hash(b"")
+    s_priv = X25519PrivateKey.generate()
+    s_pub = _x25519_pub_raw(s_priv)
+    e_priv = X25519PrivateKey.generate()
+    e_pub = _x25519_pub_raw(e_priv)
+
+    # -> e
+    re_pub = _recv(sock_recv)
+    if len(re_pub) != 32:
+        raise NoiseError("message 1 must be a bare ephemeral key")
+    ss.mix_hash(re_pub)
+
+    # <- e, ee, s, es
+    ss.mix_hash(e_pub)
+    ss.mix_key(_dh(e_priv, re_pub))
+    enc_s = ss.encrypt_and_hash(s_pub)
+    ss.mix_key(_dh(s_priv, re_pub))
+    enc_payload = ss.encrypt_and_hash(_handshake_payload(identity_key, s_pub))
+    _send(sock_send, e_pub + enc_s + enc_payload)
+
+    # -> s, se
+    msg = _recv(sock_recv)
+    rs_pub = ss.decrypt_and_hash(msg[: 32 + 16])
+    ss.mix_key(_dh(e_priv, rs_pub))
+    remote_payload = ss.decrypt_and_hash(msg[48:])
+    remote_identity = _check_payload(remote_payload, rs_pub)
+
+    c1, c2 = ss.split()  # responder receives with c1, sends with c2
+    return NoiseSession(c2, c1, remote_identity)

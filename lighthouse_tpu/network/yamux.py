@@ -1,0 +1,290 @@
+"""yamux stream multiplexing over one secured connection.
+
+The muxer of the reference's transport stack
+(`lighthouse_network/src/service/utils.rs:39-48` — yamux upgrade above
+noise).  Implements the yamux spec framing: 12-byte headers
+
+    version(1)=0 | type(1) | flags(2 BE) | stream_id(4 BE) | length(4 BE)
+
+types: 0 Data, 1 WindowUpdate, 2 Ping, 3 GoAway; flags: SYN=1 ACK=2
+FIN=4 RST=8.  Dialer opens odd stream ids, listener even.  Receive
+windows start at 256 KiB; consumed credit is returned with WindowUpdate
+once half the window is drained.
+
+The session pumps frames on a reader thread and hands bytes to Stream
+objects with blocking reads — the synchronous analog of the reference's
+polled muxer.
+"""
+
+from __future__ import annotations
+
+import queue
+import struct
+import threading
+
+TYPE_DATA = 0
+TYPE_WINDOW = 1
+TYPE_PING = 2
+TYPE_GOAWAY = 3
+FLAG_SYN = 1
+FLAG_ACK = 2
+FLAG_FIN = 4
+FLAG_RST = 8
+
+INITIAL_WINDOW = 256 * 1024
+
+
+class YamuxError(Exception):
+    pass
+
+
+def _header(typ: int, flags: int, stream_id: int, length: int) -> bytes:
+    return struct.pack(">BBHII", 0, typ, flags, stream_id, length)
+
+
+class Stream:
+    """One logical bidirectional stream."""
+
+    def __init__(self, session: "Session", stream_id: int):
+        self.session = session
+        self.id = stream_id
+        self._rx: queue.Queue[bytes | None] = queue.Queue()
+        self._buf = b""
+        self._recv_window = INITIAL_WINDOW
+        self._send_window = INITIAL_WINDOW
+        self._window_cv = threading.Condition()
+        self._closed_local = False
+        self._closed_remote = False
+
+    # -- write side --------------------------------------------------------
+
+    def write(self, data: bytes, flags: int = 0,
+              timeout: float = 30.0) -> None:
+        """Write respecting the peer's receive window: blocks for
+        WindowUpdate credit when the window is exhausted."""
+        if self._closed_local:
+            raise YamuxError(f"stream {self.id} closed")
+        view = memoryview(data)
+        while True:
+            with self._window_cv:
+                if self._send_window <= 0:
+                    if not self._window_cv.wait(timeout):
+                        raise YamuxError(
+                            f"stream {self.id}: window starved for {timeout}s"
+                        )
+                    continue
+                chunk = view[: self._send_window]
+                self._send_window -= len(chunk)
+            self.session._send_frame(TYPE_DATA, flags, self.id, bytes(chunk))
+            view = view[len(chunk) :]
+            if not len(view):
+                return
+
+    def _grant_credit(self, delta: int) -> None:
+        with self._window_cv:
+            self._send_window += delta
+            self._window_cv.notify_all()
+
+    def close(self) -> None:
+        if not self._closed_local:
+            self._closed_local = True
+            self.session._send_frame(TYPE_DATA, FLAG_FIN, self.id, b"")
+            self.session._maybe_gc(self)
+
+    def reset(self) -> None:
+        self._closed_local = True
+        self.session._send_frame(TYPE_WINDOW, FLAG_RST, self.id, b"")
+        self.session._maybe_gc(self)
+
+    # -- read side ---------------------------------------------------------
+
+    def read(self, n: int, timeout: float = 5.0) -> bytes:
+        """Read EXACTLY n bytes (blocking); raises on EOF before n."""
+        while len(self._buf) < n:
+            chunk = self._pump(timeout)
+            if chunk is None:
+                raise YamuxError(f"stream {self.id}: EOF at {len(self._buf)}/{n}")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def read_until_eof(self, timeout: float = 5.0, limit: int = 1 << 24) -> bytes:
+        while True:
+            chunk = self._pump(timeout)  # drains queued data even after FIN
+            if chunk is None:
+                break
+            self._buf += chunk
+            if len(self._buf) > limit:
+                raise YamuxError("stream body over limit")
+        out, self._buf = self._buf, b""
+        return out
+
+    def read_available(self, timeout: float = 5.0) -> bytes:
+        """At least one byte (unless EOF); whatever is buffered."""
+        if not self._buf:
+            chunk = self._pump(timeout)
+            if chunk is not None:
+                self._buf += chunk
+        out, self._buf = self._buf, b""
+        return out
+
+    def _pump(self, timeout: float):
+        """Dequeue one frame, returning credit AS data is consumed — a
+        reader mid-way through a large read must keep feeding the peer
+        window or transfers beyond one window deadlock."""
+        if self._closed_remote and self._rx.empty():
+            return None
+        try:
+            item = self._rx.get(timeout=timeout)
+        except queue.Empty:
+            raise YamuxError(f"stream {self.id}: read timeout") from None
+        if item is not None:
+            self._return_credit(len(item))
+        return item
+
+    def _return_credit(self, n: int) -> None:
+        self._recv_window -= n
+        if self._recv_window <= INITIAL_WINDOW // 2:
+            delta = INITIAL_WINDOW - self._recv_window
+            self._recv_window = INITIAL_WINDOW
+            self.session._send_frame(
+                TYPE_WINDOW, 0, self.id, delta.to_bytes(4, "big"), raw_len=delta
+            )
+
+    # session-side delivery
+    def _deliver(self, data: bytes) -> None:
+        self._rx.put(data)
+
+    def _remote_close(self) -> None:
+        self._closed_remote = True
+        self._rx.put(None)
+        self.session._maybe_gc(self)
+
+
+class Session:
+    """One muxed connection; ``is_dialer`` fixes stream-id parity."""
+
+    def __init__(self, send_fn, recv_fn, is_dialer: bool,
+                 on_stream=None, on_close=None):
+        self._send = send_fn  # (bytes) -> None, already secured
+        self._recv = recv_fn  # () -> bytes (one noise frame) or b"" on EOF
+        self._next_id = 1 if is_dialer else 2
+        self.streams: dict[int, Stream] = {}
+        self._accept_q: queue.Queue[Stream] = queue.Queue()
+        self._on_stream = on_stream
+        self._on_close = on_close
+        self._lock = threading.Lock()
+        self._wbuf = b""
+        self._running = True
+        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._send(_header(TYPE_GOAWAY, 0, 0, 0))
+        except Exception:
+            pass
+
+    # -- frame IO ----------------------------------------------------------
+
+    def _send_frame(self, typ: int, flags: int, stream_id: int, body: bytes,
+                    raw_len: int | None = None) -> None:
+        with self._lock:
+            if typ in (TYPE_WINDOW, TYPE_PING, TYPE_GOAWAY):
+                # header-only frames: the length field carries the window
+                # delta / ping opaque / goaway code, with no body
+                self._send(_header(typ, flags, stream_id,
+                                   raw_len if raw_len is not None else 0))
+            else:
+                self._send(_header(typ, flags, stream_id, len(body)) + body)
+
+    def _maybe_gc(self, st: Stream) -> None:
+        """Drop fully-closed streams from the table (long-lived sessions
+        open one stream per req/resp; the table must not grow forever)."""
+        if st._closed_local and st._closed_remote:
+            self.streams.pop(st.id, None)
+
+    def open_stream(self) -> Stream:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 2
+        st = Stream(self, sid)
+        self.streams[sid] = st
+        # SYN window update; delta 0 = both sides at the implicit 256 KiB
+        self._send_frame(TYPE_WINDOW, FLAG_SYN, sid, b"", raw_len=0)
+        return st
+
+    def accept_stream(self, timeout: float = 5.0) -> Stream:
+        try:
+            return self._accept_q.get(timeout=timeout)
+        except queue.Empty:
+            raise YamuxError("accept timeout") from None
+
+    # -- reader ------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._wbuf) < n:
+            frame = self._recv()
+            if not frame:
+                raise YamuxError("connection closed")
+            self._wbuf += frame
+        out, self._wbuf = self._wbuf[:n], self._wbuf[n:]
+        return out
+
+    def _read_loop(self) -> None:
+        try:
+            while self._running:
+                hdr = self._read_exact(12)
+                ver, typ, flags, sid, length = struct.unpack(">BBHII", hdr)
+                if ver != 0:
+                    raise YamuxError(f"bad yamux version {ver}")
+                if typ == TYPE_DATA:
+                    body = self._read_exact(length) if length else b""
+                    self._handle_data(flags, sid, body)
+                elif typ == TYPE_WINDOW:
+                    self._handle_window(flags, sid, length)
+                elif typ == TYPE_PING:
+                    if flags & FLAG_SYN:
+                        self._send_frame(TYPE_PING, FLAG_ACK, 0, b"",
+                                         raw_len=length)
+                elif typ == TYPE_GOAWAY:
+                    break
+        except Exception:
+            pass
+        finally:
+            self._running = False
+            for st in list(self.streams.values()):
+                st._remote_close()
+            if self._on_close:
+                self._on_close()
+
+    def _get_or_open(self, flags: int, sid: int) -> Stream | None:
+        st = self.streams.get(sid)
+        if st is None and flags & FLAG_SYN:
+            st = Stream(self, sid)
+            self.streams[sid] = st
+            self._accept_q.put(st)
+            if self._on_stream:
+                self._on_stream(st)
+        return st
+
+    def _handle_data(self, flags: int, sid: int, body: bytes) -> None:
+        st = self._get_or_open(flags, sid)
+        if st is None:
+            return
+        if body:
+            st._deliver(body)
+        if flags & (FLAG_FIN | FLAG_RST):
+            st._remote_close()
+
+    def _handle_window(self, flags: int, sid: int, delta: int) -> None:
+        st = self._get_or_open(flags, sid)
+        if st is None:
+            return
+        if delta and not flags & FLAG_RST:
+            st._grant_credit(delta)
+        if flags & (FLAG_FIN | FLAG_RST):
+            st._remote_close()

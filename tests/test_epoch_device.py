@@ -84,6 +84,28 @@ def test_device_matches_host(leak):
     assert dev.current_justified_checkpoint == host.current_justified_checkpoint
 
 
+def test_inactivity_bias_applies_outside_leak():
+    """Spec process_inactivity_updates: a non-participating eligible
+    validator gains INACTIVITY_SCORE_BIAS unconditionally, then the
+    recovery rate applies (to the mid-update score) outside a leak:
+    score 20 -> 20 + 4 - 16 = 8, NOT 20 - 16 = 4 (r3 review finding)."""
+    spec = phase0_spec(S.MINIMAL)
+    assert spec.preset.inactivity_score_bias == 4
+    assert spec.preset.inactivity_score_recovery_rate == 16
+    for device in (False, True):
+        state, _ = interop_state(8, spec, fork="altair")
+        state.slot = 8 * spec.preset.slots_per_epoch
+        state.previous_epoch_participation = [0] * 8  # nobody hit target
+        state.inactivity_scores = [20] * 8
+        from lighthouse_tpu.consensus.containers import Checkpoint
+
+        state.finalized_checkpoint = Checkpoint(epoch=6, root=b"\x01" * 32)
+        process_epoch_altair(state, spec, device=device)
+        assert list(state.inactivity_scores) == [8] * 8, (
+            f"device={device}: bias must apply before recovery"
+        )
+
+
 def test_padded_lanes_are_inert():
     """The padding contract: zero-EB inactive lanes produce zero deltas."""
     spec = phase0_spec(S.MINIMAL)

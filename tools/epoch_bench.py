@@ -84,45 +84,12 @@ def main():
     # wholesale), so the per-epoch cost is kernel-only.
     from lighthouse_tpu.consensus.state_processing.per_epoch_jax import (
         _build_kernel,
+        kernel_inputs,
     )
 
     kernel = _build_kernel()
-    import math
-
-    preset = spec.preset
-    incr = spec.effective_balance_increment
-    total = va.total_active_balance(args["current"], incr)
-    brpi = incr * preset.base_reward_factor // math.isqrt(total)
-    epoch_to_penalize = (
-        args["current"] + preset.epochs_per_slashings_vector // 2
-    )
-    dev_args = [
-        jax.device_put(x)
-        for x in (
-            va.effective_balance, va.balances, flags, va.slashed, scores,
-            np.asarray(va.is_active(args["previous"])),
-            np.asarray(va.is_active(args["current"])),
-            np.asarray(va.is_eligible(args["previous"])),
-            np.asarray(va.withdrawable_epoch == epoch_to_penalize),
-            np.int64(brpi),
-            (args["previous"] - args["finalized_epoch"])
-            > preset.min_epochs_to_inactivity_penalty,
-            np.int64(
-                min(
-                    args["total_slashings"]
-                    * preset.proportional_slashing_multiplier * 2,
-                    total,
-                )
-            ),
-        )
-    ]
-    static = dict(
-        inactivity_score_bias=preset.inactivity_score_bias,
-        inactivity_score_recovery_rate=preset.inactivity_score_recovery_rate,
-        inactivity_penalty_quotient=preset.inactivity_penalty_quotient,
-        effective_balance_increment=incr,
-        max_effective_balance=spec.max_effective_balance,
-    )
+    positional, static = kernel_inputs(va, flags, scores, **args)
+    dev_args = [jax.device_put(x) for x in positional]
     jax.block_until_ready(kernel(*dev_args, **static))
     times = []
     for _ in range(5):
