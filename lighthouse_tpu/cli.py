@@ -29,6 +29,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="exit after N slots (0 = run until interrupted)")
     bn.add_argument("--auto-propose", action="store_true",
                     help="produce blocks with interop keys each slot")
+    bn.add_argument("--discovery-port", type=int, default=None,
+                    help="enable discv5 on this UDP port (0 = ephemeral)")
+    bn.add_argument("--boot-nodes", default=None,
+                    help="comma-separated enr: records to bootstrap from")
+    bn.add_argument("--network", default=None,
+                    choices=["mainnet", "sepolia", "holesky"],
+                    help="use a built-in network config (boot ENRs + spec)")
+    bn.add_argument("--testnet-dir", default=None,
+                    help="load config.yaml/boot_enr.yaml from a directory")
 
     vc = sub.add_parser("vc", help="run a validator client against a BN")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
@@ -102,6 +111,23 @@ def run_bn(args) -> int:
 
     log = get_logger("bn")
     spec = _spec_for(args.spec, args.validators)
+    boot_enrs = []
+    if args.testnet_dir:
+        from .consensus.network_config import Eth2NetworkConfig
+
+        net = Eth2NetworkConfig.from_dir(args.testnet_dir)
+        spec, boot_enrs = net.chain_spec, net.boot_enrs()
+        log_with(log, logging.INFO, "Loaded testnet dir", name=net.name)
+    elif args.network:
+        from .consensus.network_config import HARDCODED_NETWORKS
+
+        net = HARDCODED_NETWORKS[args.network]()
+        spec, boot_enrs = net.chain_spec, net.boot_enrs()
+        log_with(log, logging.INFO, "Using built-in network", name=net.name)
+    if args.boot_nodes:
+        from .network.enr import Enr
+
+        boot_enrs += [Enr.from_text(t) for t in args.boot_nodes.split(",")]
     store = None
     if args.datadir:
         import os
@@ -117,6 +143,20 @@ def run_bn(args) -> int:
     h = BeaconChainHarness(n_validators=args.validators, spec=spec, store=store)
     server = BeaconApiServer(h.chain, port=args.http_port)
     server.start()
+    discovery = None
+    if args.discovery_port is not None:
+        from .network.discv5 import Discv5Service
+
+        discovery = Discv5Service(port=args.discovery_port)
+        discovery.start()
+        if boot_enrs:
+            discovery.bootstrap(boot_enrs)
+            discovery.lookup()
+        log_with(
+            log, logging.INFO, "Discovery started",
+            enr=discovery.enr.to_text()[:40] + "...",
+            udp_port=discovery.port, table=len(discovery.table),
+        )
     log_with(
         log, logging.INFO, "Beacon node started",
         spec=args.spec, validators=args.validators,
@@ -141,6 +181,8 @@ def run_bn(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if discovery is not None:
+            discovery.stop()
         server.stop()
     return 0
 

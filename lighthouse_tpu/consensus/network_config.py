@@ -1,0 +1,257 @@
+"""Embedded network configurations — the eth2_network_config analog.
+
+Twin of common/eth2_network_config (src/lib.rs:32-53: per-network
+config.yaml + boot ENRs + genesis state + deposit deploy block, with
+hardcoded built-in networks and a --testnet-dir style directory loader).
+
+The embedded values are public chain constants (the same config.yaml
+every consensus client ships); boot ENRs are the operator-published
+records from the mainnet boot_enr.yaml — decoding them through our ENR
+stack doubles as a real-world interop check (live records, signed by
+Sigma Prime / EF / Teku / Prysm / Nimbus keys, must verify).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .spec import ChainSpec, MAINNET, PRESETS
+
+# ---------------------------------------------------------------------------
+# config.yaml (subset) parser — the runtime-config file format
+# ---------------------------------------------------------------------------
+
+
+def parse_config_yaml(text: str) -> dict[str, object]:
+    """Parse the flat `KEY: value` consensus config format (full YAML is
+    never needed: the spec's config files are flat scalars + comments)."""
+    out: dict[str, object] = {}
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line or ":" not in line:
+            continue
+        key, _, raw = line.partition(":")
+        raw = raw.strip().strip("'\"")
+        if raw.startswith("0x"):
+            out[key.strip()] = bytes.fromhex(raw[2:])
+        elif raw.lstrip("-").isdigit():
+            out[key.strip()] = int(raw)
+        else:
+            out[key.strip()] = raw
+    return out
+
+
+def chain_spec_from_config(cfg: dict[str, object]) -> ChainSpec:
+    """Map parsed config keys onto ChainSpec (chain_spec.rs from_config)."""
+    preset = PRESETS.get(str(cfg.get("PRESET_BASE", "mainnet")), MAINNET)
+
+    def epoch(key: str) -> int | None:
+        v = cfg.get(key)
+        if v is None or int(v) == 2**64 - 1:
+            return None
+        return int(v)
+
+    def take(key: str, default):
+        return cfg.get(key, default)
+
+    return ChainSpec(
+        preset=preset,
+        config_name=str(take("CONFIG_NAME", preset.name)),
+        min_genesis_active_validator_count=int(
+            take("MIN_GENESIS_ACTIVE_VALIDATOR_COUNT", 16384)
+        ),
+        min_genesis_time=int(take("MIN_GENESIS_TIME", 0)),
+        genesis_fork_version=bytes(take("GENESIS_FORK_VERSION", bytes(4))),
+        genesis_delay=int(take("GENESIS_DELAY", 604800)),
+        altair_fork_version=bytes(
+            take("ALTAIR_FORK_VERSION", bytes.fromhex("01000000"))
+        ),
+        altair_fork_epoch=epoch("ALTAIR_FORK_EPOCH"),
+        bellatrix_fork_version=bytes(
+            take("BELLATRIX_FORK_VERSION", bytes.fromhex("02000000"))
+        ),
+        bellatrix_fork_epoch=epoch("BELLATRIX_FORK_EPOCH"),
+        capella_fork_version=bytes(
+            take("CAPELLA_FORK_VERSION", bytes.fromhex("03000000"))
+        ),
+        capella_fork_epoch=epoch("CAPELLA_FORK_EPOCH"),
+        deneb_fork_version=bytes(
+            take("DENEB_FORK_VERSION", bytes.fromhex("04000000"))
+        ),
+        deneb_fork_epoch=epoch("DENEB_FORK_EPOCH"),
+        seconds_per_slot=int(take("SECONDS_PER_SLOT", 12)),
+        seconds_per_eth1_block=int(take("SECONDS_PER_ETH1_BLOCK", 14)),
+        min_validator_withdrawability_delay=int(
+            take("MIN_VALIDATOR_WITHDRAWABILITY_DELAY", 256)
+        ),
+        shard_committee_period=int(take("SHARD_COMMITTEE_PERIOD", 256)),
+        eth1_follow_distance=int(take("ETH1_FOLLOW_DISTANCE", 2048)),
+        min_per_epoch_churn_limit=int(take("MIN_PER_EPOCH_CHURN_LIMIT", 4)),
+        churn_limit_quotient=int(take("CHURN_LIMIT_QUOTIENT", 65536)),
+        max_per_epoch_activation_churn_limit=int(
+            take("MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT", 8)
+        ),
+        ejection_balance=int(take("EJECTION_BALANCE", 16_000_000_000)),
+        deposit_chain_id=int(take("DEPOSIT_CHAIN_ID", 1)),
+        deposit_network_id=int(take("DEPOSIT_NETWORK_ID", 1)),
+        deposit_contract_address=bytes(
+            take("DEPOSIT_CONTRACT_ADDRESS", bytes(20))
+        ),
+        proposer_score_boost=int(take("PROPOSER_SCORE_BOOST", 40)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the network-config bundle
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Eth2NetworkConfig:
+    """One network's bootstrap bundle (eth2_network_config src/lib.rs)."""
+
+    name: str
+    chain_spec: ChainSpec
+    boot_enr_texts: list[str] = field(default_factory=list)
+    deposit_contract_deploy_block: int = 0
+    genesis_state_bytes: bytes | None = None
+
+    def boot_enrs(self):
+        """Decode + signature-verify the boot records (invalid ones are
+        skipped, matching the reference's lenient ENR loading)."""
+        from ..network.enr import Enr
+
+        out = []
+        for text in self.boot_enr_texts:
+            try:
+                out.append(Enr.from_text(text))
+            except ValueError:
+                continue
+        return out
+
+    @classmethod
+    def from_dir(cls, path: str, name: str = "custom") -> "Eth2NetworkConfig":
+        """--testnet-dir loader: config.yaml (+ boot_enr.yaml,
+        deploy_block.txt, genesis.ssz if present)."""
+        import os
+
+        with open(os.path.join(path, "config.yaml")) as f:
+            cfg = parse_config_yaml(f.read())
+        enrs: list[str] = []
+        bf = os.path.join(path, "boot_enr.yaml")
+        if os.path.exists(bf):
+            with open(bf) as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if line.startswith("- "):
+                        enrs.append(line[2:].strip().strip("'\""))
+        deploy = 0
+        db = os.path.join(path, "deploy_block.txt")
+        if os.path.exists(db):
+            with open(db) as f:
+                deploy = int(f.read().strip())
+        genesis = None
+        gs = os.path.join(path, "genesis.ssz")
+        if os.path.exists(gs):
+            with open(gs, "rb") as f:
+                genesis = f.read()
+        return cls(
+            name=str(cfg.get("CONFIG_NAME", name)),
+            chain_spec=chain_spec_from_config(cfg),
+            boot_enr_texts=enrs,
+            deposit_contract_deploy_block=deploy,
+            genesis_state_bytes=genesis,
+        )
+
+
+# ---------------------------------------------------------------------------
+# built-in networks (built_in_network_configs/*)
+# ---------------------------------------------------------------------------
+
+# Operator-published mainnet boot nodes (boot_enr.yaml; public records).
+MAINNET_BOOT_ENRS = [
+    # Lighthouse team (Sigma Prime)
+    "enr:-Le4QPUXJS2BTORXxyx2Ia-9ae4YqA_JWX3ssj4E_J-3z1A-HmFGrU8BpvpqhNabayXeOZ2Nq_sbeDgtzMJpLLnXFgAChGV0aDKQtTA_KgEAAAAAIgEAAAAAAIJpZIJ2NIJpcISsaa0Zg2lwNpAkAIkHAAAAAPA8kv_-awoTiXNlY3AyNTZrMaEDHAD2JKYevx89W0CcFJFiskdcEzkH_Wdv9iW42qLK79ODdWRwgiMohHVkcDaCI4I",
+    "enr:-Le4QLHZDSvkLfqgEo8IWGG96h6mxwe_PsggC20CL3neLBjfXLGAQFOPSltZ7oP6ol54OvaNqO02Rnvb8YmDR274uq8ChGV0aDKQtTA_KgEAAAAAIgEAAAAAAIJpZIJ2NIJpcISLosQxg2lwNpAqAX4AAAAAAPA8kv_-ax65iXNlY3AyNTZrMaEDBJj7_dLFACaxBfaI8KZTh_SSJUjhyAyfshimvSqo22WDdWRwgiMohHVkcDaCI4I",
+    # EF team
+    "enr:-Ku4QHqVeJ8PPICcWk1vSn_XcSkjOkNiTg6Fmii5j6vUQgvzMc9L1goFnLKgXqBJspJjIsB91LTOleFmyWWrFVATGngBh2F0dG5ldHOIAAAAAAAAAACEZXRoMpC1MD8qAAAAAP__________gmlkgnY0gmlwhAMRHkWJc2VjcDI1NmsxoQKLVXFOhp2uX6jeT0DvvDpPcU8FWMjQdR4wMuORMhpX24N1ZHCCIyg",
+    "enr:-Ku4QG-2_Md3sZIAUebGYT6g0SMskIml77l6yR-M_JXc-UdNHCmHQeOiMLbylPejyJsdAPsTHJyjJB2sYGDLe0dn8uYBh2F0dG5ldHOIAAAAAAAAAACEZXRoMpC1MD8qAAAAAP__________gmlkgnY0gmlwhBLY-NyJc2VjcDI1NmsxoQORcM6e19T1T9gi7jxEZjk_sjVLGFscUNqAY9obgZaxbIN1ZHCCIyg",
+    # Teku team (Consensys)
+    "enr:-KG4QNTx85fjxABbSq_Rta9wy56nQ1fHK0PewJbGjLm1M4bMGx5-3Qq4ZX2-iFJ0pys_O90sVXNNOxp2E7afBsGsBrgDhGV0aDKQu6TalgMAAAD__________4JpZIJ2NIJpcIQEnfA2iXNlY3AyNTZrMaECGXWQ-rQ2KZKRH1aOW4IlPDBkY4XDphxg9pxKytFCkayDdGNwgiMog3VkcIIjKA",
+    # Prysm team (Prysmatic Labs)
+    "enr:-Ku4QImhMc1z8yCiNJ1TyUxdcfNucje3BGwEHzodEZUan8PherEo4sF7pPHPSIB1NNuSg5fZy7qFsjmUKs2ea1Whi0EBh2F0dG5ldHOIAAAAAAAAAACEZXRoMpD1pf1CAAAAAP__________gmlkgnY0gmlwhBLf22SJc2VjcDI1NmsxoQOVphkDqal4QzPMksc5wnpuC3gvSC8AfbFOnZY_On34wIN1ZHCCIyg",
+    # Nimbus team
+    "enr:-LK4QA8FfhaAjlb_BXsXxSfiysR7R52Nhi9JBt4F8SPssu8hdE1BXQQEtVDC3qStCW60LSO7hEsVHv5zm8_6Vnjhcn0Bh2F0dG5ldHOIAAAAAAAAAACEZXRoMpC1MD8qAAAAAP__________gmlkgnY0gmlwhAN4aBKJc2VjcDI1NmsxoQJerDhsJ-KxZ8sHySMOCmTO6sHM3iCFQ6VMvLTe948MyYN0Y3CCI4yDdWRwgiOM",
+]
+
+
+def mainnet_network_config() -> Eth2NetworkConfig:
+    from .spec import mainnet_spec
+
+    return Eth2NetworkConfig(
+        name="mainnet",
+        chain_spec=mainnet_spec(),
+        boot_enr_texts=list(MAINNET_BOOT_ENRS),
+        deposit_contract_deploy_block=11_184_524,
+    )
+
+
+def sepolia_network_config() -> Eth2NetworkConfig:
+    cfg = {
+        "PRESET_BASE": "mainnet",
+        "CONFIG_NAME": "sepolia",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 1300,
+        "MIN_GENESIS_TIME": 1655647200,
+        "GENESIS_FORK_VERSION": bytes.fromhex("90000069"),
+        "ALTAIR_FORK_VERSION": bytes.fromhex("90000070"),
+        "ALTAIR_FORK_EPOCH": 50,
+        "BELLATRIX_FORK_VERSION": bytes.fromhex("90000071"),
+        "BELLATRIX_FORK_EPOCH": 100,
+        "CAPELLA_FORK_VERSION": bytes.fromhex("90000072"),
+        "CAPELLA_FORK_EPOCH": 56832,
+        "DENEB_FORK_VERSION": bytes.fromhex("90000073"),
+        "DENEB_FORK_EPOCH": 132608,
+        "DEPOSIT_CHAIN_ID": 11155111,
+        "DEPOSIT_NETWORK_ID": 11155111,
+        "DEPOSIT_CONTRACT_ADDRESS": bytes.fromhex(
+            "7f02C3E3c98b133055B8B348B2Ac625669Ed295D".lower()
+        ),
+    }
+    return Eth2NetworkConfig(
+        name="sepolia",
+        chain_spec=chain_spec_from_config(cfg),
+        deposit_contract_deploy_block=1_273_020,
+    )
+
+
+def holesky_network_config() -> Eth2NetworkConfig:
+    cfg = {
+        "PRESET_BASE": "mainnet",
+        "CONFIG_NAME": "holesky",
+        "MIN_GENESIS_ACTIVE_VALIDATOR_COUNT": 16384,
+        "MIN_GENESIS_TIME": 1695902100,
+        "GENESIS_FORK_VERSION": bytes.fromhex("01017000"),
+        "ALTAIR_FORK_VERSION": bytes.fromhex("02017000"),
+        "ALTAIR_FORK_EPOCH": 0,
+        "BELLATRIX_FORK_VERSION": bytes.fromhex("03017000"),
+        "BELLATRIX_FORK_EPOCH": 0,
+        "CAPELLA_FORK_VERSION": bytes.fromhex("04017000"),
+        "CAPELLA_FORK_EPOCH": 256,
+        "DENEB_FORK_VERSION": bytes.fromhex("05017000"),
+        "DENEB_FORK_EPOCH": 29696,
+        "DEPOSIT_CHAIN_ID": 17000,
+        "DEPOSIT_NETWORK_ID": 17000,
+        "DEPOSIT_CONTRACT_ADDRESS": bytes.fromhex("42" * 20),
+    }
+    return Eth2NetworkConfig(
+        name="holesky",
+        chain_spec=chain_spec_from_config(cfg),
+        deposit_contract_deploy_block=0,
+    )
+
+
+HARDCODED_NETWORKS = {
+    "mainnet": mainnet_network_config,
+    "sepolia": sepolia_network_config,
+    "holesky": holesky_network_config,
+}

@@ -1,0 +1,139 @@
+"""Pallas TPU kernel for the Montgomery limb multiply — the hot op.
+
+PERF.md plan item 1: the XLA `mont_mul` lowers to ~3 Horner `lax.scan`s
+whose every step materializes a shifted copy of the (52, B) accumulator
+(concatenate + two scatter-adds) — the measured kernel is dispatch/copy
+bound, not multiply bound.  This kernel runs the whole Montgomery
+product — wide schoolbook, P' low product, P wide product, 52-limb carry
+normalization — as ONE Pallas program per lane tile with every
+intermediate in VMEM, loops unrolled at trace time (static 26/52-step
+Python loops), and the shift structure expressed as static-slice
+accumulations the Mosaic compiler keeps on-chip.
+
+Same representation contract as fp.mont_mul (fp.py): 26 x 15-bit
+quasi-normalized uint32 limbs, Montgomery radix 2^390, inputs with
+bound-product <= 2000 in units of P, STRICT limbs out.  The wrapper is a
+drop-in for the three-scan body; bound bookkeeping stays in fp.LFp.
+
+Enable with LIGHTHOUSE_TPU_PALLAS=1 (fp.mont_mul routes here on TPU
+backends; the lax.scan path remains the CPU/test reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fp as F
+
+LANE_TILE = 512  # lanes per grid step (multiple of 128)
+
+_P_COLS = np.asarray(F.int_to_limbs(F.P_INT)).reshape(26, 1)
+_PP_COLS = np.asarray(F.int_to_limbs(F.PPRIME_INT)).reshape(26, 1)
+
+MASK = np.uint32((1 << 15) - 1)
+
+
+def _compress1(cols):
+    """One carry pass (fp.compress1, in-kernel): quasi-normalize < 2^16.2.
+    Shift expressed as pad+slice (Mosaic has no scatter-add)."""
+    lo = cols & MASK
+    hi = cols >> 15
+    shifted = jnp.pad(hi[:-1], ((1, 0), (0, 0)))
+    return lo + shifted
+
+
+def _acc_add(acc, rows, offset: int):
+    """acc += rows placed at row ``offset`` — expressed as a zero-pad to
+    the accumulator height plus a full-width add (Mosaic lowers
+    pad/concatenate + add; it has neither scatter-add nor value-level
+    dynamic_slice)."""
+    tail = acc.shape[0] - offset - rows.shape[0]
+    return acc + jnp.pad(rows, ((offset, tail), (0, 0)))
+
+
+def _wide_product(a, b):
+    """Schoolbook sum_i a_i * b * 2^(15 i); a, b (26, T) quasi limbs.
+    Returns (52, T) columns, two carry passes applied (< QMAX + eps).
+    All accumulator updates are full-width in-bounds slice-adds — the
+    clipped-slice variant lowers to a scatter Pallas cannot stage."""
+    T = a.shape[1]
+    acc = jnp.zeros((52, T), dtype=jnp.uint32)
+    for i in range(26):
+        p = a[i][None, :] * b  # (26, T) 32-bit products
+        plo = p & MASK
+        phi = p >> 15
+        acc = _acc_add(acc, plo, i)
+        acc = _acc_add(acc, phi, i + 1)
+        # column sums stay < 26 * 2^15.2 + carries < 2^21: no overflow
+    return _compress1(_compress1(acc))
+
+
+def _mont_kernel(a_ref, b_ref, p_ref, pp_ref, o_ref):
+    a = a_ref[:]
+    b = b_ref[:]
+    pl_ = p_ref[:]
+    pp = pp_ref[:]
+
+    t = _wide_product(a, b)  # a*b
+    # (t * P') mod 2^390: the low half of the full product (columns < 26
+    # of the wide product are exactly the low product's columns)
+    m = _wide_product(t[:26], pp)[:26]
+    u = _wide_product(m, pl_)  # m*P
+    s = t + u  # < 2^17.3 per column
+
+    # full carry normalization: low 26 limbs vanish (divisible by R);
+    # sequential chain over all 52 columns, carry as one lane row
+    carry = jnp.zeros((a.shape[1],), dtype=jnp.uint32)
+    out_rows = []
+    for k in range(52):
+        tcol = s[k] + carry
+        carry = tcol >> 15
+        if k >= 26:
+            out_rows.append(tcol & MASK)
+    o_ref[:] = jnp.stack(out_rows, axis=0)
+
+
+@functools.lru_cache(maxsize=64)
+def _mont_call(n_padded: int, tile: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (n_padded // tile,)
+    spec = pl.BlockSpec((26, tile), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+    const_spec = pl.BlockSpec((26, tile), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _mont_kernel,
+        out_shape=jax.ShapeDtypeStruct((26, n_padded), jnp.uint32),
+        grid=grid,
+        in_specs=[spec, spec, const_spec, const_spec],
+        out_specs=spec,
+        interpret=interpret,
+    )
+
+
+def mont_mul_limbs(a_limbs, b_limbs, interpret: bool = False):
+    """(26, N) x (26, N) quasi limbs -> (26, N) strict Montgomery product.
+    Pads N up to a lane multiple; slices back."""
+    n = a_limbs.shape[-1]
+    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    n_padded = -(-n // tile) * tile
+    if n_padded != n:
+        pad = ((0, 0), (0, n_padded - n))
+        a_limbs = jnp.pad(a_limbs, pad)
+        b_limbs = jnp.pad(b_limbs, pad)
+    p_tile = jnp.broadcast_to(
+        jnp.asarray(_P_COLS, dtype=jnp.uint32), (26, tile)
+    )
+    pp_tile = jnp.broadcast_to(
+        jnp.asarray(_PP_COLS, dtype=jnp.uint32), (26, tile)
+    )
+    out = _mont_call(n_padded, tile, interpret)(
+        a_limbs, b_limbs, p_tile, pp_tile
+    )
+    return out[:, :n] if n_padded != n else out

@@ -32,7 +32,6 @@ from .noise import (
     NoiseSession,
     _pb_field_bytes,
     _pb_parse,
-    _pb_read_varint,
     _pb_varint,
     initiator_handshake,
     peer_id_from_pubkey,
@@ -64,13 +63,18 @@ def _ms_frame(line: str) -> bytes:
     return _pb_varint(len(raw)) + raw
 
 
+MAX_MS_MESSAGE = 64 * 1024  # multistream-select message cap
+
+
 class _MsgReader:
-    """Adapts exact-read byte sources to uvarint-framed line reads."""
+    """Adapts exact-read byte sources to uvarint-framed reads.  The ONE
+    uvarint decoder for the wire layer — bounds enforced here apply to
+    multistream lines and gossip RPC frames alike."""
 
     def __init__(self, read_exact: Callable[[int], bytes]):
         self.read_exact = read_exact
 
-    def read_line(self) -> str:
+    def read_uvarint(self, max_value: int) -> int:
         n, shift = 0, 0
         while True:
             b = self.read_exact(1)[0]
@@ -78,6 +82,14 @@ class _MsgReader:
             if not b & 0x80:
                 break
             shift += 7
+            if shift > 63:
+                raise Libp2pError("uvarint over 9 bytes")
+        if n > max_value:
+            raise Libp2pError(f"frame length {n} over cap {max_value}")
+        return n
+
+    def read_line(self) -> str:
+        n = self.read_uvarint(MAX_MS_MESSAGE)
         raw = self.read_exact(n)
         return raw.rstrip(b"\n").decode()
 
@@ -160,6 +172,7 @@ class Connection:
         self.topics: set[str] = set()  # peer's subscriptions
         self._gossip_out: Stream | None = None
         self._lock = threading.Lock()
+        self._gossip_write_lock = threading.Lock()
         self.alive = True
 
     # -- gossip ------------------------------------------------------------
@@ -176,7 +189,11 @@ class Connection:
     def send_gossip_rpc(self, rpc: bytes) -> None:
         try:
             st = self._ensure_gossip_stream()
-            st.write(_pb_varint(len(rpc)) + rpc)
+            # one writer at a time: a large RPC can split across yamux
+            # frames while blocked on window credit, and interleaved
+            # writers would corrupt the shared stream's varint framing
+            with self._gossip_write_lock:
+                st.write(_pb_varint(len(rpc)) + rpc)
         except (YamuxError, OSError, Libp2pError) as exc:
             log.debug("gossip send to %s failed: %s", self.peer_id.hex()[:8], exc)
             self.alive = False
@@ -388,16 +405,12 @@ class Libp2pHost:
 
     def _serve_gossip(self, conn: Connection, st: Stream,
                       reader: _MsgReader) -> None:
+        idle_reader = _MsgReader(lambda n: st.read(n, timeout=3600.0))
         while self._running and conn.alive:
-            n, shift = 0, 0
-            while True:
-                b = st.read(1, timeout=3600.0)[0]
-                n |= (b & 0x7F) << shift
-                if not b & 0x80:
-                    break
-                shift += 7
-            if n > MAX_GOSSIP_RPC_SIZE:
-                # remote-controlled allocation: drop + penalize, never buffer
+            try:
+                n = idle_reader.read_uvarint(MAX_GOSSIP_RPC_SIZE)
+            except Libp2pError:
+                # oversized/malformed: drop + penalize, never buffer
                 self.peer_manager.report(
                     conn.peer_id.hex(), -10.0, "oversized gossip rpc"
                 )

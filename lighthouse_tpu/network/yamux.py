@@ -52,6 +52,7 @@ class Stream:
         self._buf = b""
         self._recv_window = INITIAL_WINDOW
         self._send_window = INITIAL_WINDOW
+        self._inflight = 0  # delivered-not-yet-consumed bytes
         self._window_cv = threading.Condition()
         self._closed_local = False
         self._closed_remote = False
@@ -139,6 +140,7 @@ class Stream:
         except queue.Empty:
             raise YamuxError(f"stream {self.id}: read timeout") from None
         if item is not None:
+            self._inflight -= len(item)
             self._return_credit(len(item))
         return item
 
@@ -152,8 +154,14 @@ class Stream:
             )
 
     # session-side delivery
-    def _deliver(self, data: bytes) -> None:
+    def _deliver(self, data: bytes) -> bool:
+        """Queue received bytes; False = peer overran our advertised
+        receive window (protocol violation — remote-controlled memory)."""
+        self._inflight += len(data)
+        if self._inflight > 2 * INITIAL_WINDOW:
+            return False
         self._rx.put(data)
+        return True
 
     def _remote_close(self) -> None:
         self._closed_remote = True
@@ -275,8 +283,11 @@ class Session:
         st = self._get_or_open(flags, sid)
         if st is None:
             return
-        if body:
-            st._deliver(body)
+        if body and not st._deliver(body):
+            # window overrun: reset the stream rather than buffer
+            st.reset()
+            st._remote_close()
+            return
         if flags & (FLAG_FIN | FLAG_RST):
             st._remote_close()
 

@@ -1,0 +1,107 @@
+"""Embedded network configs (eth2_network_config analog).
+
+The boot-ENR test is a REAL interop check: the embedded records are the
+operator-published mainnet boot nodes (Sigma Prime, EF, Teku, Prysm,
+Nimbus) — our RLP/keccak/secp256k1 ENR stack must verify their live
+signatures and recover endpoints.
+"""
+
+import os
+
+import pytest
+
+from lighthouse_tpu.consensus.network_config import (
+    HARDCODED_NETWORKS,
+    MAINNET_BOOT_ENRS,
+    Eth2NetworkConfig,
+    chain_spec_from_config,
+    mainnet_network_config,
+    parse_config_yaml,
+)
+from lighthouse_tpu.consensus.spec import mainnet_spec
+
+MAINNET_CONFIG_YAML = """
+PRESET_BASE: 'mainnet'
+CONFIG_NAME: 'mainnet'
+MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: 16384
+MIN_GENESIS_TIME: 1606824000
+GENESIS_FORK_VERSION: 0x00000000
+GENESIS_DELAY: 604800
+ALTAIR_FORK_VERSION: 0x01000000
+ALTAIR_FORK_EPOCH: 74240  # Oct 27, 2021
+BELLATRIX_FORK_VERSION: 0x02000000
+BELLATRIX_FORK_EPOCH: 144896
+CAPELLA_FORK_VERSION: 0x03000000
+CAPELLA_FORK_EPOCH: 194048
+DENEB_FORK_VERSION: 0x04000000
+DENEB_FORK_EPOCH: 269568
+SECONDS_PER_SLOT: 12
+ETH1_FOLLOW_DISTANCE: 2048
+EJECTION_BALANCE: 16000000000
+DEPOSIT_CHAIN_ID: 1
+DEPOSIT_NETWORK_ID: 1
+DEPOSIT_CONTRACT_ADDRESS: 0x00000000219ab540356cBB839Cbe05303d7705Fa
+"""
+
+
+def test_parse_and_spec_mapping_matches_builtin():
+    cfg = parse_config_yaml(MAINNET_CONFIG_YAML)
+    assert cfg["MIN_GENESIS_TIME"] == 1606824000
+    assert cfg["GENESIS_FORK_VERSION"] == bytes(4)
+    spec = chain_spec_from_config(cfg)
+    builtin = mainnet_spec()
+    assert spec.altair_fork_epoch == builtin.altair_fork_epoch == 74240
+    assert spec.deneb_fork_version == builtin.deneb_fork_version
+    assert spec.deposit_contract_address.hex().startswith("00000000219ab540")
+    assert spec.preset.name == "mainnet"
+
+
+def test_far_future_epoch_means_unscheduled():
+    cfg = parse_config_yaml("ELECTRA_FORK_EPOCH: 18446744073709551615\n")
+    spec = chain_spec_from_config(
+        {**cfg, "ALTAIR_FORK_EPOCH": 18446744073709551615}
+    )
+    assert spec.altair_fork_epoch is None
+
+
+def test_mainnet_boot_enrs_verify_real_signatures():
+    """Operator-published records must decode + signature-verify through
+    the from-scratch keccak/secp256k1/RLP stack."""
+    recs = mainnet_network_config().boot_enrs()
+    assert len(recs) == len(MAINNET_BOOT_ENRS), "every boot record verifies"
+    for rec in recs:
+        assert rec.kv.get(b"id") == b"v4"
+        assert len(rec.node_id) == 32
+        # every mainnet boot node publishes an eth2 fork digest field
+        assert b"eth2" in rec.kv
+    # at least the Lighthouse records carry UDP endpoints
+    assert any(r.udp_endpoint() for r in recs)
+
+
+def test_hardcoded_networks():
+    assert set(HARDCODED_NETWORKS) == {"mainnet", "sepolia", "holesky"}
+    sep = HARDCODED_NETWORKS["sepolia"]()
+    assert sep.chain_spec.deposit_chain_id == 11155111
+    assert sep.chain_spec.genesis_fork_version == bytes.fromhex("90000069")
+    hol = HARDCODED_NETWORKS["holesky"]()
+    assert hol.chain_spec.altair_fork_epoch == 0
+    assert hol.chain_spec.deposit_contract_address == bytes.fromhex("42" * 20)
+
+
+def test_testnet_dir_loader(tmp_path):
+    (tmp_path / "config.yaml").write_text(
+        "CONFIG_NAME: 'devnet-7'\nPRESET_BASE: 'minimal'\n"
+        "ALTAIR_FORK_EPOCH: 0\nDEPOSIT_CHAIN_ID: 424242\n"
+    )
+    (tmp_path / "deploy_block.txt").write_text("123\n")
+    (tmp_path / "boot_enr.yaml").write_text(
+        "# devnet nodes\n- " + MAINNET_BOOT_ENRS[0] + "\n"
+    )
+    (tmp_path / "genesis.ssz").write_bytes(b"\x01\x02\x03")
+    net = Eth2NetworkConfig.from_dir(str(tmp_path))
+    assert net.name == "devnet-7"
+    assert net.chain_spec.preset.name == "minimal"
+    assert net.chain_spec.deposit_chain_id == 424242
+    assert net.deposit_contract_deploy_block == 123
+    assert net.genesis_state_bytes == b"\x01\x02\x03"
+    assert len(net.boot_enrs()) == 1
