@@ -83,20 +83,29 @@ def run_measurement(force_cpu: bool) -> None:
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
     _enable_compile_cache(jax)
+    device_h2c = os.environ.get("BENCH_DEVICE_H2C", "") == "1"
     # backend modules materialize jnp constants at import: watchdog first
     disarm = _arm_watchdog(init_timeout, "device init")
-    from __graft_entry__ import _example_batch
-    from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
+    from __graft_entry__ import _build_sets, _marshal
+
+    if device_h2c:
+        from lighthouse_tpu.crypto.bls.jax_backend.backend import (
+            _verify_kernel_h2c as _verify_kernel,
+        )
+    else:
+        from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
 
     dev = jax.devices()[0]
     disarm()
-    print(f"device: {dev}", file=sys.stderr)
+    print(f"device: {dev} (device_h2c={device_h2c})", file=sys.stderr)
 
+    sets = _build_sets(B)  # test-data construction: NOT timed (includes
+    # signing, which a real node receives from the wire)
     t0 = time.time()
-    args = _example_batch(B)
+    args = _marshal(sets, device_h2c=device_h2c)
     t_marshal = time.time() - t0
     print(
-        f"host build+hash+marshal for B={B}: {t_marshal:.1f}s "
+        f"host marshal (hash+encode+weights) for B={B}: {t_marshal:.1f}s "
         f"({B / t_marshal:.0f} sets/s host-side)",
         file=sys.stderr,
     )
@@ -135,6 +144,7 @@ def run_measurement(force_cpu: bool) -> None:
                 "batch": B,
                 "compile_sec": round(t_compile, 1),
                 "host_marshal_sets_per_s": round(B / t_marshal, 1),
+                "device_h2c": device_h2c,
             }
         ),
         flush=True,

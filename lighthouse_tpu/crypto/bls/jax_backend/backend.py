@@ -205,12 +205,19 @@ class JaxBackend:
 
     name = "jax"
 
-    def __init__(self, min_batch: int = 8, device_h2c: bool = False):
+    def __init__(self, min_batch: int = 8, device_h2c: bool | None = None):
         self._kernels = {}
         self.min_batch = min_batch
         # device_h2c: map messages to G2 ON DEVICE (host only hashes).
-        # Removes the dominant host cost; off by default until profiled on
-        # the real chip (it grows the compiled graph by ~2 sqrt chains).
+        # Measured on the v5e at B=512 (PERF.md): host marshal 120 -> 5,008
+        # sets/s/core while the kernel pays +70% (2,655 -> 1,565 sets/s) for
+        # the two sqrt chains — system throughput is host-bound without it,
+        # balanced with it.  Default: on for TPU, off on CPU (where the
+        # bigger graph just slows the test oracle).
+        if device_h2c is None:
+            import jax
+
+            device_h2c = jax.default_backend() == "tpu"
         self.device_h2c = device_h2c
 
     def _kernel(self, B: int):

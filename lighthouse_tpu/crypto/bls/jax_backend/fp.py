@@ -111,10 +111,22 @@ def limbs_to_int(limbs) -> int:
 
 
 def ints_to_limbs(xs) -> np.ndarray:
-    out = np.zeros((N, len(xs)), dtype=np.uint32)
-    for j, x in enumerate(xs):
-        out[:, j] = int_to_limbs(x)
-    return out
+    """Host codec, vectorized: ints -> (N, B) limb columns.  int.to_bytes
+    is C-speed; the 8-bit -> 15-bit regrouping is one unpackbits reshape
+    (the per-int Python limb loop was a marshal bottleneck at B=4096)."""
+    B = len(xs)
+    if B == 0:
+        return np.zeros((N, 0), dtype=np.uint32)
+    raw = np.frombuffer(
+        b"".join(x.to_bytes(49, "little") for x in xs), dtype=np.uint8
+    ).reshape(B, 49)
+    # bits in little-endian significance order per value
+    bits = np.unpackbits(raw, axis=1, bitorder="little")[:, : N * BITS]
+    weights = (1 << np.arange(BITS, dtype=np.uint32))
+    limbs = (bits.reshape(B, N, BITS) * weights[None, None, :]).sum(
+        axis=2, dtype=np.uint32
+    )
+    return np.ascontiguousarray(limbs.T)
 
 
 def limbs_to_ints(limbs) -> list[int]:

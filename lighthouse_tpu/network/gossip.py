@@ -54,6 +54,10 @@ class SeenCache:
             self._d.popitem(last=False)
         return True
 
+    def contains(self, mid: bytes) -> bool:
+        """Non-mutating membership probe (IHAVE filtering)."""
+        return mid in self._d
+
 
 # peer scoring (gossipsub_scoring_parameters.rs / peer_manager shape)
 GREYLIST_THRESHOLD = -16.0

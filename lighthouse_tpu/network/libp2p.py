@@ -125,6 +125,7 @@ def ms_negotiate_in(write, reader: _MsgReader, supported) -> str:
 def encode_gossip_rpc(
     subscriptions: list[tuple[bool, str]] | None = None,
     publish: list[tuple[str, bytes]] | None = None,
+    control: "GossipControl | None" = None,
 ) -> bytes:
     out = b""
     for sub, topic in subscriptions or []:
@@ -134,7 +135,60 @@ def encode_gossip_rpc(
     for topic, data in publish or []:
         msg = _pb_field_bytes(2, data) + _pb_field_bytes(4, topic.encode())
         out += _pb_field_bytes(2, msg)
+    if control is not None and not control.empty():
+        out += _pb_field_bytes(3, control.encode())
     return out
+
+
+class GossipControl:
+    """gossipsub v1.1 ControlMessage: ihave/iwant/graft/prune."""
+
+    def __init__(self, ihave=None, iwant=None, graft=None, prune=None):
+        self.ihave: list[tuple[str, list[bytes]]] = ihave or []
+        self.iwant: list[bytes] = iwant or []
+        self.graft: list[str] = graft or []
+        self.prune: list[str] = prune or []
+
+    def empty(self) -> bool:
+        return not (self.ihave or self.iwant or self.graft or self.prune)
+
+    def encode(self) -> bytes:
+        out = b""
+        for topic, mids in self.ihave:
+            body = _pb_field_bytes(1, topic.encode())
+            for mid in mids:
+                body += _pb_field_bytes(2, mid)
+            out += _pb_field_bytes(1, body)
+        if self.iwant:
+            body = b""
+            for mid in self.iwant:
+                body += _pb_field_bytes(1, mid)
+            out += _pb_field_bytes(2, body)
+        for topic in self.graft:
+            out += _pb_field_bytes(3, _pb_field_bytes(1, topic.encode()))
+        for topic in self.prune:
+            out += _pb_field_bytes(4, _pb_field_bytes(1, topic.encode()))
+        return out
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "GossipControl":
+        f = _pb_parse(raw)
+        ctl = cls()
+        for ih in f.get(1, []):
+            g = _pb_parse(ih)
+            ctl.ihave.append(
+                (g.get(1, [b""])[0].decode(), list(g.get(2, [])))
+            )
+        for iw in f.get(2, []):
+            g = _pb_parse(iw)
+            ctl.iwant.extend(g.get(1, []))
+        for gr in f.get(3, []):
+            g = _pb_parse(gr)
+            ctl.graft.append(g.get(1, [b""])[0].decode())
+        for pr in f.get(4, []):
+            g = _pb_parse(pr)
+            ctl.prune.append(g.get(1, [b""])[0].decode())
+        return ctl
 
 
 def decode_gossip_rpc(raw: bytes):
@@ -151,7 +205,42 @@ def decode_gossip_rpc(raw: bytes):
         topic = f.get(4, [b""])[0].decode()
         data = f.get(2, [b""])[0]
         msgs.append((topic, data))
-    return subs, msgs
+    control = None
+    if fields.get(3):
+        control = GossipControl.decode(fields[3][0])
+    return subs, msgs, control
+
+
+class MessageCache:
+    """gossipsub mcache: full messages for IWANT service, sliding window
+    of heartbeats for IHAVE advertisement."""
+
+    def __init__(self, gossip_windows: int = 3, total_windows: int = 5):
+        self.gossip_windows = gossip_windows
+        self.windows: list[list[bytes]] = [[] for _ in range(total_windows)]
+        self.msgs: dict[bytes, tuple[str, bytes]] = {}
+
+    def put(self, mid: bytes, topic: str, data: bytes) -> None:
+        self.windows[0].append(mid)
+        self.msgs[mid] = (topic, data)
+
+    def get(self, mid: bytes):
+        return self.msgs.get(mid)
+
+    def recent_ids(self, topic: str) -> list[bytes]:
+        out = []
+        for w in self.windows[: self.gossip_windows]:
+            for mid in w:
+                entry = self.msgs.get(mid)
+                if entry is not None and entry[0] == topic:
+                    out.append(mid)
+        return out
+
+    def shift(self) -> None:
+        expired = self.windows.pop()
+        for mid in expired:
+            self.msgs.pop(mid, None)
+        self.windows.insert(0, [])
 
 
 # ---------------------------------------------------------------------------
@@ -230,7 +319,15 @@ class Libp2pHost:
     ignore/reject (MessageAcceptance semantics, gossip.py scoring).
     """
 
-    def __init__(self, key=None, ip: str = "127.0.0.1", port: int = 0):
+    # gossipsub v1.1 mesh parameters (the reference's defaults)
+    D = 6
+    D_LO = 4
+    D_HI = 12
+    D_LAZY = 6
+    HEARTBEAT_SECS = 1.0
+
+    def __init__(self, key=None, ip: str = "127.0.0.1", port: int = 0,
+                 heartbeat: bool = True):
         from cryptography.hazmat.primitives.asymmetric import ec
 
         self.key = key or ec.generate_private_key(ec.SECP256K1())
@@ -252,6 +349,9 @@ class Libp2pHost:
         self.peer_manager = PeerManager()
         self.received: list[tuple[str, bytes]] = []
         self.rate_limiter = rpc_mod.RateLimiter()
+        self.mesh: dict[str, set[bytes]] = {}  # topic -> mesh peer ids
+        self.mcache = MessageCache()
+        self._heartbeat_enabled = heartbeat
         self._running = False
         self._threads: list[threading.Thread] = []
 
@@ -263,6 +363,62 @@ class Libp2pHost:
                              name=f"libp2p-{self.port}", daemon=True)
         t.start()
         self._threads.append(t)
+        if self._heartbeat_enabled:
+            hb = threading.Thread(target=self._heartbeat_loop,
+                                  name=f"gossip-hb-{self.port}", daemon=True)
+            hb.start()
+            self._threads.append(hb)
+
+    def _heartbeat_loop(self) -> None:
+        import time as _time
+
+        while self._running:
+            _time.sleep(self.HEARTBEAT_SECS)
+            try:
+                self.heartbeat()
+            except Exception as exc:  # noqa: BLE001
+                log.debug("heartbeat: %s", exc)
+
+    def heartbeat(self) -> None:
+        """gossipsub heartbeat: mesh maintenance + IHAVE gossip + mcache
+        window shift (the vendored gossipsub's heartbeat())."""
+        import random as _random
+
+        for topic in list(self.subscriptions):
+            mesh = self.mesh.setdefault(topic, set())
+            subscribed = [
+                pid for pid, c in self.connections.items()
+                if topic in c.topics and c.alive
+            ]
+            mesh.intersection_update(subscribed)
+            # grow toward D when below D_LO
+            if len(mesh) < self.D_LO:
+                candidates = [p for p in subscribed if p not in mesh]
+                _random.shuffle(candidates)
+                for pid in candidates[: self.D - len(mesh)]:
+                    mesh.add(pid)
+                    self._send_control(pid, GossipControl(graft=[topic]))
+            # shrink toward D when above D_HI
+            elif len(mesh) > self.D_HI:
+                excess = _random.sample(sorted(mesh), len(mesh) - self.D)
+                for pid in excess:
+                    mesh.discard(pid)
+                    self._send_control(pid, GossipControl(prune=[topic]))
+            # IHAVE gossip to a sample of non-mesh subscribers
+            mids = self.mcache.recent_ids(topic)
+            if mids:
+                lazy = [p for p in subscribed if p not in mesh]
+                _random.shuffle(lazy)
+                for pid in lazy[: self.D_LAZY]:
+                    self._send_control(
+                        pid, GossipControl(ihave=[(topic, mids[:64])])
+                    )
+        self.mcache.shift()
+
+    def _send_control(self, peer_id: bytes, ctl: GossipControl) -> None:
+        conn = self.connections.get(peer_id)
+        if conn is not None:
+            conn.send_gossip_rpc(encode_gossip_rpc(control=ctl))
 
     def stop(self) -> None:
         self._running = False
@@ -373,6 +529,8 @@ class Libp2pHost:
         conn.alive = False
         if self.connections.get(conn.peer_id) is conn:
             del self.connections[conn.peer_id]
+        for mesh in self.mesh.values():
+            mesh.discard(conn.peer_id)  # stale mesh entries eat publishes
         info = self.peer_manager.peers.get(conn.peer_id.hex())
         if info is not None:
             info.connected = False
@@ -417,11 +575,13 @@ class Libp2pHost:
                 st.reset()
                 return
             raw = st.read(n, timeout=10.0)
-            subs, msgs = decode_gossip_rpc(raw)
+            subs, msgs, control = decode_gossip_rpc(raw)
             for subscribed, topic in subs:
                 (conn.topics.add if subscribed else conn.topics.discard)(topic)
             for topic, data in msgs:
                 self._on_gossip_message(conn, topic, data)
+            if control is not None:
+                self._on_gossip_control(conn, control)
 
     def _on_gossip_message(self, conn: Connection, topic: str,
                            data: bytes) -> None:
@@ -439,9 +599,46 @@ class Libp2pHost:
         outcome = handler(payload, conn.peer_id)
         if outcome == "accept":
             self.received.append((topic, payload))
-            self._flood(topic, data, skip=conn.peer_id)
+            self.mcache.put(mid, topic, data)
+            self._forward(topic, data, skip=conn.peer_id)
         elif outcome == "reject":
             self.peer_manager.report(conn.peer_id.hex(), -10.0, "invalid gossip")
+
+    def _on_gossip_control(self, conn: Connection, ctl: GossipControl) -> None:
+        """GRAFT/PRUNE mesh membership; IHAVE -> IWANT for unseen ids;
+        IWANT served from the mcache."""
+        for topic in ctl.graft:
+            if topic in self.subscriptions:
+                self.mesh.setdefault(topic, set()).add(conn.peer_id)
+            else:
+                # not subscribed: refuse the graft (spec: prune back)
+                self._send_control(conn.peer_id, GossipControl(prune=[topic]))
+        for topic in ctl.prune:
+            self.mesh.get(topic, set()).discard(conn.peer_id)
+        wanted = []
+        for topic, mids in ctl.ihave:
+            if topic not in self.subscriptions:
+                continue
+            wanted.extend(m for m in mids if not self.seen.contains(m))
+        if wanted:
+            self._send_control(conn.peer_id, GossipControl(iwant=wanted[:64]))
+        if ctl.iwant:
+            # retransmission bound (gossip_retransmission analog): IWANT
+            # floods re-serve full messages — rate limit per peer
+            if not self.rate_limiter.allow(
+                conn.peer_id.hex(), "gossip_iwant", cost=float(len(ctl.iwant))
+            ):
+                self.peer_manager.report(
+                    conn.peer_id.hex(), -1.0, "iwant flood"
+                )
+                return
+            sends = []
+            for mid in ctl.iwant[:64]:
+                entry = self.mcache.get(mid)
+                if entry is not None:
+                    sends.append(entry)
+            if sends:
+                conn.send_gossip_rpc(encode_gossip_rpc(publish=sends))
 
     def _serve_rpc(self, conn: Connection, st: Stream, name: str) -> None:
         body = st.read_until_eof(timeout=10.0)
@@ -467,15 +664,25 @@ class Libp2pHost:
         compressed = snappy.compress_block(payload)
         mid = message_id(topic, compressed)
         self.seen.observe(mid)
-        self._flood(topic, compressed, skip=None)
+        self.mcache.put(mid, topic, compressed)
+        self._forward(topic, compressed, skip=None)
         return mid
 
-    def _flood(self, topic: str, compressed: bytes, skip: bytes | None) -> None:
+    def _forward(self, topic: str, compressed: bytes, skip: bytes | None) -> None:
+        """Route to the topic mesh (gossipsub); peers outside the mesh
+        learn of the message via heartbeat IHAVE + IWANT.  With no mesh
+        formed yet (pre-heartbeat bootstrap), flood all subscribers."""
         rpc = encode_gossip_rpc(publish=[(topic, compressed)])
+        live = {
+            pid for pid, c in self.connections.items() if c.alive
+        }
+        mesh = (self.mesh.get(topic) or set()) & live
         for conn in list(self.connections.values()):
             if not conn.alive:
                 self._drop_connection(conn)
                 continue
             if conn.peer_id == skip or topic not in conn.topics:
+                continue
+            if mesh and conn.peer_id not in mesh:
                 continue
             conn.send_gossip_rpc(rpc)

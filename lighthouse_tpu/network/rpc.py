@@ -166,6 +166,8 @@ DEFAULT_LIMITS = {
     "metadata": (2, 0.5),
     "beacon_blocks_by_range": (1024, 100.0),
     "beacon_blocks_by_root": (128, 20.0),
+    # gossipsub IWANT retransmission budget (ids/sec, not requests)
+    "gossip_iwant": (256, 32.0),
 }
 
 

@@ -188,10 +188,40 @@ class TestGossipRpcCodec:
                            (False, "/eth2/x/voluntary_exit/ssz_snappy")],
             publish=[("/eth2/x/beacon_block/ssz_snappy", b"\x01\x02")],
         )
-        subs, msgs = decode_gossip_rpc(raw)
+        subs, msgs, control = decode_gossip_rpc(raw)
         assert subs == [(True, "/eth2/x/beacon_block/ssz_snappy"),
                         (False, "/eth2/x/voluntary_exit/ssz_snappy")]
         assert msgs == [("/eth2/x/beacon_block/ssz_snappy", b"\x01\x02")]
+        assert control is None
+
+    def test_control_roundtrip(self):
+        from lighthouse_tpu.network.libp2p import GossipControl
+
+        ctl = GossipControl(
+            ihave=[("/t1", [b"\xaa" * 20, b"\xbb" * 20])],
+            iwant=[b"\xcc" * 20],
+            graft=["/t2"],
+            prune=["/t3", "/t4"],
+        )
+        raw = encode_gossip_rpc(control=ctl)
+        _subs, _msgs, back = decode_gossip_rpc(raw)
+        assert back.ihave == [("/t1", [b"\xaa" * 20, b"\xbb" * 20])]
+        assert back.iwant == [b"\xcc" * 20]
+        assert back.graft == ["/t2"]
+        assert back.prune == ["/t3", "/t4"]
+
+    def test_mcache_windows(self):
+        from lighthouse_tpu.network.libp2p import MessageCache
+
+        mc = MessageCache(gossip_windows=2, total_windows=3)
+        mc.put(b"m1", "/t", b"d1")
+        mc.shift()
+        mc.put(b"m2", "/t", b"d2")
+        assert set(mc.recent_ids("/t")) == {b"m1", b"m2"}
+        mc.shift()  # m1 now outside the gossip window
+        assert set(mc.recent_ids("/t")) == {b"m2"}
+        mc.shift()  # m1 expires entirely
+        assert mc.get(b"m1") is None and mc.get(b"m2") == ("/t", b"d2")
 
 
 @pytest.fixture
@@ -247,6 +277,66 @@ class TestHost:
         conn = a.dial("127.0.0.1", b.port)
         with pytest.raises(Exception):
             conn.request("status", b"\x00", timeout=2.0)  # b has no handler
+
+    def test_mesh_graft_and_iwant_recovery(self):
+        """Heartbeats form a mesh; a message published while a peer was
+        outside the mesh is recovered via IHAVE -> IWANT.  Manual
+        heartbeats so the background loop cannot race the scenario."""
+        a = Libp2pHost(heartbeat=False)
+        b = Libp2pHost(heartbeat=False)
+        a.start(); b.start()
+        try:
+            self._run_graft_iwant_scenario(a, b)
+        finally:
+            a.stop(); b.stop()
+
+    def _run_graft_iwant_scenario(self, a, b):
+        got_b = []
+        a.subscribe(TOPIC, lambda p, pid: "accept")
+        b.subscribe(TOPIC, lambda p, pid: (got_b.append(p), "accept")[1])
+        a.dial("127.0.0.1", b.port)
+        time.sleep(0.3)
+        a.heartbeat()  # grafts b into a's mesh
+        assert any(TOPIC in c.topics for c in a.connections.values())
+        deadline = time.time() + 3
+        while time.time() < deadline and not a.mesh.get(TOPIC):
+            time.sleep(0.05)
+        assert a.mesh.get(TOPIC), "graft must land b in a's mesh"
+        # publish lands directly (mesh route)
+        a.publish(TOPIC, b"direct")
+        deadline = time.time() + 3
+        while time.time() < deadline and not got_b:
+            time.sleep(0.05)
+        assert got_b == [b"direct"]
+        # now simulate a missed message: present only in a's mcache; an
+        # IHAVE advertisement must trigger b's IWANT and deliver it
+        from lighthouse_tpu.network.gossip import message_id
+        from lighthouse_tpu.network.libp2p import GossipControl
+        from lighthouse_tpu.network.snappy import compress_block
+
+        payload = b"recovered-via-iwant"
+        compressed = compress_block(payload)
+        mid = message_id(TOPIC, compressed)
+        a.mcache.put(mid, TOPIC, compressed)
+        a._send_control(b.peer_id, GossipControl(ihave=[(TOPIC, [mid])]))
+        deadline = time.time() + 3
+        while time.time() < deadline and payload not in got_b:
+            time.sleep(0.05)
+        assert payload in got_b, "IHAVE/IWANT recovery failed"
+
+    def test_graft_unsubscribed_topic_pruned_back(self, hosts):
+        a, b, _c = hosts
+        a.subscribe(TOPIC, lambda p, pid: "accept")
+        conn = a.dial("127.0.0.1", b.port)
+        time.sleep(0.2)
+        from lighthouse_tpu.network.libp2p import GossipControl, encode_gossip_rpc
+
+        conn.send_gossip_rpc(
+            encode_gossip_rpc(control=GossipControl(graft=[TOPIC]))
+        )
+        time.sleep(0.5)
+        # b is not subscribed: must NOT keep a in any mesh
+        assert not b.mesh.get(TOPIC)
 
     def test_rate_limit_returns_resource_unavailable(self, hosts):
         a, b, _c = hosts
