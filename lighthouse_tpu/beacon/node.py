@@ -1,0 +1,255 @@
+"""BeaconNode: the assembled service graph (client builder analog).
+
+Twin of beacon_node/client/src/builder.rs:765-960 — one object that
+builds and boots every service in dependency order: store → chain →
+wire transports (libp2p TCP + discv5 UDP, network/) → gossip topic
+subscriptions feeding the chain → req/resp handlers (status, ping,
+metadata, blocks-by-range served from the chain) → Beacon-API HTTP →
+slot-driven block production/attestation.  Two BeaconNodes discover
+each other through a boot node, Status-handshake, range-sync history
+over the encrypted channel, then follow the head via gossipsub — the
+full lighthouse bn networking loop, TPU-sided verification underneath.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..consensus import spec as S
+from ..consensus.containers import types_for
+from ..consensus.testing import interop_state
+from ..network import rpc as rpc_mod
+from ..network import topics as topics_mod
+from ..network.api import BeaconApiServer
+from ..network.libp2p import Libp2pHost
+from ..utils.logging import get_logger
+from .chain import BeaconChain
+
+log = get_logger("node")
+
+
+class BeaconNode:
+    """One beacon node over real transports.
+
+    ``genesis_state`` may be shared between nodes (same genesis = same
+    fork digest = same topics).  ``keypairs`` enables block production.
+    """
+
+    def __init__(
+        self,
+        spec: S.ChainSpec,
+        genesis_state,
+        keypairs=None,
+        fork: str = "altair",
+        http_port: int = 0,
+        tcp_port: int = 0,
+        udp_port: int | None = None,
+        store=None,
+    ):
+        self.spec = spec
+        self.fork = fork
+        self.types = types_for(spec.preset)
+        self.block_cls = self.types.SignedBeaconBlock_BY_FORK[fork]
+        self.keypairs = keypairs or []
+        # 1. chain over the (optional) store
+        self.chain = BeaconChain(spec, genesis_state.copy(), store, fork=fork)
+        self.digest = topics_mod.fork_digest(
+            spec, 0, bytes(genesis_state.genesis_validators_root)
+        )
+        self.block_topic = topics_mod.topic("beacon_block", self.digest)
+        self.attestation_topic = topics_mod.topic(
+            "beacon_aggregate_and_proof", self.digest
+        )
+        # 2. transports
+        self.host = Libp2pHost(port=tcp_port)
+        self.discovery = None
+        if udp_port is not None:
+            from ..network.discv5 import Discv5Service
+
+            self.discovery = Discv5Service(
+                key=self.host.key,
+                port=udp_port,
+                enr_extra={b"eth2": self.digest + bytes(12)},
+            )
+            # advertise the libp2p TCP port in the ENR
+            from ..network.enr import build_enr
+
+            self.discovery.enr = build_enr(
+                self.host.key,
+                seq=2,
+                ip4="127.0.0.1",
+                udp=self.discovery.port,
+                tcp=self.host.port,
+                extra={b"eth2": self.digest + bytes(12)},
+            )
+        # 3. gossip subscriptions -> chain
+        self.host.subscribe(self.block_topic, self._on_gossip_block)
+        # 4. req/resp handlers
+        self.host.rpc_handlers["status"] = self._on_status
+        self.host.rpc_handlers["ping"] = lambda req, pid: (
+            rpc_mod.SUCCESS, rpc_mod.Ping(data=1).encode(),
+        )
+        self.host.rpc_handlers["metadata"] = lambda req, pid: (
+            rpc_mod.SUCCESS,
+            rpc_mod.MetaData(seq_number=1, attnets=0, syncnets=0).encode(),
+        )
+        self.host.rpc_handlers["beacon_blocks_by_range"] = self._on_blocks_by_range
+        # 5. HTTP API
+        self.api = BeaconApiServer(self.chain, port=http_port)
+        self._dialed: set[bytes] = set()
+        self._running = False
+
+    # -- service lifecycle (builder.rs build order) ------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self.host.start()
+        if self.discovery is not None:
+            self.discovery.start()
+        self.api.start()
+        log.info(
+            "node up: tcp=%d udp=%s http=%d",
+            self.host.port,
+            getattr(self.discovery, "port", None),
+            self.api.port,
+        )
+
+    def stop(self) -> None:
+        self._running = False
+        self.api.stop()
+        if self.discovery is not None:
+            self.discovery.stop()
+        self.host.stop()
+
+    # -- discovery -> dialing ---------------------------------------------
+
+    def bootstrap(self, boot_enrs) -> None:
+        if self.discovery is None:
+            raise RuntimeError("node built without discovery")
+        self.discovery.bootstrap(boot_enrs)
+
+    def discover_and_dial(self) -> int:
+        """One discovery round: lookup, dial every new peer advertising
+        our fork digest and a TCP port (subnet_predicate analog)."""
+        if self.discovery is None:
+            return 0
+        found = self.discovery.lookup()
+        dialed = 0
+        for rec in found:
+            eth2 = rec.kv.get(b"eth2")
+            tcp = rec.tcp_port
+            if eth2 is None or eth2[:4] != self.digest or tcp is None:
+                continue
+            nid = rec.node_id
+            if nid in self._dialed:
+                continue
+            try:
+                conn = self.host.dial(rec.ip4 or "127.0.0.1", tcp)
+                self._dialed.add(nid)
+                dialed += 1
+                self._status_handshake(conn)
+            except Exception as exc:  # noqa: BLE001
+                log.debug("dial %s failed: %s", nid.hex()[:8], exc)
+        return dialed
+
+    # -- status / sync -----------------------------------------------------
+
+    def _local_status(self) -> rpc_mod.StatusMessage:
+        head = self.chain.head_state()
+        return rpc_mod.StatusMessage(
+            fork_digest=self.digest,
+            finalized_root=bytes(32),
+            finalized_epoch=int(head.finalized_checkpoint.epoch),
+            head_root=self.chain.head_root,
+            head_slot=int(head.slot),
+        )
+
+    def _on_status(self, req: bytes, peer_id):
+        their = rpc_mod.StatusMessage.deserialize_value(req)
+        if bytes(their.fork_digest) != self.digest:
+            return rpc_mod.INVALID_REQUEST, b""
+        return rpc_mod.SUCCESS, self._local_status().encode()
+
+    def _status_handshake(self, conn) -> None:
+        code, resp = conn.request("status", self._local_status().encode())
+        if code != rpc_mod.SUCCESS:
+            return
+        their = rpc_mod.StatusMessage.deserialize_value(resp)
+        if their.head_slot > self.chain.head_state().slot:
+            self._range_sync(conn, int(their.head_slot))
+
+    def _range_sync(self, conn, target_slot: int, batch: int = 16) -> None:
+        """Catch up over the wire: BlocksByRange in batches, importing in
+        order (sync/range_sync semantics, single-peer degenerate case)."""
+        while self._running:
+            start = int(self.chain.head_state().slot) + 1
+            if start > target_slot:
+                return
+            req = rpc_mod.BlocksByRangeRequest(
+                start_slot=start,
+                count=min(batch, target_slot - start + 1),
+                step=1,
+            )
+            chunks = conn.request_multi(
+                "beacon_blocks_by_range", req.encode(), timeout=15.0
+            )
+            imported = 0
+            for code, ssz in chunks:
+                if code != rpc_mod.SUCCESS:
+                    continue
+                block = self.block_cls.deserialize_value(ssz)
+                try:
+                    self.chain.process_block(block)
+                    imported += 1
+                except Exception as exc:  # noqa: BLE001
+                    log.debug("range-sync import: %s", exc)
+            if imported == 0:
+                return  # peer has nothing more for us (or all invalid)
+
+    def _on_blocks_by_range(self, req: bytes, peer_id):
+        """Serve from the canonical chain, one coded chunk per block
+        (sync.serve_blocks_by_range walks the store)."""
+        from .sync import serve_blocks_by_range
+
+        r = rpc_mod.BlocksByRangeRequest.deserialize_value(req)
+        chunks = serve_blocks_by_range(self.chain, self.fork)(
+            int(r.start_slot), min(int(r.count), 64)
+        )
+        return rpc_mod.RAW_CHUNKS, b"".join(chunks)
+
+    # -- gossip ------------------------------------------------------------
+
+    def _on_gossip_block(self, payload: bytes, peer_id) -> str:
+        try:
+            block = self.block_cls.deserialize_value(payload)
+        except Exception:  # noqa: BLE001
+            return "reject"
+        try:
+            self.chain.process_block(block)
+            return "accept"
+        except Exception as exc:  # noqa: BLE001
+            log.debug("gossip block rejected: %s", exc)
+            return "ignore"  # could be early/unknown-parent: don't penalize
+
+    def publish_block(self, signed_block) -> None:
+        self.host.publish(self.block_topic, signed_block.encode())
+
+    # -- production (auto-propose dev mode) --------------------------------
+
+    def produce_and_publish(self, slot: int):
+        block = self.chain.produce_block(slot, self.keypairs)
+        self.chain.process_block(block)
+        self.publish_block(block)
+        return block
+
+
+
+def interop_node(n_validators: int = 16, **kwargs) -> tuple[BeaconNode, list]:
+    """Dev node on a minimal-preset interop genesis (ClientGenesis::Interop)."""
+    from ..consensus.testing import phase0_spec
+
+    spec = kwargs.pop("spec", None) or phase0_spec(S.MINIMAL)
+    state, keypairs = interop_state(n_validators, spec, fork="altair")
+    node = BeaconNode(spec, state, keypairs=keypairs, **kwargs)
+    return node, keypairs

@@ -405,6 +405,15 @@ def fp_pow(a: LFp, e: int) -> LFp:
         return one_like(a)
     if a.bound > 4.0:
         a = fp_reduce(a)
+    # chunked in-kernel chains only on real TPU: big exponents in
+    # interpret mode would unroll to an untractable CPU graph
+    if pallas_enabled() and e > 3 and jax.default_backend() == "tpu":
+        from . import pallas_fp
+
+        batch = a.limbs.shape[1:]
+        flat = pallas_fp.pow_chain_limbs(a.limbs.reshape(N, -1), e)
+        fixp = MAX_MUL_PRODUCT / 625.0 + 1.1
+        return LFp(flat.reshape((N,) + batch), fixp)
     bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=U32)
     # stabilize the carried bound: sqr of <=4.3 would grow, so pin to the
     # fixpoint bound of mont outputs

@@ -63,6 +63,26 @@ def _stable(a):
 def fp2_pow_static(a, bits: list[int]):
     """a^e for a static exponent (MSB-first bits), batched."""
     a = _stable(a)
+    # real TPU: chunked in-kernel Fp2 square-and-multiply (pallas_fp) —
+    # the sqrt/cofactor chains drop from ~1 XLA dispatch per bit to one
+    # kernel per 8 bits
+    import jax as _jax
+
+    if F.pallas_enabled() and bits[0] == 1 and len(bits) > 4 \
+            and _jax.default_backend() == "tpu":
+        from . import pallas_fp as PF
+
+        bshape = F.batch_shape(a[0])
+        r0, r1 = PF.fp2_pow_chain(
+            a[0].limbs.reshape(F.N, -1),
+            a[1].limbs.reshape(F.N, -1),
+            tuple(bits),
+        )
+        out = (
+            F.LFp(r0.reshape((F.N,) + bshape), 6.0),
+            F.LFp(r1.reshape((F.N,) + bshape), 6.0),
+        )
+        return _stable(out)
     bit_arr = jnp.array(bits, dtype=jnp.uint32)
 
     def step(acc, bit):

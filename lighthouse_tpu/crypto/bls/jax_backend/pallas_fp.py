@@ -72,12 +72,8 @@ def _wide_product(a, b):
     return _compress1(_compress1(acc))
 
 
-def _mont_kernel(a_ref, b_ref, p_ref, pp_ref, o_ref):
-    a = a_ref[:]
-    b = b_ref[:]
-    pl_ = p_ref[:]
-    pp = pp_ref[:]
-
+def _mont_core(a, b, pl_, pp):
+    """One full Montgomery product on in-kernel values -> strict limbs."""
     t = _wide_product(a, b)  # a*b
     # (t * P') mod 2^390: the low half of the full product (columns < 26
     # of the wide product are exactly the low product's columns)
@@ -94,7 +90,32 @@ def _mont_kernel(a_ref, b_ref, p_ref, pp_ref, o_ref):
         carry = tcol >> 15
         if k >= 26:
             out_rows.append(tcol & MASK)
-    o_ref[:] = jnp.stack(out_rows, axis=0)
+    return jnp.stack(out_rows, axis=0)
+
+
+def _mont_kernel(a_ref, b_ref, p_ref, pp_ref, o_ref):
+    o_ref[:] = _mont_core(a_ref[:], b_ref[:], p_ref[:], pp_ref[:])
+
+
+def _make_chain_kernel(pattern: tuple[bool, ...]):
+    """Square-and-multiply segment: for each bit, acc = acc²; if bit,
+    acc = acc·base — the WHOLE segment one kernel, state in VMEM.
+    Replaces per-bit pallas calls in fixed-exponent chains (Fermat
+    inversion for affinization), cutting call count by the segment
+    length."""
+
+    def kernel(acc_ref, base_ref, p_ref, pp_ref, o_ref):
+        acc = acc_ref[:]
+        base = base_ref[:]
+        pl_ = p_ref[:]
+        pp = pp_ref[:]
+        for mul_bit in pattern:
+            acc = _mont_core(acc, acc, pl_, pp)
+            if mul_bit:
+                acc = _mont_core(acc, base, pl_, pp)
+        o_ref[:] = acc
+
+    return kernel
 
 
 @functools.lru_cache(maxsize=64)
@@ -115,6 +136,164 @@ def _mont_call(n_padded: int, tile: int, interpret: bool):
         out_specs=spec,
         interpret=interpret,
     )
+
+
+_BIAS2_COLS = np.asarray(F._biased_kp(2)).astype(np.uint32).reshape(26, 1)
+_BIAS16_COLS = np.asarray(F._biased_kp(16)).astype(np.uint32).reshape(26, 1)
+
+
+def _sub_biased(a, b, bias):
+    """Value a - b + k·P, limb-safe when every bias limb >= b's quasi
+    limbs (fp._biased_kp boosts all non-top limbs past QMAX) and k
+    exceeds b's value bound (top-limb non-negativity)."""
+    return _compress1((a + bias) - b)
+
+
+def _fp2_sqr_core(a0, a1, pl_, pp, b16):
+    """(a0 + a1·u)²: real (a0+a1)(a0-a1), imag 2·a0·a1 (u² = -1).
+    Worst-case input is post-mul (a0 <= ~3.2P, a1 <= ~5.2P): the k=16
+    bias covers the subtrahend; outputs re-normalize to (<=1.4P, <=2.4P)."""
+    s = _compress1(a0 + a1)
+    d = _sub_biased(a0, a1, b16)
+    r0 = _mont_core(s, d, pl_, pp)
+    t = _mont_core(a0, a1, pl_, pp)
+    return r0, _compress1(t + t)
+
+
+def _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2):
+    """Karatsuba: v0 - v1 + (cross - v0 - v1)·u.  The v's are Montgomery
+    outputs (< 1.2P), so k=2 biases suffice; outputs stay <= (3.2P, 5.2P)
+    — inside the square's envelope above."""
+    v0 = _mont_core(a0, b0, pl_, pp)
+    v1 = _mont_core(a1, b1, pl_, pp)
+    m = _mont_core(_compress1(a0 + a1), _compress1(b0 + b1), pl_, pp)
+    r0 = _sub_biased(v0, v1, b2)
+    r1 = _sub_biased(_sub_biased(m, v0, b2), v1, b2)
+    return r0, r1
+
+
+def _make_fp2_chain_kernel(pattern: tuple[bool, ...]):
+    """Fp2 square-and-multiply segment in one kernel (the h2c sqrt /
+    cofactor chains: fp2_pow_static's per-bit scan dispatched stacked XLA
+    ops per bit; here a whole segment keeps both coordinates in VMEM)."""
+
+    def kernel(a0_ref, a1_ref, b0_ref, b1_ref, p_ref, pp_ref, b16_ref,
+               b2_ref, o0_ref, o1_ref):
+        a0, a1 = a0_ref[:], a1_ref[:]
+        b0, b1 = b0_ref[:], b1_ref[:]
+        pl_, pp = p_ref[:], pp_ref[:]
+        b16, b2 = b16_ref[:], b2_ref[:]
+        for mul_bit in pattern:
+            a0, a1 = _fp2_sqr_core(a0, a1, pl_, pp, b16)
+            if mul_bit:
+                a0, a1 = _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2)
+        o0_ref[:] = a0
+        o1_ref[:] = a1
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=256)
+def _fp2_chain_call(n_padded: int, tile: int, pattern: tuple,
+                    interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (n_padded // tile,)
+    spec = pl.BlockSpec((26, tile), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+    const_spec = pl.BlockSpec((26, tile), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((26, n_padded), jnp.uint32)
+    return pl.pallas_call(
+        _make_fp2_chain_kernel(pattern),
+        out_shape=(out_shape, out_shape),
+        grid=grid,
+        in_specs=[spec, spec, spec, spec, const_spec, const_spec,
+                  const_spec, const_spec],
+        out_specs=(spec, spec),
+        interpret=interpret,
+    )
+
+
+def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
+                  chunk: int = 8, interpret: bool = False):
+    """(a0 + a1·u)^e for static MSB-first bits (leading bit must be 1);
+    inputs reduced (bound <= 2).  Returns raw limb pair; value bounds on
+    exit are <= ~18P (callers re-reduce)."""
+    assert bits and bits[0] == 1
+    n = a0_limbs.shape[-1]
+    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    n_padded = -(-n // tile) * tile
+    if n_padded != n:
+        pad = ((0, 0), (0, n_padded - n))
+        a0_limbs = jnp.pad(a0_limbs, pad)
+        a1_limbs = jnp.pad(a1_limbs, pad)
+    consts = [
+        jnp.broadcast_to(jnp.asarray(c, dtype=jnp.uint32), (26, tile))
+        for c in (_P_COLS, _PP_COLS, _BIAS16_COLS, _BIAS2_COLS)
+    ]
+    acc0, acc1 = a0_limbs, a1_limbs
+    rest = [bool(b) for b in bits[1:]]
+    for off in range(0, len(rest), chunk):
+        pattern = tuple(rest[off : off + chunk])
+        acc0, acc1 = _fp2_chain_call(n_padded, tile, pattern, interpret)(
+            acc0, acc1, a0_limbs, a1_limbs, *consts
+        )
+    if n_padded != n:
+        return acc0[:, :n], acc1[:, :n]
+    return acc0, acc1
+
+
+@functools.lru_cache(maxsize=256)
+def _chain_call(n_padded: int, tile: int, pattern: tuple, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (n_padded // tile,)
+    spec = pl.BlockSpec((26, tile), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+    const_spec = pl.BlockSpec((26, tile), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _make_chain_kernel(pattern),
+        out_shape=jax.ShapeDtypeStruct((26, n_padded), jnp.uint32),
+        grid=grid,
+        in_specs=[spec, spec, const_spec, const_spec],
+        out_specs=spec,
+        interpret=interpret,
+    )
+
+
+CHAIN_CHUNK = 16  # square-and-multiply bits per kernel (compile-size knob)
+
+
+def pow_chain_limbs(base_limbs, exponent: int, interpret: bool = False):
+    """base^exponent (Montgomery domain) via chunked in-kernel chains.
+    base must be strict/quasi limbs of a value bounded < 4.3P (mont
+    outputs and reduced values qualify: every in-kernel product is then
+    strict×strict, far under the bound-product ceiling)."""
+    bits = [c == "1" for c in bin(exponent)[2:]]
+    n = base_limbs.shape[-1]
+    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    n_padded = -(-n // tile) * tile
+    if n_padded != n:
+        base_limbs = jnp.pad(base_limbs, ((0, 0), (0, n_padded - n)))
+    p_tile = jnp.broadcast_to(
+        jnp.asarray(_P_COLS, dtype=jnp.uint32), (26, tile)
+    )
+    pp_tile = jnp.broadcast_to(
+        jnp.asarray(_PP_COLS, dtype=jnp.uint32), (26, tile)
+    )
+    # first bit is always 1: start acc = base (skips one square+mul)
+    acc = base_limbs
+    rest = bits[1:]
+    for off in range(0, len(rest), CHAIN_CHUNK):
+        pattern = tuple(rest[off : off + CHAIN_CHUNK])
+        acc = _chain_call(n_padded, tile, pattern, interpret)(
+            acc, base_limbs, p_tile, pp_tile
+        )
+    return acc[:, :n] if n_padded != n else acc
 
 
 def mont_mul_limbs(a_limbs, b_limbs, interpret: bool = False):

@@ -221,6 +221,8 @@ class MessageCache:
         self.msgs: dict[bytes, tuple[str, bytes]] = {}
 
     def put(self, mid: bytes, topic: str, data: bytes) -> None:
+        if mid in self.msgs:
+            return  # re-publish: the earlier window entry must stay unique
         self.windows[0].append(mid)
         self.msgs[mid] = (topic, data)
 
@@ -289,9 +291,10 @@ class Connection:
 
     # -- req/resp ----------------------------------------------------------
 
-    def request(self, name: str, payload_ssz: bytes,
-                timeout: float = 5.0) -> tuple[int, bytes]:
-        """One shot request: returns (result_code, response_ssz)."""
+    def _request_raw(self, name: str, payload_ssz: bytes,
+                     timeout: float) -> bytes:
+        """Stream choreography shared by single- and multi-chunk requests:
+        open, negotiate, write, FIN, read to EOF."""
         st = self.muxer.open_stream()
         reader = _MsgReader(lambda n: st.read(n, timeout=timeout))
         ms_negotiate_out(st.write, reader, rpc_mod.protocol_id(name))
@@ -300,7 +303,22 @@ class Connection:
         body = st.read_until_eof(timeout=timeout)
         if not body:
             raise Libp2pError(f"empty response to {name}")
-        return rpc_mod.decode_response_chunk(body)
+        return body
+
+    def request(self, name: str, payload_ssz: bytes,
+                timeout: float = 5.0) -> tuple[int, bytes]:
+        """One shot request: returns (result_code, response_ssz)."""
+        return rpc_mod.decode_response_chunk(
+            self._request_raw(name, payload_ssz, timeout)
+        )
+
+    def request_multi(self, name: str, payload_ssz: bytes,
+                      timeout: float = 10.0) -> list[tuple[int, bytes]]:
+        """Streamed request (BlocksByRange shape): every coded chunk on
+        the stream, in order."""
+        return rpc_mod.decode_response_chunks(
+            self._request_raw(name, payload_ssz, timeout)
+        )
 
     def close(self) -> None:
         self.alive = False
@@ -350,6 +368,7 @@ class Libp2pHost:
         self.received: list[tuple[str, bytes]] = []
         self.rate_limiter = rpc_mod.RateLimiter()
         self.mesh: dict[str, set[bytes]] = {}  # topic -> mesh peer ids
+        self._mesh_lock = threading.Lock()  # heartbeat/reader/publisher
         self.mcache = MessageCache()
         self._heartbeat_enabled = heartbeat
         self._running = False
@@ -385,29 +404,35 @@ class Libp2pHost:
         import random as _random
 
         for topic in list(self.subscriptions):
-            mesh = self.mesh.setdefault(topic, set())
-            subscribed = [
-                pid for pid, c in self.connections.items()
-                if topic in c.topics and c.alive
-            ]
-            mesh.intersection_update(subscribed)
-            # grow toward D when below D_LO
-            if len(mesh) < self.D_LO:
-                candidates = [p for p in subscribed if p not in mesh]
-                _random.shuffle(candidates)
-                for pid in candidates[: self.D - len(mesh)]:
-                    mesh.add(pid)
-                    self._send_control(pid, GossipControl(graft=[topic]))
-            # shrink toward D when above D_HI
-            elif len(mesh) > self.D_HI:
-                excess = _random.sample(sorted(mesh), len(mesh) - self.D)
-                for pid in excess:
-                    mesh.discard(pid)
-                    self._send_control(pid, GossipControl(prune=[topic]))
+            grafts, prunes = [], []
+            with self._mesh_lock:
+                mesh = self.mesh.setdefault(topic, set())
+                subscribed = [
+                    pid for pid, c in self.connections.items()
+                    if topic in c.topics and c.alive
+                ]
+                mesh.intersection_update(subscribed)
+                # grow toward D when below D_LO
+                if len(mesh) < self.D_LO:
+                    candidates = [p for p in subscribed if p not in mesh]
+                    _random.shuffle(candidates)
+                    for pid in candidates[: self.D - len(mesh)]:
+                        mesh.add(pid)
+                        grafts.append(pid)
+                # shrink toward D when above D_HI
+                elif len(mesh) > self.D_HI:
+                    for pid in _random.sample(sorted(mesh),
+                                              len(mesh) - self.D):
+                        mesh.discard(pid)
+                        prunes.append(pid)
+                lazy = [p for p in subscribed if p not in mesh]
+            for pid in grafts:  # sends outside the lock
+                self._send_control(pid, GossipControl(graft=[topic]))
+            for pid in prunes:
+                self._send_control(pid, GossipControl(prune=[topic]))
             # IHAVE gossip to a sample of non-mesh subscribers
             mids = self.mcache.recent_ids(topic)
             if mids:
-                lazy = [p for p in subscribed if p not in mesh]
                 _random.shuffle(lazy)
                 for pid in lazy[: self.D_LAZY]:
                     self._send_control(
@@ -529,8 +554,9 @@ class Libp2pHost:
         conn.alive = False
         if self.connections.get(conn.peer_id) is conn:
             del self.connections[conn.peer_id]
-        for mesh in self.mesh.values():
-            mesh.discard(conn.peer_id)  # stale mesh entries eat publishes
+        with self._mesh_lock:
+            for mesh in self.mesh.values():
+                mesh.discard(conn.peer_id)  # stale entries eat publishes
         info = self.peer_manager.peers.get(conn.peer_id.hex())
         if info is not None:
             info.connected = False
@@ -609,12 +635,14 @@ class Libp2pHost:
         IWANT served from the mcache."""
         for topic in ctl.graft:
             if topic in self.subscriptions:
-                self.mesh.setdefault(topic, set()).add(conn.peer_id)
+                with self._mesh_lock:
+                    self.mesh.setdefault(topic, set()).add(conn.peer_id)
             else:
                 # not subscribed: refuse the graft (spec: prune back)
                 self._send_control(conn.peer_id, GossipControl(prune=[topic]))
         for topic in ctl.prune:
-            self.mesh.get(topic, set()).discard(conn.peer_id)
+            with self._mesh_lock:
+                self.mesh.get(topic, set()).discard(conn.peer_id)
         wanted = []
         for topic, mids in ctl.ihave:
             if topic not in self.subscriptions:
@@ -626,7 +654,8 @@ class Libp2pHost:
             # retransmission bound (gossip_retransmission analog): IWANT
             # floods re-serve full messages — rate limit per peer
             if not self.rate_limiter.allow(
-                conn.peer_id.hex(), "gossip_iwant", cost=float(len(ctl.iwant))
+                conn.peer_id.hex(), "gossip_iwant",
+                cost=float(min(len(ctl.iwant), 64)),  # the actual serve cost
             ):
                 self.peer_manager.report(
                     conn.peer_id.hex(), -1.0, "iwant flood"
@@ -649,7 +678,12 @@ class Libp2pHost:
             return
         request = rpc_mod.decode_request(body) if body else b""
         code, resp = self.rpc_handlers[name](request, conn.peer_id)
-        st.write(rpc_mod.encode_response_chunk(code, resp))
+        if code == rpc_mod.RAW_CHUNKS:
+            # handler returned pre-encoded coded chunks (multi-chunk
+            # responses: one chunk per block on the same stream)
+            st.write(resp)
+        else:
+            st.write(rpc_mod.encode_response_chunk(code, resp))
         st.close()
 
     # -- public API --------------------------------------------------------
@@ -676,7 +710,8 @@ class Libp2pHost:
         live = {
             pid for pid, c in self.connections.items() if c.alive
         }
-        mesh = (self.mesh.get(topic) or set()) & live
+        with self._mesh_lock:
+            mesh = set(self.mesh.get(topic) or ()) & live
         for conn in list(self.connections.values()):
             if not conn.alive:
                 self._drop_connection(conn)

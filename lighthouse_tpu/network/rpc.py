@@ -82,6 +82,8 @@ def protocol_id(name: str) -> str:
 
 # result codes (RPCCodedResponse)
 SUCCESS = 0
+# handler-side sentinel: response is already a stream of coded chunks
+RAW_CHUNKS = -1
 INVALID_REQUEST = 1
 SERVER_ERROR = 2
 RESOURCE_UNAVAILABLE = 3
@@ -129,6 +131,20 @@ def decode_response_chunk(data: bytes) -> tuple[int, bytes]:
     if len(out) != want:
         raise ValueError("response length mismatch")
     return result, out
+
+
+def decode_response_chunks(data: bytes) -> list[tuple[int, bytes]]:
+    """Split a stream of back-to-back coded chunks (the multi-block
+    BlocksByRange response shape: one <code><len><framed-snappy> per
+    block on a single stream)."""
+    out, pos = [], 0
+    while pos < len(data):
+        code = data[pos]
+        want, p2 = _read_uvarint(data, pos + 1)
+        payload, consumed = snappy.decompress_framed_prefix(data[p2:], want)
+        out.append((code, payload))
+        pos = p2 + consumed
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -218,6 +218,49 @@ def compress_framed(data: bytes) -> bytes:
     return bytes(out)
 
 
+def decompress_framed_prefix(data: bytes, want: int) -> tuple[bytes, int]:
+    """Decompress until ``want`` output bytes, returning (output, bytes
+    CONSUMED from data) — the incremental reader for back-to-back
+    ssz_snappy response chunks sharing one stream."""
+    pos, out = 0, bytearray()
+    seen_header = False
+    data_frames = 0
+    while pos < len(data):
+        if pos + 4 > len(data):
+            raise SnappyError("truncated chunk header")
+        ctype = data[pos]
+        length = int.from_bytes(data[pos + 1 : pos + 4], "little")
+        body = data[pos + 4 : pos + 4 + length]
+        if len(body) != length:
+            raise SnappyError("truncated chunk body")
+        pos += 4 + length
+        if ctype == 0xFF:
+            if body != STREAM_IDENTIFIER[4:]:
+                raise SnappyError("bad stream identifier")
+            seen_header = True
+            continue
+        if not seen_header:
+            raise SnappyError("chunk before stream identifier")
+        if ctype in (0x00, 0x01):
+            if len(body) < 4:
+                raise SnappyError("chunk body shorter than its CRC")
+            crc = struct.unpack("<I", body[:4])[0]
+            chunk = decompress_block(body[4:]) if ctype == 0x00 else body[4:]
+            if _masked_crc(chunk) != crc:
+                raise SnappyError("chunk CRC mismatch")
+            out += chunk
+            data_frames += 1
+            if len(out) >= want and data_frames >= 1:
+                break  # next bytes belong to the following coded chunk
+        elif 0x80 <= ctype <= 0xFD:
+            continue
+        else:
+            raise SnappyError(f"unskippable unknown chunk type {ctype:#x}")
+    if len(out) < want:
+        raise SnappyError(f"stream ended at {len(out)}/{want} bytes")
+    return bytes(out[:want]), pos
+
+
 def decompress_framed(data: bytes) -> bytes:
     pos, out = 0, bytearray()
     seen_header = False

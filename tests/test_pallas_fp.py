@@ -50,6 +50,36 @@ def test_lazy_inputs_match():
         )
 
 
+@pytest.mark.parametrize("e", [5, 13, 21, 0b110101])
+def test_pow_chain_small_exponents(e):
+    """Chunked in-kernel square-and-multiply == standard-domain pow
+    (interpret mode; big exponents run only on real TPU — fp.fp_pow
+    gates on default_backend)."""
+    a = _rand_lfp(3)
+    got = PF.pow_chain_limbs(a.limbs, e, interpret=True)
+    a_std = F.decode_mont(a)
+    got_std = F.decode_mont(F.LFp(got, 2.0))
+    assert got_std == [pow(x, e, F.P_INT) for x in a_std]
+
+
+@pytest.mark.parametrize("e", [13, 37])
+def test_fp2_pow_chain_small_exponents(e):
+    """In-kernel Fp2 square-and-multiply == the Fp2 oracle."""
+    from lighthouse_tpu.crypto.bls.fields import Fp2
+
+    c0s = [rng.randrange(F.P_INT) for _ in range(2)]
+    c1s = [rng.randrange(F.P_INT) for _ in range(2)]
+    a0 = jnp.asarray(F.ints_to_limbs([x * F.R_INT % F.P_INT for x in c0s]))
+    a1 = jnp.asarray(F.ints_to_limbs([x * F.R_INT % F.P_INT for x in c1s]))
+    bits = tuple(int(c) for c in bin(e)[2:])
+    r0, r1 = PF.fp2_pow_chain(a0, a1, bits, interpret=True)
+    got0 = F.decode_mont(F.LFp(r0, 6.0))
+    got1 = F.decode_mont(F.LFp(r1, 6.0))
+    for j in range(2):
+        want = Fp2(c0s[j], c1s[j]).pow(e)
+        assert (got0[j] % F.P_INT, got1[j] % F.P_INT) == (want.c0, want.c1)
+
+
 def test_flag_routes_mont_mul():
     """set_pallas(True) must route fp.mont_mul through the kernel and
     preserve values + bound bookkeeping."""
