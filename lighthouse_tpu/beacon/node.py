@@ -146,9 +146,11 @@ class BeaconNode:
                 continue
             try:
                 conn = self.host.dial(rec.ip4 or "127.0.0.1", tcp)
-                self._dialed.add(nid)
                 dialed += 1
                 self._status_handshake(conn)
+                # only a COMPLETED handshake excludes the peer from
+                # future rounds; transient failures stay retryable
+                self._dialed.add(nid)
             except Exception as exc:  # noqa: BLE001
                 log.debug("dial %s failed: %s", nid.hex()[:8], exc)
         return dialed

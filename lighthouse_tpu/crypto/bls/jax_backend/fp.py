@@ -335,6 +335,29 @@ def set_pallas(enabled: bool) -> None:
     _PALLAS_MODE = enabled
 
 
+_CHAINS_MODE: bool | None = None
+
+
+def chains_enabled() -> bool:
+    """LIGHTHOUSE_TPU_CHAINS=1 routes static-exponent chains through the
+    chunked Pallas chain kernels (interpret-proven; flips to default-on
+    once measured on hardware)."""
+    global _CHAINS_MODE
+    if _CHAINS_MODE is None:
+        import os
+
+        _CHAINS_MODE = os.environ.get("LIGHTHOUSE_TPU_CHAINS", "") == "1"
+    return _CHAINS_MODE
+
+
+def chains_active() -> bool:
+    """The ONE gate for chain-kernel routing (fp_pow, h2c fp2 chains):
+    pallas on + chains opted in + a real TPU backend."""
+    return (
+        pallas_enabled() and chains_enabled() and jax.default_backend() == "tpu"
+    )
+
+
 def mont_mul(a: LFp, b: LFp) -> LFp:
     """Montgomery product a*b*R^-1 mod P (strict limbs out)."""
     prod = a.bound * b.bound
@@ -405,9 +428,11 @@ def fp_pow(a: LFp, e: int) -> LFp:
         return one_like(a)
     if a.bound > 4.0:
         a = fp_reduce(a)
-    # chunked in-kernel chains only on real TPU: big exponents in
-    # interpret mode would unroll to an untractable CPU graph
-    if pallas_enabled() and e > 3 and jax.default_backend() == "tpu":
+    # chunked in-kernel chains only on real TPU, and only opt-in until
+    # validated on hardware (the relay wedged before the A/B completed;
+    # the mont_mul kernel is hardware-proven, the chain variants are
+    # interpret-proven): LIGHTHOUSE_TPU_CHAINS=1
+    if e > 3 and chains_active():
         from . import pallas_fp
 
         batch = a.limbs.shape[1:]

@@ -66,10 +66,7 @@ def fp2_pow_static(a, bits: list[int]):
     # real TPU: chunked in-kernel Fp2 square-and-multiply (pallas_fp) —
     # the sqrt/cofactor chains drop from ~1 XLA dispatch per bit to one
     # kernel per 8 bits
-    import jax as _jax
-
-    if F.pallas_enabled() and bits[0] == 1 and len(bits) > 4 \
-            and _jax.default_backend() == "tpu":
+    if F.chains_active() and bits[0] == 1 and len(bits) > 4:
         from . import pallas_fp as PF
 
         bshape = F.batch_shape(a[0])

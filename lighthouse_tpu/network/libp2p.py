@@ -300,22 +300,21 @@ class Connection:
         ms_negotiate_out(st.write, reader, rpc_mod.protocol_id(name))
         st.write(rpc_mod.encode_request(payload_ssz))
         st.close()  # FIN: request fully written
-        body = st.read_until_eof(timeout=timeout)
-        if not body:
-            raise Libp2pError(f"empty response to {name}")
-        return body
+        return st.read_until_eof(timeout=timeout)
 
     def request(self, name: str, payload_ssz: bytes,
                 timeout: float = 5.0) -> tuple[int, bytes]:
         """One shot request: returns (result_code, response_ssz)."""
-        return rpc_mod.decode_response_chunk(
-            self._request_raw(name, payload_ssz, timeout)
-        )
+        body = self._request_raw(name, payload_ssz, timeout)
+        if not body:
+            raise Libp2pError(f"empty response to {name}")
+        return rpc_mod.decode_response_chunk(body)
 
     def request_multi(self, name: str, payload_ssz: bytes,
                       timeout: float = 10.0) -> list[tuple[int, bytes]]:
         """Streamed request (BlocksByRange shape): every coded chunk on
-        the stream, in order."""
+        the stream, in order.  An EMPTY stream is a valid response (all
+        requested slots skipped / unknown) -> []."""
         return rpc_mod.decode_response_chunks(
             self._request_raw(name, payload_ssz, timeout)
         )

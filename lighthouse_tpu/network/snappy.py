@@ -251,8 +251,12 @@ def decompress_framed_prefix(data: bytes, want: int) -> tuple[bytes, int]:
             out += chunk
             data_frames += 1
             if len(out) >= want and data_frames >= 1:
-                break  # next bytes belong to the following coded chunk
-        elif 0x80 <= ctype <= 0xFD:
+                # Stop at the payload boundary, like the reference's
+                # streaming readers that read exactly `want` decompressed
+                # bytes per chunk; trailing skippable frames would belong
+                # to the NEXT coded chunk's parse.
+                break
+        elif 0x80 <= ctype <= 0xFE:  # skippable (0xFE = padding)
             continue
         else:
             raise SnappyError(f"unskippable unknown chunk type {ctype:#x}")
