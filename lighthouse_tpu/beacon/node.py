@@ -85,6 +85,7 @@ class BeaconNode:
             )
         # 3. gossip subscriptions -> chain
         self.host.subscribe(self.block_topic, self._on_gossip_block)
+        self.host.subscribe(self.attestation_topic, self._on_gossip_aggregate)
         # 4. req/resp handlers
         self.host.rpc_handlers["status"] = self._on_status
         self.host.rpc_handlers["ping"] = lambda req, pid: (
@@ -98,6 +99,11 @@ class BeaconNode:
         # 5. HTTP API
         self.api = BeaconApiServer(self.chain, port=http_port)
         self._dialed: set[bytes] = set()
+        # chain.py is single-writer by design (the beacon_processor's
+        # worker model); with gossip threads + the slot timer feeding one
+        # chain, this lock IS that single writer.
+        self._chain_lock = threading.Lock()
+        self.slot_timer = None
         self._running = False
 
     # -- service lifecycle (builder.rs build order) ------------------------
@@ -117,6 +123,8 @@ class BeaconNode:
 
     def stop(self) -> None:
         self._running = False
+        if self.slot_timer is not None:
+            self.slot_timer.stop()
         self.api.stop()
         if self.discovery is not None:
             self.discovery.stop()
@@ -144,6 +152,7 @@ class BeaconNode:
             nid = rec.node_id
             if nid in self._dialed:
                 continue
+            conn = None
             try:
                 conn = self.host.dial(rec.ip4 or "127.0.0.1", tcp)
                 dialed += 1
@@ -153,6 +162,9 @@ class BeaconNode:
                 self._dialed.add(nid)
             except Exception as exc:  # noqa: BLE001
                 log.debug("dial %s failed: %s", nid.hex()[:8], exc)
+                if conn is not None:
+                    # don't leak the socket/pump thread while retryable
+                    self.host._drop_connection(conn)
         return dialed
 
     # -- status / sync -----------------------------------------------------
@@ -202,7 +214,8 @@ class BeaconNode:
                     continue
                 block = self.block_cls.deserialize_value(ssz)
                 try:
-                    self.chain.process_block(block)
+                    with self._chain_lock:
+                        self.chain.process_block(block)
                     imported += 1
                 except Exception as exc:  # noqa: BLE001
                     log.debug("range-sync import: %s", exc)
@@ -220,6 +233,30 @@ class BeaconNode:
         )
         return rpc_mod.RAW_CHUNKS, b"".join(chunks)
 
+    # -- slot timer (beacon_node/timer analog) -----------------------------
+
+    def start_slot_timer(self, clock, auto_propose: bool = False):
+        """Per-slot service: head recompute each tick (timer/src/lib.rs),
+        optional interop block production."""
+        from ..utils.slot_clock import SlotTimer
+
+        def on_slot(slot: int) -> None:
+            with self._chain_lock:  # atomic check-then-produce
+                if auto_propose and self.keypairs and slot > int(
+                    self.chain.head_state().slot
+                ):
+                    block = self.chain.produce_block(slot, self.keypairs)
+                    self.chain.process_block(block)
+                else:
+                    block = None
+                self.chain.recompute_head()
+            if block is not None:
+                self.publish_block(block)
+
+        self.slot_timer = SlotTimer(clock, on_slot)
+        self.slot_timer.start()
+        return self.slot_timer
+
     # -- gossip ------------------------------------------------------------
 
     def _on_gossip_block(self, payload: bytes, peer_id) -> str:
@@ -228,20 +265,63 @@ class BeaconNode:
         except Exception:  # noqa: BLE001
             return "reject"
         try:
-            self.chain.process_block(block)
+            with self._chain_lock:
+                self.chain.process_block(block)
             return "accept"
         except Exception as exc:  # noqa: BLE001
             log.debug("gossip block rejected: %s", exc)
             return "ignore"  # could be early/unknown-parent: don't penalize
 
+    def _on_gossip_aggregate(self, payload: bytes, peer_id) -> str:
+        """beacon_aggregate_and_proof topic -> attestation pipeline.
+
+        Envelope verification per the gossip rules (attestation_
+        verification/batch.rs: the aggregate's THREE signature sets —
+        selection proof, outer aggregate signature, and the indexed
+        attestation, the last checked by chain.process_attestation)."""
+        from ..consensus.containers import SignedAggregateAndProof
+        from ..consensus.state_processing import signature_sets as sets
+        from ..crypto.bls import api as bls
+
+        try:
+            agg = SignedAggregateAndProof.deserialize_value(payload)
+        except Exception:  # noqa: BLE001
+            return "reject"
+        try:
+            with self._chain_lock:
+                state = self.chain.head_state()
+                envelope = [
+                    sets.selection_proof_signature_set(
+                        state, self.chain.get_pubkey,
+                        int(agg.message.aggregator_index),
+                        int(agg.message.aggregate.data.slot),
+                        bytes(agg.message.selection_proof),
+                        self.spec.preset,
+                    ),
+                    sets.aggregate_and_proof_signature_set(
+                        state, self.chain.get_pubkey, agg, self.spec.preset
+                    ),
+                ]
+                if not bls.verify_signature_sets(envelope):
+                    return "reject"
+                self.chain.process_attestation(agg.message.aggregate)
+            return "accept"
+        except Exception as exc:  # noqa: BLE001
+            log.debug("gossip aggregate dropped: %s", exc)
+            return "ignore"
+
     def publish_block(self, signed_block) -> None:
         self.host.publish(self.block_topic, signed_block.encode())
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        self.host.publish(self.attestation_topic, signed_aggregate.encode())
 
     # -- production (auto-propose dev mode) --------------------------------
 
     def produce_and_publish(self, slot: int):
-        block = self.chain.produce_block(slot, self.keypairs)
-        self.chain.process_block(block)
+        with self._chain_lock:
+            block = self.chain.produce_block(slot, self.keypairs)
+            self.chain.process_block(block)
         self.publish_block(block)
         return block
 

@@ -350,6 +350,12 @@ def chains_enabled() -> bool:
     return _CHAINS_MODE
 
 
+def set_chains(enabled: bool) -> None:
+    """In-process A/B toggle (mirrors set_pallas)."""
+    global _CHAINS_MODE
+    _CHAINS_MODE = enabled
+
+
 def chains_active() -> bool:
     """The ONE gate for chain-kernel routing (fp_pow, h2c fp2 chains):
     pallas on + chains opted in + a real TPU backend."""

@@ -47,6 +47,51 @@ class SystemTimeSlotClock(SlotClock):
         return time.time()
 
 
+class SlotTimer:
+    """Per-slot tick service — twin of beacon_node/timer (src/lib.rs, 34
+    LoC there: a task that fires a fork-choice update each slot).  Polls
+    the clock on a short interval so it works with ManualSlotClock in
+    tests and SystemTimeSlotClock in a node; fires ``on_slot(slot)`` once
+    per new slot, in its own thread."""
+
+    def __init__(self, clock: SlotClock, on_slot, poll_interval: float = 0.05):
+        import threading
+
+        self.clock = clock
+        self.on_slot = on_slot
+        self.poll_interval = poll_interval
+        self._last_fired: int | None = None
+        self._running = False
+        self._thread = threading.Thread(
+            target=self._loop, name="slot-timer", daemon=True
+        )
+
+    def start(self) -> None:
+        self._running = True
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        import logging
+        import time as _time
+
+        log = logging.getLogger("slot_timer")
+        while self._running:
+            slot = self.clock.current_slot()
+            if self._last_fired is None or slot > self._last_fired:
+                self._last_fired = slot
+                try:
+                    self.on_slot(slot)
+                except Exception:  # noqa: BLE001 — a bad tick must not
+                    # kill the timer (task_executor isolation), but a
+                    # silently dead proposer is worse than a noisy one
+                    log.exception("on_slot(%d) failed", slot)
+            _time.sleep(self.poll_interval)
+
+
 class ManualSlotClock(SlotClock):
     """Test clock advanced by hand (the reference's TestingSlotClock)."""
 

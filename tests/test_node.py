@@ -87,6 +87,113 @@ def test_interop_node_factory():
         node.stop()
 
 
+def test_aggregate_gossip_feeds_fork_choice(net):
+    """A SignedAggregateAndProof published by A lands in B's attestation
+    pipeline over the wire."""
+    import lighthouse_tpu.consensus.committees as cm
+    from lighthouse_tpu.consensus import spec as SS
+    from lighthouse_tpu.consensus.containers import (
+        AggregateAndProof,
+        Attestation,
+        AttestationData,
+        Checkpoint,
+        SignedAggregateAndProof,
+    )
+    from lighthouse_tpu.consensus.state_processing import signature_sets as sets
+    from lighthouse_tpu.crypto.bls import api as bls
+
+    boot, a, b = net
+    a.produce_and_publish(1)
+    a.bootstrap([boot.enr]); b.bootstrap([boot.enr])
+    assert b.discover_and_dial() == 1
+    time.sleep(1.2)  # mesh heartbeat
+
+    state = a.chain.head_state()
+    preset = a.spec.preset
+    cache = cm.CommitteeCache(state, 0, preset)
+    committee = cache.committee(1, 0)
+    data = AttestationData(
+        slot=1, index=0,
+        beacon_block_root=a.chain.head_root,
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(epoch=0, root=a.chain.genesis_block_root),
+    )
+    domain = sets.get_domain(
+        state.fork, bytes(state.genesis_validators_root),
+        SS.DOMAIN_BEACON_ATTESTER, 0,
+    )
+    root = SS.compute_signing_root(data, domain)
+    sigs = [a.keypairs[int(v)][0].sign(root) for v in committee]
+    att = Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=bls.AggregateSignature.aggregate(sigs).to_bytes(),
+    )
+    agg_index = int(committee[0])
+    agg_sk = a.keypairs[agg_index][0]
+    # selection proof: the aggregator signs the SLOT
+    from lighthouse_tpu.consensus.ssz import U64
+
+    sel_domain = sets.get_domain(
+        state.fork, bytes(state.genesis_validators_root),
+        SS.DOMAIN_SELECTION_PROOF, 0,
+    )
+    sel_root = sets.SigningData(
+        object_root=U64.hash_tree_root(1), domain=sel_domain
+    ).root()
+    message = AggregateAndProof(
+        aggregator_index=agg_index, aggregate=att,
+        selection_proof=agg_sk.sign(sel_root).to_bytes(),
+    )
+    agg_domain = sets.get_domain(
+        state.fork, bytes(state.genesis_validators_root),
+        SS.DOMAIN_AGGREGATE_AND_PROOF, 0,
+    )
+    agg = SignedAggregateAndProof(
+        message=message,
+        signature=agg_sk.sign(
+            SS.compute_signing_root(message, agg_domain)
+        ).to_bytes(),
+    )
+    a.publish_aggregate(agg)
+    deadline = time.time() + 10
+    while time.time() < deadline and not any(
+        t == b.attestation_topic for t, _ in b.host.received
+    ):
+        time.sleep(0.1)
+    assert any(t == b.attestation_topic for t, _ in b.host.received), (
+        "aggregate must be accepted into B's pipeline"
+    )
+    # a zeroed envelope must be REJECTED (gossip rules)
+    bad = SignedAggregateAndProof(
+        message=message, signature=b"\x00" * 96
+    )
+    assert b._on_gossip_aggregate(bad.encode(), b"peer") in ("reject", "ignore")
+
+
+def test_slot_timer_drives_production():
+    """The per-slot timer service (timer crate analog) produces and
+    publishes as a manual clock advances."""
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    node, _keys = interop_node(n_validators=8)
+    node.start()
+    clock = ManualSlotClock(genesis_time=0.0, seconds_per_slot=12)
+    try:
+        timer = node.start_slot_timer(clock, auto_propose=True)
+        for slot in (1, 2, 3):
+            clock.set_slot(slot)
+            deadline = time.time() + 5
+            while time.time() < deadline and int(
+                node.chain.head_state().slot
+            ) < slot:
+                time.sleep(0.02)
+            assert int(node.chain.head_state().slot) == slot, slot
+        timer.stop()
+    finally:
+        node.stop()
+
+
 def test_multichunk_response_codec():
     chunks = (
         rpc_mod.encode_response_chunk(rpc_mod.SUCCESS, b"one")
