@@ -96,6 +96,7 @@ class BeaconNode:
             rpc_mod.MetaData(seq_number=1, attnets=0, syncnets=0).encode(),
         )
         self.host.rpc_handlers["beacon_blocks_by_range"] = self._on_blocks_by_range
+        self.host.rpc_handlers["beacon_blocks_by_root"] = self._on_blocks_by_root
         # 5. HTTP API
         self.api = BeaconApiServer(self.chain, port=http_port)
         self._dialed: set[bytes] = set()
@@ -155,10 +156,10 @@ class BeaconNode:
             conn = None
             try:
                 conn = self.host.dial(rec.ip4 or "127.0.0.1", tcp)
-                dialed += 1
                 self._status_handshake(conn)
-                # only a COMPLETED handshake excludes the peer from
-                # future rounds; transient failures stay retryable
+                # only a COMPLETED handshake counts as a usable peer and
+                # excludes it from future rounds; failures stay retryable
+                dialed += 1
                 self._dialed.add(nid)
             except Exception as exc:  # noqa: BLE001
                 log.debug("dial %s failed: %s", nid.hex()[:8], exc)
@@ -233,6 +234,80 @@ class BeaconNode:
         )
         return rpc_mod.RAW_CHUNKS, b"".join(chunks)
 
+    def _on_blocks_by_root(self, req: bytes, peer_id):
+        """Serve specific blocks by root (rpc_methods.rs BlocksByRoot —
+        the parent-lookup server half)."""
+        from ..consensus.containers import Root
+        from ..consensus.ssz import SSZList
+
+        roots_t = SSZList(Root, 1024)
+        out = b""
+        for root in roots_t.deserialize(req)[:64]:
+            blk = self.chain.store.get_block(bytes(root), self.block_cls)
+            if blk is not None:
+                out += rpc_mod.encode_response_chunk(
+                    rpc_mod.SUCCESS, blk.encode()
+                )
+        return rpc_mod.RAW_CHUNKS, out
+
+    def _parent_lookup(self, conn, block, max_depth: int = 32,
+                       budget_secs: float = 30.0) -> bool:
+        """Unknown-parent recovery (sync/block_lookups): walk parent
+        roots backward via BlocksByRoot until an importable (or already
+        known) ancestor, then import the fetched chain forward.  Bounded
+        by depth AND wall clock — this runs on the sender's gossip lane,
+        and a withholding peer must not wedge it."""
+        import time as _time
+
+        from ..consensus.containers import Root
+        from ..consensus.ssz import SSZList
+
+        roots_t = SSZList(Root, 1024)
+        deadline = _time.monotonic() + budget_secs
+        pending = [block]
+        anchored = False
+        for _ in range(max_depth):
+            if _time.monotonic() > deadline:
+                return False
+            parent_root = bytes(pending[-1].message.parent_root)
+            chunks = conn.request_multi(
+                "beacon_blocks_by_root",
+                roots_t.serialize([parent_root]),
+                timeout=5.0,
+            )
+            got = None
+            for code, ssz in chunks:
+                if code == rpc_mod.SUCCESS:
+                    got = self.block_cls.deserialize_value(ssz)
+                    break
+            if got is None:
+                return False  # peer doesn't have the ancestor either
+            pending.append(got)
+            try:
+                with self._chain_lock:
+                    self.chain.process_block(got)
+                anchored = True
+            except Exception as exc:  # noqa: BLE001
+                if "unknown parent" in str(exc):
+                    continue  # keep walking backward
+                # anything else ("already known", a racing import): the
+                # ancestor is in the chain — replay from here
+                anchored = True
+            if anchored:
+                break
+        if not anchored:
+            return False
+        # replay the fetched descendants forward, tolerating blocks a
+        # concurrent import already landed
+        for blk in reversed(pending[:-1]):
+            try:
+                with self._chain_lock:
+                    self.chain.process_block(blk)
+            except Exception as exc:  # noqa: BLE001
+                if "unknown parent" in str(exc):
+                    return False  # replay chain broken: give up honestly
+        return True
+
     # -- slot timer (beacon_node/timer analog) -----------------------------
 
     def start_slot_timer(self, clock, auto_propose: bool = False):
@@ -269,6 +344,15 @@ class BeaconNode:
                 self.chain.process_block(block)
             return "accept"
         except Exception as exc:  # noqa: BLE001
+            if "unknown parent" in str(exc):
+                conn = self.host.connections.get(peer_id)
+                try:
+                    if conn is not None and self._parent_lookup(conn, block):
+                        # the lookup replayed the fetched chain INCLUDING
+                        # this block — it is imported now
+                        return "accept"
+                except Exception as lexc:  # noqa: BLE001
+                    log.debug("parent lookup failed: %s", lexc)
             log.debug("gossip block rejected: %s", exc)
             return "ignore"  # could be early/unknown-parent: don't penalize
 
@@ -288,6 +372,9 @@ class BeaconNode:
         except Exception:  # noqa: BLE001
             return "reject"
         try:
+            # snapshot under the lock; verify OUTSIDE it (pairings are
+            # the most expensive op in the system — they must not
+            # serialize block import / the slot timer)
             with self._chain_lock:
                 state = self.chain.head_state()
                 envelope = [
@@ -302,8 +389,9 @@ class BeaconNode:
                         state, self.chain.get_pubkey, agg, self.spec.preset
                     ),
                 ]
-                if not bls.verify_signature_sets(envelope):
-                    return "reject"
+            if not bls.verify_signature_sets(envelope):
+                return "reject"
+            with self._chain_lock:
                 self.chain.process_attestation(agg.message.aggregate)
             return "accept"
         except Exception as exc:  # noqa: BLE001

@@ -171,6 +171,31 @@ def test_aggregate_gossip_feeds_fork_choice(net):
     assert b._on_gossip_aggregate(bad.encode(), b"peer") in ("reject", "ignore")
 
 
+def test_parent_lookup_recovers_missed_blocks(net):
+    """B connects AFTER A built slots 1-2 but never status-syncs; a
+    gossiped block at slot 3 has an unknown parent, and B walks the
+    ancestry back over BlocksByRoot, then imports forward."""
+    _boot, a, b = net
+    for slot in (1, 2):
+        a.produce_and_publish(slot)
+    # direct dial WITHOUT the status handshake (so B stays at genesis)
+    conn = b.host.dial("127.0.0.1", a.host.port)
+    time.sleep(0.3)
+    assert int(b.chain.head_state().slot) == 0
+    blk3 = a.produce_and_publish(3)
+    # deliver the tip into B's gossip handler, attributed to A.  The
+    # publish above may ALSO race it over the live connection; either
+    # path must leave B converged on A's head.
+    outcome = b._on_gossip_block(blk3.encode(), a.host.peer_id)
+    assert outcome in ("accept", "ignore"), outcome
+    deadline = time.time() + 10
+    while time.time() < deadline and b.chain.head_root != a.chain.head_root:
+        time.sleep(0.1)
+    assert b.chain.head_root == a.chain.head_root
+    assert int(b.chain.head_state().slot) == 3
+    del conn
+
+
 def test_slot_timer_drives_production():
     """The per-slot timer service (timer crate analog) produces and
     publishes as a manual clock advances."""
