@@ -290,22 +290,25 @@ class BeaconNode:
             except Exception as exc:  # noqa: BLE001
                 if "unknown parent" in str(exc):
                     continue  # keep walking backward
-                # anything else ("already known", a racing import): the
-                # ancestor is in the chain — replay from here
-                anchored = True
+                if "already known" in str(exc):
+                    anchored = True  # a racing import landed the ancestor
+                else:
+                    return False  # invalid ancestor: the chain is garbage
             if anchored:
                 break
         if not anchored:
             return False
-        # replay the fetched descendants forward, tolerating blocks a
-        # concurrent import already landed
+        # replay the fetched descendants forward; ONLY a concurrent
+        # duplicate import is tolerable — any other failure (bad
+        # signature, invalid transition) means the block must NOT be
+        # reported accepted/forwarded
         for blk in reversed(pending[:-1]):
             try:
                 with self._chain_lock:
                     self.chain.process_block(blk)
             except Exception as exc:  # noqa: BLE001
-                if "unknown parent" in str(exc):
-                    return False  # replay chain broken: give up honestly
+                if "already known" not in str(exc):
+                    return False
         return True
 
     # -- slot timer (beacon_node/timer analog) -----------------------------

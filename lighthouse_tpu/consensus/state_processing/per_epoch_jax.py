@@ -37,14 +37,19 @@ _jitted = None
 
 
 def _build_kernel():
-    """Deferred so importing this module never initializes a JAX backend."""
+    """Deferred so importing this module never initializes a JAX backend.
+
+    x64 is (re-)enabled on EVERY call, not just the build-once path: the
+    kernel is compiled for int64 inputs, and a caller (or test fixture)
+    may have flipped the global flag back between calls — invoking the
+    cached kernel under x32 silently downcasts the registry columns."""
     global _jitted
-    if _jitted is not None:
-        return _jitted
     import jax
 
     if not jax.config.jax_enable_x64:
         jax.config.update("jax_enable_x64", True)
+    if _jitted is not None:
+        return _jitted
     import jax.numpy as jnp
     from functools import partial
 

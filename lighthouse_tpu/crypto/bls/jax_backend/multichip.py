@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as PS
 
+from ....parallel.mesh import allgather_tree, and_reduce, batch_spec
 from . import fp as F
 from . import pairing as PR
 from . import points as P
@@ -33,7 +34,7 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
     """
     from jax import shard_map
 
-    batch_spec = PS(None, axis)  # (limbs, B) arrays split on B
+    in_spec = batch_spec(2, axis=axis)  # (limbs, B) arrays split on B
 
     def local_part(pk_aff, sig_aff, h_aff, wbits):
         # --- per-device heavy compute on the local shard ---
@@ -46,16 +47,10 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
         wpk_aff = P.to_affine(P.FP_OPS, wpk, F.fp_inv)
         f_local = PR.miller_loop(wpk_aff, h_aff)
         g_local = PR.gt_product(f_local)  # batch-1 fp12
-        # --- tiny cross-device combine over ICI ---
-        g_all = jax.tree.map(
-            lambda a: jax.lax.all_gather(a, axis, axis=a.ndim - 1, tiled=True),
-            g_local,
-        )
-        S_all = jax.tree.map(
-            lambda a: jax.lax.all_gather(a, axis, axis=a.ndim - 1, tiled=True),
-            S_local,
-        )
-        ok_all = jnp.all(jax.lax.all_gather(ok_sub, axis))
+        # --- tiny cross-device combine over ICI (parallel/mesh.py) ---
+        g_all = allgather_tree(g_local, axis)
+        S_all = allgather_tree(S_local, axis)
+        ok_all = and_reduce(ok_sub, axis)
         # --- replicated epilogue: fold in (-G1, S) and final-exponentiate ---
         g = PR.gt_product(g_all)
         S = _tree_reduce_g2(S_all)
@@ -78,7 +73,7 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
     sharded = shard_map(
         local_part,
         mesh=mesh,
-        in_specs=(batch_spec, batch_spec, batch_spec, batch_spec),
+        in_specs=(in_spec, in_spec, in_spec, in_spec),
         out_specs=PS(),
         check_vma=False,
     )

@@ -1,0 +1,11 @@
+"""SPMD parallelism toolkit: device meshes + data-parallel sharding
+(SURVEY §2.8 — the DP axis of the framework)."""
+
+from .mesh import (  # noqa: F401
+    BATCH_AXIS,
+    allgather_tree,
+    and_reduce,
+    batch_spec,
+    dp_shard_map,
+    make_mesh,
+)

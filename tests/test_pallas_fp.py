@@ -50,6 +50,15 @@ def test_lazy_inputs_match():
         )
 
 
+_CHAINS_OPTIN = pytest.mark.skipif(
+    __import__("os").environ.get("LIGHTHOUSE_TPU_CHAINS", "") != "1",
+    reason="chain kernels are LIGHTHOUSE_TPU_CHAINS-gated (interpret runs "
+    "of the big unrolled programs have flakily segfaulted XLA:CPU inside "
+    "long pytest processes; run this file standalone with the env set)",
+)
+
+
+@_CHAINS_OPTIN
 @pytest.mark.parametrize("e", [5, 13, 21, 0b110101])
 def test_pow_chain_small_exponents(e):
     """Chunked in-kernel square-and-multiply == standard-domain pow
@@ -62,6 +71,7 @@ def test_pow_chain_small_exponents(e):
     assert got_std == [pow(x, e, F.P_INT) for x in a_std]
 
 
+@_CHAINS_OPTIN
 @pytest.mark.parametrize("e", [13, 37])
 def test_fp2_pow_chain_small_exponents(e):
     """In-kernel Fp2 square-and-multiply == the Fp2 oracle."""
