@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     vc = sub.add_parser("vc", help="run a validator client against a BN")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
     vc.add_argument("--keys", type=int, default=8, help="interop key count")
+    vc.add_argument("--slots", type=int, default=None,
+                    help="exit after attesting through slot N (tests)")
+    vc.add_argument("--fork", default="altair",
+                    help="state fork variant the BN serves (SSZ decode)")
 
     acct = sub.add_parser("account", help="keystore/wallet operations")
     acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
@@ -188,11 +192,24 @@ def run_bn(args) -> int:
 
 
 def run_vc(args) -> int:
+    """The validator-client process: duties + sign + publish over the
+    Beacon API (validator_client/src/lib.rs posture)."""
     from .network.api import BeaconApiClient
+    from .validator.remote import run_validator_client
 
     client = BeaconApiClient(args.beacon_node)
     print(json.dumps({"version": client.node_version(),
-                      "syncing": client.node_syncing()}))
+                      "syncing": client.node_syncing()}), flush=True)
+    spec = _spec_for(args.spec, args.keys)
+    published = 0
+    try:
+        published = run_validator_client(
+            args.beacon_node, args.keys, slots=args.slots, spec=spec,
+            fork=args.fork,
+        )
+    except KeyboardInterrupt:
+        pass
+    print(json.dumps({"published_attestations": published}))
     return 0
 
 

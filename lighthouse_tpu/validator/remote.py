@@ -1,0 +1,130 @@
+"""Remote beacon-node adapter — the VC as a true separate process.
+
+Twin of the reference VC's HTTP posture (validator_client talks to ≥1
+beacon nodes over the Beacon API; src/lib.rs:93-98, beacon_node_
+fallback.rs): `RemoteChain` exposes the same surface the VC services
+consume from an in-process chain (head_state / head_root / preset /
+committee_cache) but backed by `BeaconApiClient` — head state fetched
+as SSZ from the debug endpoint and cached by head root, committees
+computed locally from it (the reference's duties endpoints do the same
+work server-side; fetching the state once per head is the thin-BN
+equivalent).  Publishing goes through the pool endpoints.
+"""
+
+from __future__ import annotations
+
+from ..consensus import committees as cm
+from ..consensus.containers import types_for
+from ..utils.logging import get_logger
+
+log = get_logger("vc_remote")
+
+
+class RemoteChain:
+    """Chain-surface adapter over the Beacon API for the VC services."""
+
+    def __init__(self, client, spec, fork: str = "altair"):
+        self.client = client
+        self.spec = spec
+        self.preset = spec.preset
+        self.types = types_for(spec.preset)
+        self.fork = fork
+        self._cached_root: bytes | None = None
+        self._cached_state = None
+
+    def refresh(self) -> bytes:
+        """Fetch the head ONCE and pin (root, state) as a consistent
+        snapshot — AttestationService reads head_root and head_state
+        separately, and mixing two different heads across those reads
+        would build attestations the BN rejects (inconsistent target).
+        Called once per poll tick; everything between ticks serves from
+        the snapshot.  Returns the head root."""
+        hdr = self.client.block_header("head")
+        root = bytes.fromhex(hdr["root"].removeprefix("0x"))
+        if root != self._cached_root:
+            raw = self.client.get_state_ssz("head")
+            state_cls = self.types.BeaconState_BY_FORK[self.fork]
+            self._cached_state = state_cls.deserialize_value(raw)
+            self._cached_root = root
+        return root
+
+    # -- the surface DutiesService / AttestationService consume ------------
+
+    @property
+    def head_root(self) -> bytes:
+        if self._cached_root is None:
+            self.refresh()
+        return self._cached_root
+
+    def head_state(self):
+        if self._cached_state is None:
+            self.refresh()
+        return self._cached_state
+
+    def committee_cache(self, state, epoch: int) -> cm.CommitteeCache:
+        return cm.CommitteeCache(state, epoch, self.preset)
+
+    # -- publishing --------------------------------------------------------
+
+    def publish_attestations(self, attestations) -> None:
+        self.client.publish_attestations(attestations)
+
+    def publish_block(self, signed_block) -> None:
+        self.client.publish_block_ssz(signed_block)
+
+
+def run_validator_client(
+    beacon_url: str, n_keys: int, slots: int | None = None,
+    spec=None, fork: str = "altair", poll: float = 0.2,
+) -> int:
+    """The `lighthouse vc` loop over HTTP: interop keys, duties each
+    epoch, sign + publish attestations as head slots arrive."""
+    import time
+
+    from ..consensus import spec as S
+    from ..consensus.testing import interop_keypairs, phase0_spec
+    from ..network.api import BeaconApiClient
+    from .client import AttestationService, DutiesService, ValidatorStore
+    from .slashing_protection import SlashingDatabase
+
+    spec = spec or phase0_spec(S.MINIMAL)
+    client = BeaconApiClient(beacon_url)
+    chain = RemoteChain(client, spec, fork=fork)
+    state = chain.head_state()
+    pubkey_to_index = {
+        bytes(v.pubkey): i for i, v in enumerate(state.validators)
+    }
+    # one pass builds keys and indices together (they must never diverge)
+    keys, index_by_pubkey = {}, {}
+    for sk, pk in interop_keypairs(n_keys):
+        raw = pk.to_bytes()
+        idx = pubkey_to_index.get(raw)
+        if idx is not None:
+            keys[raw] = sk
+            index_by_pubkey[raw] = idx
+    store = ValidatorStore(
+        keys=keys,
+        slashing_db=SlashingDatabase(
+            ":memory:",
+            genesis_validators_root=bytes(state.genesis_validators_root),
+        ),
+        index_by_pubkey=index_by_pubkey,
+    )
+    duties = DutiesService(chain, store)
+    attester = AttestationService(chain, store, duties)
+    log.info("vc up: %d managed keys against %s", len(store.keys), beacon_url)
+    published = 0
+    last_attested = -1
+    while True:
+        chain.refresh()  # one consistent (root, state) snapshot per tick
+        slot = int(chain.head_state().slot)
+        if slot > last_attested:
+            atts = attester.attest(slot)
+            if atts:
+                chain.publish_attestations(atts)
+                published += len(atts)
+                log.info("slot %d: published %d attestations", slot, len(atts))
+            last_attested = slot
+            if slots is not None and slot >= slots:
+                return published
+        time.sleep(poll)

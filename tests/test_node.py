@@ -219,6 +219,26 @@ def test_slot_timer_drives_production():
         node.stop()
 
 
+def test_remote_validator_client_attests_over_http():
+    """The VC as a separate-process posture: duties computed from the
+    debug-state SSZ endpoint, attestations signed locally (slashing
+    protection consulted) and published through the pool endpoint."""
+    from lighthouse_tpu.validator.remote import run_validator_client
+
+    node, _keys = interop_node(n_validators=16)
+    node.start()
+    try:
+        node.produce_and_publish(1)
+        node.produce_and_publish(2)
+        url = f"http://127.0.0.1:{node.api.port}"
+        published = run_validator_client(
+            url, 16, slots=2, spec=node.spec, fork=node.fork
+        )
+        assert published > 0, "VC must publish attestations over HTTP"
+    finally:
+        node.stop()
+
+
 def test_four_node_churn_and_heal():
     """Four real nodes in a line topology a-b-c-d; gossip reaches the
     far end through two hops; killing an INTERIOR node partitions the
