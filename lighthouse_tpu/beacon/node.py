@@ -46,6 +46,7 @@ class BeaconNode:
         tcp_port: int = 0,
         udp_port: int | None = None,
         store=None,
+        slasher: bool = False,
     ):
         self.spec = spec
         self.fork = fork
@@ -104,6 +105,14 @@ class BeaconNode:
         # worker model); with gossip threads + the slot timer feeding one
         # chain, this lock IS that single writer.
         self._chain_lock = threading.Lock()
+        # optional in-node slasher service (slasher/service/src/service.rs:
+        # fed from verified gossip, polled each slot, found slashings go to
+        # the op pool for block inclusion)
+        self.slasher = None
+        if slasher:
+            from ..slasher import Slasher
+
+            self.slasher = Slasher()
         self.slot_timer = None
         self._running = False
 
@@ -311,6 +320,52 @@ class BeaconNode:
                     return False
         return True
 
+    def _feed_slasher_header(self, signed_block) -> None:
+        """Queue a gossiped block's header for equivocation detection
+        (service.rs: the proposer-slashing half of the feed)."""
+        if self.slasher is None:
+            return
+        from ..consensus.containers import (
+            BeaconBlockHeader,
+            SignedBeaconBlockHeader,
+        )
+
+        msg = signed_block.message
+        self.slasher.accept_block_header(
+            SignedBeaconBlockHeader(
+                message=BeaconBlockHeader(
+                    slot=int(msg.slot),
+                    proposer_index=int(msg.proposer_index),
+                    parent_root=bytes(msg.parent_root),
+                    state_root=bytes(msg.state_root),
+                    body_root=msg.body.root(),
+                ),
+                signature=bytes(signed_block.signature),
+            )
+        )
+
+    def poll_slasher(self) -> tuple[list, list]:
+        """One slasher-service tick (service.rs: poll each slot): process
+        queued messages, push found slashings into the op pool for block
+        inclusion.  Returns (attester_slashings, proposer_slashings)."""
+        if self.slasher is None:
+            return [], []
+        with self._chain_lock:
+            epoch = int(self.chain.head_state().slot) // (
+                self.spec.preset.slots_per_epoch
+            )
+            att_slashings, prop_slashings = self.slasher.process_queued(epoch)
+            for s in att_slashings:
+                self.chain.op_pool.insert_attester_slashing(s)
+            for s in prop_slashings:
+                self.chain.op_pool.insert_proposer_slashing(s)
+        if att_slashings or prop_slashings:
+            log.info(
+                "slasher found %d attester / %d proposer slashings",
+                len(att_slashings), len(prop_slashings),
+            )
+        return att_slashings, prop_slashings
+
     # -- slot timer (beacon_node/timer analog) -----------------------------
 
     def start_slot_timer(self, clock, auto_propose: bool = False):
@@ -330,6 +385,7 @@ class BeaconNode:
                 self.chain.recompute_head()
             if block is not None:
                 self.publish_block(block)
+            self.poll_slasher()
 
         self.slot_timer = SlotTimer(clock, on_slot)
         self.slot_timer.start()
@@ -345,6 +401,7 @@ class BeaconNode:
         try:
             with self._chain_lock:
                 self.chain.process_block(block)
+            self._feed_slasher_header(block)
             return "accept"
         except Exception as exc:  # noqa: BLE001
             if "unknown parent" in str(exc):
@@ -394,6 +451,26 @@ class BeaconNode:
                 ]
             if not bls.verify_signature_sets(envelope):
                 return "reject"
+            # feed the slasher BEFORE fork-choice import: conflicting-head
+            # votes (the primary slashable offense) reference unknown
+            # roots and would never survive process_attestation.  The
+            # committee comes from the SLOT-derived epoch — the same
+            # shuffling the attesters actually used.
+            if self.slasher is not None:
+                import lighthouse_tpu.consensus.committees as cm
+
+                att = agg.message.aggregate
+                slot_epoch = (
+                    int(att.data.slot) // self.spec.preset.slots_per_epoch
+                )
+                with self._chain_lock:
+                    cache = self.chain.committee_cache(state, slot_epoch)
+                    committee = cache.committee(
+                        int(att.data.slot), int(att.data.index)
+                    )
+                self.slasher.accept_attestation(
+                    cm.get_indexed_attestation(committee, att)
+                )
             with self._chain_lock:
                 self.chain.process_attestation(agg.message.aggregate)
             return "accept"

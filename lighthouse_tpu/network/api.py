@@ -348,7 +348,12 @@ class BeaconApiServer:
                 raise KeyError(f"{state_id} state not held")
             return st
         if state_id.startswith("0x"):
-            st = chain.store.get_state(bytes.fromhex(state_id[2:]))
+            # decode with the chain's ACTIVE fork class — the store's
+            # default (base) would mis-deserialize post-altair states
+            st = chain.store.get_state(
+                bytes.fromhex(state_id[2:]),
+                state_cls=chain.types.BeaconState_BY_FORK[chain.fork_name],
+            )
             if st is None:
                 raise KeyError("state not found")
             return st

@@ -31,21 +31,25 @@ class RemoteChain:
         self.fork = fork
         self._cached_root: bytes | None = None
         self._cached_state = None
+        self._committee_caches: dict[int, cm.CommitteeCache] = {}
 
     def refresh(self) -> bytes:
         """Fetch the head ONCE and pin (root, state) as a consistent
         snapshot — AttestationService reads head_root and head_state
         separately, and mixing two different heads across those reads
         would build attestations the BN rejects (inconsistent target).
-        Called once per poll tick; everything between ticks serves from
-        the snapshot.  Returns the head root."""
+        The state is fetched BY THE HEADER'S state_root, so even if the
+        BN advances between the two HTTP calls the snapshot stays
+        internally consistent.  Called once per poll tick."""
         hdr = self.client.block_header("head")
         root = bytes.fromhex(hdr["root"].removeprefix("0x"))
         if root != self._cached_root:
-            raw = self.client.get_state_ssz("head")
+            state_root = hdr["header"]["message"]["state_root"]
+            raw = self.client.get_state_ssz(state_root)
             state_cls = self.types.BeaconState_BY_FORK[self.fork]
             self._cached_state = state_cls.deserialize_value(raw)
             self._cached_root = root
+            self._committee_caches = {}
         return root
 
     # -- the surface DutiesService / AttestationService consume ------------
@@ -62,7 +66,14 @@ class RemoteChain:
         return self._cached_state
 
     def committee_cache(self, state, epoch: int) -> cm.CommitteeCache:
-        return cm.CommitteeCache(state, epoch, self.preset)
+        """Keyed per (snapshot, epoch): the full shuffle is O(registry)
+        and the VC hot loop asks several times per tick (cf.
+        BeaconChain.committee_cache's cache)."""
+        cache = self._committee_caches.get(epoch)
+        if cache is None:
+            cache = cm.CommitteeCache(state, epoch, self.preset)
+            self._committee_caches[epoch] = cache
+        return cache
 
     # -- publishing --------------------------------------------------------
 
@@ -115,16 +126,21 @@ def run_validator_client(
     log.info("vc up: %d managed keys against %s", len(store.keys), beacon_url)
     published = 0
     last_attested = -1
-    while True:
-        chain.refresh()  # one consistent (root, state) snapshot per tick
-        slot = int(chain.head_state().slot)
-        if slot > last_attested:
-            atts = attester.attest(slot)
-            if atts:
-                chain.publish_attestations(atts)
-                published += len(atts)
-                log.info("slot %d: published %d attestations", slot, len(atts))
-            last_attested = slot
-            if slots is not None and slot >= slots:
-                return published
-        time.sleep(poll)
+    try:
+        while True:
+            chain.refresh()  # one consistent (root, state) snapshot/tick
+            slot = int(chain.head_state().slot)
+            if slot > last_attested:
+                atts = attester.attest(slot)
+                if atts:
+                    chain.publish_attestations(atts)
+                    published += len(atts)
+                    log.info(
+                        "slot %d: published %d attestations", slot, len(atts)
+                    )
+                last_attested = slot
+                if slots is not None and slot >= slots:
+                    return published
+            time.sleep(poll)
+    except KeyboardInterrupt:
+        return published  # long-running mode: report the real count

@@ -87,9 +87,8 @@ def test_interop_node_factory():
         node.stop()
 
 
-def test_aggregate_gossip_feeds_fork_choice(net):
-    """A SignedAggregateAndProof published by A lands in B's attestation
-    pipeline over the wire."""
+def _signed_aggregate(node, slot: int, block_root: bytes | None = None):
+    """Build a fully-signed SignedAggregateAndProof over node's chain."""
     import lighthouse_tpu.consensus.committees as cm
     from lighthouse_tpu.consensus import spec as SS
     from lighthouse_tpu.consensus.containers import (
@@ -99,8 +98,57 @@ def test_aggregate_gossip_feeds_fork_choice(net):
         Checkpoint,
         SignedAggregateAndProof,
     )
+    from lighthouse_tpu.consensus.ssz import U64
     from lighthouse_tpu.consensus.state_processing import signature_sets as sets
     from lighthouse_tpu.crypto.bls import api as bls
+
+    state = node.chain.head_state()
+    preset = node.spec.preset
+    epoch = slot // preset.slots_per_epoch
+    cache = cm.CommitteeCache(state, epoch, preset)
+    committee = cache.committee(slot, 0)
+    data = AttestationData(
+        slot=slot, index=0,
+        beacon_block_root=block_root or node.chain.head_root,
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(epoch=epoch, root=node.chain.genesis_block_root),
+    )
+    gvr = bytes(state.genesis_validators_root)
+    domain = sets.get_domain(state.fork, gvr, SS.DOMAIN_BEACON_ATTESTER, epoch)
+    root = SS.compute_signing_root(data, domain)
+    sigs = [node.keypairs[int(v)][0].sign(root) for v in committee]
+    att = Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=bls.AggregateSignature.aggregate(sigs).to_bytes(),
+    )
+    agg_index = int(committee[0])
+    agg_sk = node.keypairs[agg_index][0]
+    sel_domain = sets.get_domain(
+        state.fork, gvr, SS.DOMAIN_SELECTION_PROOF, epoch
+    )
+    sel_root = sets.SigningData(
+        object_root=U64.hash_tree_root(slot), domain=sel_domain
+    ).root()
+    message = AggregateAndProof(
+        aggregator_index=agg_index, aggregate=att,
+        selection_proof=agg_sk.sign(sel_root).to_bytes(),
+    )
+    agg_domain = sets.get_domain(
+        state.fork, gvr, SS.DOMAIN_AGGREGATE_AND_PROOF, epoch
+    )
+    return SignedAggregateAndProof(
+        message=message,
+        signature=agg_sk.sign(
+            SS.compute_signing_root(message, agg_domain)
+        ).to_bytes(),
+    )
+
+
+def test_aggregate_gossip_feeds_fork_choice(net):
+    """A SignedAggregateAndProof published by A lands in B's attestation
+    pipeline over the wire."""
+    from lighthouse_tpu.consensus.containers import SignedAggregateAndProof
 
     boot, a, b = net
     a.produce_and_publish(1)
@@ -108,53 +156,8 @@ def test_aggregate_gossip_feeds_fork_choice(net):
     assert b.discover_and_dial() == 1
     time.sleep(1.2)  # mesh heartbeat
 
-    state = a.chain.head_state()
-    preset = a.spec.preset
-    cache = cm.CommitteeCache(state, 0, preset)
-    committee = cache.committee(1, 0)
-    data = AttestationData(
-        slot=1, index=0,
-        beacon_block_root=a.chain.head_root,
-        source=state.current_justified_checkpoint,
-        target=Checkpoint(epoch=0, root=a.chain.genesis_block_root),
-    )
-    domain = sets.get_domain(
-        state.fork, bytes(state.genesis_validators_root),
-        SS.DOMAIN_BEACON_ATTESTER, 0,
-    )
-    root = SS.compute_signing_root(data, domain)
-    sigs = [a.keypairs[int(v)][0].sign(root) for v in committee]
-    att = Attestation(
-        aggregation_bits=[True] * len(committee),
-        data=data,
-        signature=bls.AggregateSignature.aggregate(sigs).to_bytes(),
-    )
-    agg_index = int(committee[0])
-    agg_sk = a.keypairs[agg_index][0]
-    # selection proof: the aggregator signs the SLOT
-    from lighthouse_tpu.consensus.ssz import U64
-
-    sel_domain = sets.get_domain(
-        state.fork, bytes(state.genesis_validators_root),
-        SS.DOMAIN_SELECTION_PROOF, 0,
-    )
-    sel_root = sets.SigningData(
-        object_root=U64.hash_tree_root(1), domain=sel_domain
-    ).root()
-    message = AggregateAndProof(
-        aggregator_index=agg_index, aggregate=att,
-        selection_proof=agg_sk.sign(sel_root).to_bytes(),
-    )
-    agg_domain = sets.get_domain(
-        state.fork, bytes(state.genesis_validators_root),
-        SS.DOMAIN_AGGREGATE_AND_PROOF, 0,
-    )
-    agg = SignedAggregateAndProof(
-        message=message,
-        signature=agg_sk.sign(
-            SS.compute_signing_root(message, agg_domain)
-        ).to_bytes(),
-    )
+    agg = _signed_aggregate(a, 1)
+    message = agg.message
     a.publish_aggregate(agg)
     deadline = time.time() + 10
     while time.time() < deadline and not any(
@@ -215,6 +218,32 @@ def test_slot_timer_drives_production():
                 time.sleep(0.02)
             assert int(node.chain.head_state().slot) == slot, slot
         timer.stop()
+    finally:
+        node.stop()
+
+
+def test_slasher_service_catches_double_vote():
+    """A node with the in-process slasher: two verified aggregates voting
+    for DIFFERENT heads at the same target land an attester slashing in
+    the op pool on the next service poll (slasher/service wiring)."""
+    from lighthouse_tpu.beacon.node import BeaconNode
+
+    spec = phase0_spec(S.MINIMAL)
+    state, keypairs = interop_state(N, spec, fork="altair")
+    node = BeaconNode(spec, state, keypairs=keypairs, slasher=True)
+    node.start()
+    try:
+        node.produce_and_publish(1)
+        agg1 = _signed_aggregate(node, 1)
+        agg2 = _signed_aggregate(node, 1, block_root=b"\x13" * 32)
+        assert node._on_gossip_aggregate(agg1.encode(), b"p1") == "accept"
+        # the conflicting vote references an unknown head root, so fork
+        # choice ignores it — but the PIPELINE must have fed the slasher
+        # before the import attempt (that is the point of the wiring)
+        assert node._on_gossip_aggregate(agg2.encode(), b"p2") == "ignore"
+        att_slash, _prop = node.poll_slasher()
+        assert att_slash, "double vote must produce an attester slashing"
+        assert node.chain.op_pool.attester_slashings, "pool must hold it"
     finally:
         node.stop()
 
