@@ -131,13 +131,18 @@ def run_validator_client(
             chain.refresh()  # one consistent (root, state) snapshot/tick
             slot = int(chain.head_state().slot)
             if slot > last_attested:
-                atts = attester.attest(slot)
-                if atts:
-                    chain.publish_attestations(atts)
-                    published += len(atts)
-                    log.info(
-                        "slot %d: published %d attestations", slot, len(atts)
-                    )
+                # attest EVERY slot since the last poll, not just the
+                # newest — a head that advanced several slots between
+                # polls must not permanently skip those duties (late
+                # attestations vote the current view, as a late VC does)
+                for s in range(max(last_attested + 1, 1), slot + 1):
+                    atts = attester.attest(s)
+                    if atts:
+                        chain.publish_attestations(atts)
+                        published += len(atts)
+                        log.info(
+                            "slot %d: published %d attestations", s, len(atts)
+                        )
                 last_attested = slot
                 if slots is not None and slot >= slots:
                     return published

@@ -87,6 +87,12 @@ def test_interop_node_factory():
         node.stop()
 
 
+def _wait_for_head(node, slot: int, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline and int(node.chain.head_state().slot) < slot:
+        time.sleep(0.02)
+
+
 def _signed_aggregate(node, slot: int, block_root: bytes | None = None):
     """Build a fully-signed SignedAggregateAndProof over node's chain."""
     import lighthouse_tpu.consensus.committees as cm
@@ -211,11 +217,7 @@ def test_slot_timer_drives_production():
         timer = node.start_slot_timer(clock, auto_propose=True)
         for slot in (1, 2, 3):
             clock.set_slot(slot)
-            deadline = time.time() + 5
-            while time.time() < deadline and int(
-                node.chain.head_state().slot
-            ) < slot:
-                time.sleep(0.02)
+            _wait_for_head(node, slot, timeout=5.0)
             assert int(node.chain.head_state().slot) == slot, slot
         timer.stop()
     finally:
@@ -318,6 +320,55 @@ def test_four_node_churn_and_heal():
                 n.stop()
             except Exception:  # noqa: BLE001 — double-stop is harmless
                 pass
+
+
+@pytest.mark.slow
+def test_full_node_vc_loop_reaches_justification():
+    """The whole service graph under its own steam: the slot timer
+    produces blocks, a remote VC attests over HTTP, attestations flow
+    through the pool into produced blocks, and the chain justifies —
+    lighthouse's bn+vc happy path end-to-end."""
+    import threading
+
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+    from lighthouse_tpu.validator.remote import run_validator_client
+
+    node, _keys = interop_node(n_validators=8)
+    node.start()
+    clock = ManualSlotClock(genesis_time=0.0, seconds_per_slot=12)
+    per_epoch = node.spec.preset.slots_per_epoch
+    target_slot = 3 * per_epoch  # through two epoch boundaries
+    url = f"http://127.0.0.1:{node.api.port}"
+    result = {}
+
+    def vc():
+        try:
+            result["published"] = run_validator_client(
+                url, 8, slots=target_slot, spec=node.spec, fork=node.fork,
+                poll=0.05,
+            )
+        except Exception as exc:  # noqa: BLE001 — surface in the assert
+            result["error"] = repr(exc)
+
+    vc_thread = threading.Thread(target=vc, daemon=True)
+    try:
+        node.start_slot_timer(clock, auto_propose=True)
+        # the VC needs a head block to exist (a real VC waits out genesis)
+        clock.set_slot(1)
+        _wait_for_head(node, 1)
+        vc_thread.start()
+        for slot in range(2, target_slot + 1):
+            clock.set_slot(slot)
+            _wait_for_head(node, slot)
+        vc_thread.join(timeout=60)
+        head = node.chain.head_state()
+        assert int(head.slot) == target_slot
+        assert result.get("published", 0) > 0, f"VC attested over HTTP: {result}"
+        assert int(head.current_justified_checkpoint.epoch) >= 1, (
+            "attested chain must justify"
+        )
+    finally:
+        node.stop()
 
 
 def test_multichunk_response_codec():
