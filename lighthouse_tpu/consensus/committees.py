@@ -86,6 +86,17 @@ class CommitteeCache:
         return [self.committee(slot, i) for i in range(self.committees_per_slot)]
 
 
+def iter_epoch_committees(cache: "CommitteeCache", epoch: int, preset: Preset):
+    """Yield (slot, committee_index, committee) for every committee in the
+    epoch — the one enumeration both duty computation (validator duties
+    service) and the duties API endpoints walk."""
+    for slot in range(
+        epoch * preset.slots_per_epoch, (epoch + 1) * preset.slots_per_epoch
+    ):
+        for index in range(cache.committees_per_slot):
+            yield slot, index, cache.committee(slot, index)
+
+
 def get_committee_count_per_slot(state, epoch: int, preset: Preset) -> int:
     return committees_per_slot(len(get_active_validator_indices(state, epoch)), preset)
 

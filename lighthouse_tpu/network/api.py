@@ -203,6 +203,38 @@ class BeaconApiServer:
                 },
             )
             return
+        if path.startswith("/eth/v1/beacon/states/") and path.endswith(
+            "/validators"
+        ):
+            state = self._resolve_state(path.split("/")[5])
+            current = int(state.slot) // chain.preset.slots_per_epoch
+            out = []
+            for i, v in enumerate(state.validators):
+                active = v.activation_epoch <= current < v.exit_epoch
+                if v.slashed:
+                    status = "active_slashed" if active else "exited_slashed"
+                elif active:
+                    status = "active_ongoing"
+                elif v.activation_epoch > current:
+                    status = "pending_queued"
+                else:
+                    status = "exited_unslashed"
+                out.append(
+                    {
+                        "index": str(i),
+                        "balance": str(int(state.balances[i])),
+                        "status": status,
+                        "validator": {
+                            "pubkey": "0x" + bytes(v.pubkey).hex(),
+                            "effective_balance": str(int(v.effective_balance)),
+                            "slashed": bool(v.slashed),
+                            "activation_epoch": str(int(v.activation_epoch)),
+                            "exit_epoch": str(int(v.exit_epoch)),
+                        },
+                    }
+                )
+            h._send(200, {"data": out})
+            return
         if path.startswith("/eth/v1/beacon/headers"):
             root = self._resolve_block_root(path.split("/")[-1])
             blk = chain.store.get_block(
@@ -266,6 +298,32 @@ class BeaconApiServer:
                         "slot": str(slot),
                     }
                 )
+            h._send(200, {"data": duties, "dependent_root": "0x" + "00" * 32})
+            return
+        if path.startswith("/eth/v1/validator/duties/attester/"):
+            # GET variant (the reference serves POST with index filters;
+            # the GET form returns all indices' duties for the epoch)
+            from ..consensus import committees as cm
+
+            epoch = int(path.split("/")[-1])
+            state = chain.head_state()
+            cache = chain.committee_cache(state, epoch)
+            duties = []
+            for slot, index, committee in cm.iter_epoch_committees(
+                cache, epoch, chain.preset
+            ):
+                for pos, vi in enumerate(committee):
+                    duties.append(
+                        {
+                            "pubkey": "0x"
+                            + bytes(state.validators[int(vi)].pubkey).hex(),
+                            "validator_index": str(int(vi)),
+                            "committee_index": str(index),
+                            "committee_length": str(len(committee)),
+                            "validator_committee_index": str(pos),
+                            "slot": str(slot),
+                        }
+                    )
             h._send(200, {"data": duties, "dependent_root": "0x" + "00" * 32})
             return
         if path == "/eth/v1/config/spec":
@@ -446,6 +504,12 @@ class BeaconApiClient:
             timeout=self.timeout,
         ) as r:
             return r.read()
+
+    def validators(self, state_id: str = "head") -> list[dict]:
+        return self._get(f"/eth/v1/beacon/states/{state_id}/validators")["data"]
+
+    def attester_duties(self, epoch: int) -> list[dict]:
+        return self._get(f"/eth/v1/validator/duties/attester/{epoch}")["data"]
 
     def proposer_duties(self, epoch: int) -> list[dict]:
         return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]

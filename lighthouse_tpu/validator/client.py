@@ -109,22 +109,20 @@ class DutiesService:
         } - {None}
         out = []
         preset = self.chain.preset
-        for slot in range(
-            epoch * preset.slots_per_epoch, (epoch + 1) * preset.slots_per_epoch
+        for slot, index, committee in cm.iter_epoch_committees(
+            cache, epoch, preset
         ):
-            for index in range(cache.committees_per_slot):
-                committee = cache.committee(slot, index)
-                for pos, vi in enumerate(committee):
-                    if int(vi) in managed:
-                        out.append(
-                            Duty(
-                                validator_index=int(vi),
-                                slot=slot,
-                                committee_index=index,
-                                committee_position=pos,
-                                committee_size=len(committee),
-                            )
+            for pos, vi in enumerate(committee):
+                if int(vi) in managed:
+                    out.append(
+                        Duty(
+                            validator_index=int(vi),
+                            slot=slot,
+                            committee_index=index,
+                            committee_position=pos,
+                            committee_size=len(committee),
                         )
+                    )
         return out
 
     def proposer_duties(self, epoch: int) -> dict[int, int]:

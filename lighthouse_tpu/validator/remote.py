@@ -134,8 +134,14 @@ def run_validator_client(
                 # attest EVERY slot since the last poll, not just the
                 # newest — a head that advanced several slots between
                 # polls must not permanently skip those duties (late
-                # attestations vote the current view, as a late VC does)
-                for s in range(max(last_attested + 1, 1), slot + 1):
+                # attestations vote the current view, as a late VC does).
+                # Clamped to the inclusion window: older slots' target
+                # roots have rotated out of block_roots and would produce
+                # invalid votes (and a fresh VC must not burst-sign the
+                # whole historic chain).
+                window_start = slot - spec.preset.slots_per_epoch + 1
+                for s in range(max(last_attested + 1, window_start, 1),
+                               slot + 1):
                     atts = attester.attest(s)
                     if atts:
                         chain.publish_attestations(atts)

@@ -183,7 +183,28 @@ class TestBeaconApi:
         h, _, client = rig
         spec = client.spec()
         assert spec["SLOTS_PER_EPOCH"] == str(MINIMAL.slots_per_epoch)
-        assert spec["SECONDS_PER_SLOT"] == "12"
+        assert spec["SECONDS_PER_SLOT"] == "12"  # non-preset runtime field
+
+    def test_validators_endpoint(self, rig):
+        h, _, client = rig
+        vals = client.validators("head")
+        assert len(vals) == 16
+        assert all(v["status"] == "active_ongoing" for v in vals)
+        assert vals[3]["validator"]["pubkey"] == "0x" + bytes(
+            h.head_state().validators[3].pubkey
+        ).hex()
+        assert int(vals[0]["balance"]) > 0
+
+    def test_attester_duties_endpoint(self, rig):
+        h, _, client = rig
+        duties = client.attester_duties(0)
+        preset = h.chain.preset
+        # every active validator appears exactly once per epoch
+        seen = [d["validator_index"] for d in duties]
+        assert len(seen) == 16 and len(set(seen)) == 16
+        assert all(
+            0 <= int(d["slot"]) < preset.slots_per_epoch for d in duties
+        )
 
     def test_publish_block_ssz_roundtrip(self, rig):
         h, _, client = rig

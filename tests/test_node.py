@@ -360,6 +360,9 @@ def test_full_node_vc_loop_reaches_justification():
         for slot in range(2, target_slot + 1):
             clock.set_slot(slot)
             _wait_for_head(node, slot)
+            # fail FAST on a stalled producer instead of burning the
+            # remaining slots' timeouts
+            assert int(node.chain.head_state().slot) == slot, slot
         vc_thread.join(timeout=60)
         head = node.chain.head_state()
         assert int(head.slot) == target_slot
