@@ -31,6 +31,7 @@ from ..consensus.state_processing.per_block import (
     BlockProcessingError,
     process_block as st_process_block,
 )
+from ..consensus.state_processing.forks import state_fork_name
 from ..consensus.state_processing.per_slot import process_slots
 from ..crypto.bls import api as bls
 from ..store import HotColdDB
@@ -83,11 +84,15 @@ class ValidatorPubkeyCache:
 
 class BeaconChain:
     def __init__(self, spec: S.ChainSpec, genesis_state, store: HotColdDB | None,
-                 slot_clock=None, fork: str = "base"):
+                 slot_clock=None, fork: str = "base", execution=None):
         self.spec = spec
         self.preset = spec.preset
         self.types = types_for(spec.preset)
         self.fork_name = fork
+        # execution-layer boundary (None = pre-merge chain / no EL wired);
+        # anything with new_payload()/build_payload() — EngineApiClient or
+        # MockExecutionEngine (execution.py)
+        self.execution = execution
         self.store = store or HotColdDB(types_family=self.types)
         self.log = get_logger("beacon_chain")
         self.slot_clock = slot_clock
@@ -177,7 +182,7 @@ class BeaconChain:
                 raise BlockError("block from the future")
         # --- advance parent state to the block's slot ----------------------
         state = parent_state.copy()
-        process_slots(state, block.slot, self.spec)
+        state = process_slots(state, block.slot, self.spec)
         epoch = block.slot // self.preset.slots_per_epoch
         cache = self.committee_cache(state, epoch)
         # --- bulk signature verification (SignatureVerifiedBlock rung) -----
@@ -202,6 +207,18 @@ class BeaconChain:
             )
         except BlockProcessingError as e:
             raise BlockError(f"state transition rejected block: {e}") from None
+        # --- execution-layer gate (ExecutionPendingBlock rung) -------------
+        payload = getattr(block.body, "execution_payload", None)
+        if payload is not None and self.execution is not None:
+            from ..consensus.state_processing.per_block import _default_root
+            from .execution import PayloadStatus, notify_new_payload
+
+            if payload.root() != _default_root(type(payload)):
+                status = notify_new_payload(self.execution, payload)
+                if status == PayloadStatus.INVALID:
+                    raise BlockError("execution engine rejected payload")
+                # SYNCING/ACCEPTED: optimistic import, same as the
+                # reference's optimistic-sync path
         # --- import: fork choice + store + caches --------------------------
         jc = state.current_justified_checkpoint
         fc = state.finalized_checkpoint
@@ -308,7 +325,10 @@ class BeaconChain:
         remotely)."""
         state = self.head_state().copy()
         parent_root = self.head_root
-        process_slots(state, slot, self.spec)
+        state = process_slots(state, slot, self.spec)
+        # dynamic fork: the post-advance state is the fork witness, so a
+        # proposal straddling a fork boundary uses the NEW fork's containers
+        fork_now = state_fork_name(state)
         proposer = cm.get_beacon_proposer_index(state, slot, self.preset)
         sk = keypairs[proposer][0]
         epoch = slot // self.preset.slots_per_epoch
@@ -323,8 +343,8 @@ class BeaconChain:
         ).root()
         atts = self.op_pool.get_attestations_for_block(state, self.preset)
         ps, asl, exits = self.op_pool.get_slashings_and_exits(state, self.preset)
-        body_cls = self.types.BeaconBlockBody_BY_FORK[self.fork_name]
-        body = body_cls(
+        body_cls = self.types.BeaconBlockBody_BY_FORK[fork_now]
+        body_kwargs = dict(
             randao_reveal=sk.sign(randao_root).to_bytes(),
             graffiti=graffiti.ljust(32, b"\x00")[:32],
             attestations=atts,
@@ -332,7 +352,13 @@ class BeaconChain:
             attester_slashings=asl,
             voluntary_exits=exits,
         )
-        block_cls = self.types.BeaconBlock_BY_FORK[self.fork_name]
+        if "execution_payload" in body_cls._fields and self.execution is not None:
+            payload_cls = body_cls._fields["execution_payload"].cls
+            body_kwargs["execution_payload"] = self.execution.build_payload(
+                state, self.spec, payload_cls
+            )
+        body = body_cls(**body_kwargs)
+        block_cls = self.types.BeaconBlock_BY_FORK[fork_now]
         block = block_cls(
             slot=slot,
             proposer_index=proposer,
@@ -342,7 +368,7 @@ class BeaconChain:
         )
         # fill state_root by running the transition (produce_block.rs does
         # the same complete-state dance)
-        trial = self.types.SignedBeaconBlock_BY_FORK[self.fork_name](
+        trial = self.types.SignedBeaconBlock_BY_FORK[fork_now](
             message=block, signature=b"\x00" * 96
         )
         st_process_block(
@@ -352,7 +378,7 @@ class BeaconChain:
         block.state_root = state.root()
         block_domain = sets.get_domain(fork, gvr, S.DOMAIN_BEACON_PROPOSER, epoch)
         sig = sk.sign(S.compute_signing_root(block, block_domain))
-        return self.types.SignedBeaconBlock_BY_FORK[self.fork_name](
+        return self.types.SignedBeaconBlock_BY_FORK[fork_now](
             message=block, signature=sig.to_bytes()
         )
 

@@ -82,6 +82,11 @@ class EngineApiClient:
         res = self.call("engine_newPayloadV2", [payload_json])
         return PayloadStatus(res["status"])
 
+    def new_payload_from(self, payload) -> PayloadStatus:
+        """Marshal a consensus ExecutionPayload container into the Engine-API
+        JSON shape (engine_api/json_structures.rs) and send it."""
+        return self.new_payload(payload_to_json(payload))
+
     def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes,
                            payload_attributes: dict | None = None) -> dict:
         state = {
@@ -92,6 +97,58 @@ class EngineApiClient:
         return self.call(
             "engine_forkchoiceUpdatedV2", [state, payload_attributes]
         )
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _qty(n: int) -> str:
+    return hex(int(n))
+
+
+def payload_to_json(payload) -> dict:
+    """ExecutionPayload container → Engine-API JSON (camelCase, 0x-hex
+    values — engine_api/json_structures.rs JsonExecutionPayload)."""
+    out = {
+        "parentHash": _hex(payload.parent_hash),
+        "feeRecipient": _hex(payload.fee_recipient),
+        "stateRoot": _hex(payload.state_root),
+        "receiptsRoot": _hex(payload.receipts_root),
+        "logsBloom": _hex(payload.logs_bloom),
+        "prevRandao": _hex(payload.prev_randao),
+        "blockNumber": _qty(payload.block_number),
+        "gasLimit": _qty(payload.gas_limit),
+        "gasUsed": _qty(payload.gas_used),
+        "timestamp": _qty(payload.timestamp),
+        "extraData": _hex(payload.extra_data),
+        "baseFeePerGas": _qty(payload.base_fee_per_gas),
+        "blockHash": _hex(payload.block_hash),
+        "transactions": [_hex(tx) for tx in payload.transactions],
+    }
+    if hasattr(payload, "withdrawals"):
+        out["withdrawals"] = [
+            {
+                "index": _qty(w.index),
+                "validatorIndex": _qty(w.validator_index),
+                "address": _hex(w.address),
+                "amount": _qty(w.amount),
+            }
+            for w in payload.withdrawals
+        ]
+    if hasattr(payload, "blob_gas_used"):
+        out["blobGasUsed"] = _qty(payload.blob_gas_used)
+        out["excessBlobGas"] = _qty(payload.excess_blob_gas)
+    return out
+
+
+def notify_new_payload(engine, payload) -> PayloadStatus:
+    """Uniform chain→engine verb: full-payload marshal when the engine
+    speaks Engine-API JSON (EngineApiClient), block-hash shortcut for the
+    in-process mock."""
+    if hasattr(engine, "new_payload_from"):
+        return engine.new_payload_from(payload)
+    return engine.new_payload(bytes(payload.block_hash))
 
 
 class MockExecutionEngine:
@@ -121,6 +178,47 @@ class MockExecutionEngine:
         self.calls.append(("forkchoice_updated", head))
         self._head = head
         return {"payloadStatus": {"status": "VALID"}, "payloadId": "0x01"}
+
+    def build_payload(self, state, spec, payload_cls):
+        """ExecutionBlockGenerator analog (execution_layer/src/test_utils/
+        execution_block_generator.rs): produce a payload that satisfies the
+        consensus checks of process_execution_payload — parent linkage,
+        prev_randao, timestamp — plus expected withdrawals for capella+."""
+        preset = spec.preset
+        parent = bytes(state.latest_execution_payload_header.block_hash)
+        epoch = state.slot // preset.slots_per_epoch
+        prev_randao = bytes(
+            state.randao_mixes[epoch % preset.epochs_per_historical_vector]
+        )
+        number = state.latest_execution_payload_header.block_number + 1
+        block_hash = hashlib.sha256(
+            b"mock-el" + parent + number.to_bytes(8, "little")
+        ).digest()
+        from ..consensus.state_processing.per_block import (
+            compute_timestamp_at_slot,
+            get_expected_withdrawals,
+        )
+
+        kwargs = dict(
+            parent_hash=parent,
+            fee_recipient=bytes(20),
+            state_root=hashlib.sha256(b"el-state" + block_hash).digest(),
+            receipts_root=bytes(32),
+            prev_randao=prev_randao,
+            block_number=number,
+            gas_limit=30_000_000,
+            gas_used=0,
+            timestamp=compute_timestamp_at_slot(state, state.slot, spec),
+            base_fee_per_gas=7,
+            block_hash=block_hash,
+            transactions=[],
+        )
+        if "withdrawals" in payload_cls._fields:
+            kwargs["withdrawals"] = get_expected_withdrawals(state, spec)
+        if "blob_gas_used" in payload_cls._fields:
+            kwargs["blob_gas_used"] = 0
+            kwargs["excess_blob_gas"] = 0
+        return payload_cls(**kwargs)
 
 
 @dataclass

@@ -274,7 +274,7 @@ def run_lcli(args) -> int:
         spec = phase0_spec(S.PRESETS[args.spec])
         state, _ = interop_state(args.validators, spec, fork="altair")
         t0 = _t.perf_counter()
-        process_slots(state, args.slots, spec)
+        state = process_slots(state, args.slots, spec)
         dt = _t.perf_counter() - t0
         print(json.dumps({
             "slots": args.slots,

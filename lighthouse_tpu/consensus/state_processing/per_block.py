@@ -28,6 +28,11 @@ from .arrays import (
     WEIGHT_DENOMINATOR,
 )
 from . import signature_sets as sets
+from .forks import (
+    fork_at_least,
+    min_slashing_penalty_quotient,
+    state_fork_name,
+)
 
 
 class BlockProcessingError(Exception):
@@ -61,6 +66,15 @@ def process_block(
         get_pubkey = pubkey_getter(state)
 
     process_block_header(state, block, spec)
+    # bellatrix+ execution pipeline (per_block_processing.rs:169-175 order:
+    # withdrawals before the payload, both before randao), gated on
+    # is_execution_enabled exactly as the spec gates both steps pre-merge
+    if hasattr(block.body, "execution_payload") and is_execution_enabled(
+        state, block.body
+    ):
+        if hasattr(state, "next_withdrawal_index"):
+            process_withdrawals(state, block.body.execution_payload, spec)
+        process_execution_payload(state, block.body, spec)
     process_randao(state, block, spec, verify_signatures, get_pubkey)
     process_eth1_data(state, block.body, spec)
     process_operations(
@@ -150,6 +164,9 @@ def process_operations(
         process_deposit(state, dep, spec)
     for ex in body.voluntary_exits:
         process_voluntary_exit(state, ex, spec, verify_signatures, get_pubkey)
+    if hasattr(body, "bls_to_execution_changes"):
+        for ch in body.bls_to_execution_changes:
+            process_bls_to_execution_change(state, ch, spec, verify_signatures)
 
 
 # ---------------------------------------------------------------------------
@@ -174,18 +191,14 @@ def slash_validator(
     s = list(state.slashings)
     s[epoch % preset.epochs_per_slashings_vector] += v.effective_balance
     state.slashings = s
-    is_base = hasattr(state, "previous_epoch_attestations")
-    if is_base:
-        # phase0 MIN_SLASHING_PENALTY_QUOTIENT = 128
-        penalty = v.effective_balance // preset.min_slashing_penalty_quotient
-    else:
-        # altair MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR = 64 (= phase0 128 / 2)
-        penalty = v.effective_balance // (preset.min_slashing_penalty_quotient // 2)
+    fork = state_fork_name(state)
+    # 128 (phase0) → 64 (altair) → 32 (bellatrix+), chain_spec.rs quotients
+    penalty = v.effective_balance // min_slashing_penalty_quotient(fork, preset)
     _decrease_balance(state, slashed_index, penalty)
     proposer = get_beacon_proposer_index(state, state.slot, preset)
     whistleblower = whistleblower if whistleblower is not None else proposer
     wb_reward = v.effective_balance // preset.whistleblower_reward_quotient
-    if is_base:
+    if fork == "base":
         proposer_reward = wb_reward // preset.proposer_reward_quotient
     else:
         proposer_reward = wb_reward * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR
@@ -311,7 +324,12 @@ def get_attestation_participation_flags(
 
     if is_matching_source and inclusion_delay <= math.isqrt(preset.slots_per_epoch):
         flags.append(TIMELY_SOURCE_FLAG_INDEX)
-    if is_matching_target:  # deneb: no inclusion-delay cap on target
+    # deneb (EIP-7045) drops the inclusion-delay cap on the target flag;
+    # altair..capella keep the one-epoch window.
+    target_in_window = fork_at_least(state_fork_name(state), "deneb") or (
+        inclusion_delay <= preset.slots_per_epoch
+    )
+    if is_matching_target and target_in_window:
         flags.append(TIMELY_TARGET_FLAG_INDEX)
     if is_matching_head and inclusion_delay == spec.min_attestation_inclusion_delay:
         flags.append(TIMELY_HEAD_FLAG_INDEX)
@@ -500,6 +518,206 @@ def process_voluntary_exit(state, signed_exit, spec, verify_signatures, get_pubk
         s = sets.exit_signature_set(state, get_pubkey, signed_exit, spec)
         _err(s.verify(), "exit signature invalid")
     _initiate_validator_exit(state, exit_msg.validator_index, spec)
+
+
+# ---------------------------------------------------------------------------
+# Execution payloads + withdrawals (bellatrix → deneb)
+# ---------------------------------------------------------------------------
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def _default_root(cls) -> bytes:
+    """hash_tree_root of a default instance — a per-class constant on the
+    block-import hot path (merge-complete / empty-payload detection)."""
+    return cls().root()
+
+
+def is_merge_transition_complete(state) -> bool:
+    """bellatrix helper: the state has seen a real payload (its stored
+    header differs from the default instance)."""
+    header = state.latest_execution_payload_header
+    return header.root() != _default_root(type(header))
+
+
+def is_execution_enabled(state, body) -> bool:
+    """bellatrix is_execution_enabled: merge complete, or this block IS the
+    merge-transition block (carries a non-default payload)."""
+    if is_merge_transition_complete(state):
+        return True
+    payload = body.execution_payload
+    return payload.root() != _default_root(type(payload))
+
+
+def compute_timestamp_at_slot(state, slot: int, spec: ChainSpec) -> int:
+    return state.genesis_time + slot * spec.seconds_per_slot
+
+
+def process_execution_payload(state, body, spec: ChainSpec) -> None:
+    """per_block_processing.rs:410 partially_verify_execution_payload +
+    header assignment.  The EL validity verdict (notify_new_payload) is the
+    chain pipeline's job (beacon/execution.py) — this is the consensus
+    portion: parent linkage, randao, timestamp, blob-count gate, header
+    update."""
+    preset = spec.preset
+    payload = body.execution_payload
+    if is_merge_transition_complete(state):
+        _err(
+            bytes(payload.parent_hash)
+            == bytes(state.latest_execution_payload_header.block_hash),
+            "payload parent_hash does not chain to the stored header",
+        )
+    elif payload.root() == _default_root(type(payload)):
+        # pre-merge bellatrix block with an empty (default) payload:
+        # execution is not yet enabled, nothing to process.
+        return
+    epoch = _current_epoch(state, preset)
+    _err(
+        bytes(payload.prev_randao)
+        == bytes(state.randao_mixes[epoch % preset.epochs_per_historical_vector]),
+        "payload prev_randao mismatch",
+    )
+    _err(
+        payload.timestamp == compute_timestamp_at_slot(state, state.slot, spec),
+        "payload timestamp mismatch",
+    )
+    if hasattr(body, "blob_kzg_commitments"):
+        _err(
+            len(body.blob_kzg_commitments) <= preset.max_blobs_per_block,
+            "too many blob kzg commitments",
+        )
+    state.latest_execution_payload_header = _header_from_payload(state, payload)
+
+
+def _header_from_payload(state, payload):
+    """ExecutionPayloadHeader::from(payload): copy scalars, root the lists."""
+    header_cls = type(state.latest_execution_payload_header)
+    payload_fields = type(payload)._fields
+    kwargs = {}
+    for name in header_cls._fields:
+        if name == "transactions_root":
+            kwargs[name] = payload_fields["transactions"].hash_tree_root(
+                payload.transactions
+            )
+        elif name == "withdrawals_root":
+            kwargs[name] = payload_fields["withdrawals"].hash_tree_root(
+                payload.withdrawals
+            )
+        else:
+            kwargs[name] = getattr(payload, name)
+    return header_cls(**kwargs)
+
+
+def has_eth1_withdrawal_credential(validator) -> bool:
+    return bytes(validator.withdrawal_credentials)[:1] == b"\x01"
+
+
+def is_fully_withdrawable_validator(validator, balance: int, epoch: int) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.withdrawable_epoch <= epoch
+        and balance > 0
+    )
+
+
+def is_partially_withdrawable_validator(validator, balance: int, spec) -> bool:
+    return (
+        has_eth1_withdrawal_credential(validator)
+        and validator.effective_balance == spec.max_effective_balance
+        and balance > spec.max_effective_balance
+    )
+
+
+def get_expected_withdrawals(state, spec: ChainSpec) -> list:
+    """capella get_expected_withdrawals: a bounded sweep over the registry
+    from next_withdrawal_validator_index (per_block_processing.rs:545 twin)."""
+    from ..containers import Withdrawal
+
+    preset = spec.preset
+    epoch = _current_epoch(state, preset)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    n = len(state.validators)
+    withdrawals = []
+    for _ in range(min(n, preset.max_validators_per_withdrawals_sweep)):
+        v = state.validators[validator_index]
+        balance = state.balances[validator_index]
+        if is_fully_withdrawable_validator(v, balance, epoch):
+            withdrawals.append(
+                Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance,
+                )
+            )
+            withdrawal_index += 1
+        elif is_partially_withdrawable_validator(v, balance, spec):
+            withdrawals.append(
+                Withdrawal(
+                    index=withdrawal_index,
+                    validator_index=validator_index,
+                    address=bytes(v.withdrawal_credentials)[12:],
+                    amount=balance - spec.max_effective_balance,
+                )
+            )
+            withdrawal_index += 1
+        if len(withdrawals) == preset.max_withdrawals_per_payload:
+            break
+        validator_index = (validator_index + 1) % n
+    return withdrawals
+
+
+def process_withdrawals(state, payload, spec: ChainSpec) -> None:
+    """capella process_withdrawals: the payload's withdrawals must equal the
+    state's expected list; balances decrease; sweep cursors advance."""
+    preset = spec.preset
+    expected = get_expected_withdrawals(state, spec)
+    got = list(payload.withdrawals)
+    _err(len(got) == len(expected), "withdrawal count mismatch")
+    for w_got, w_exp in zip(got, expected):
+        _err(w_got.root() == w_exp.root(), "withdrawal mismatch")
+    for w in expected:
+        _decrease_balance(state, w.validator_index, w.amount)
+    if expected:
+        state.next_withdrawal_index = expected[-1].index + 1
+    n = len(state.validators)
+    if len(expected) == preset.max_withdrawals_per_payload:
+        # full payload: resume right after the last withdrawn validator
+        state.next_withdrawal_validator_index = (
+            expected[-1].validator_index + 1
+        ) % n
+    else:
+        # sweep exhausted: jump the cursor a full sweep ahead
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + preset.max_validators_per_withdrawals_sweep
+        ) % n
+
+
+def process_bls_to_execution_change(
+    state, signed_change, spec: ChainSpec, verify_signatures: bool = True
+) -> None:
+    """capella process_bls_to_execution_change: rotate 0x00 BLS withdrawal
+    credentials to a 0x01 execution address (signature over the GENESIS
+    domain — signature_sets.rs:580)."""
+    change = signed_change.message
+    _err(change.validator_index < len(state.validators), "unknown validator")
+    v = state.validators[change.validator_index]
+    wc = bytes(v.withdrawal_credentials)
+    _err(wc[:1] == b"\x00", "credentials are not BLS (0x00) form")
+    _err(
+        wc[1:] == sha256(bytes(change.from_bls_pubkey))[1:],
+        "withdrawal credentials do not commit to this pubkey",
+    )
+    if verify_signatures:
+        s = sets.bls_execution_change_signature_set(state, signed_change, spec)
+        _err(s.verify(), "bls-to-execution-change signature invalid")
+    v.withdrawal_credentials = (
+        b"\x01" + bytes(11) + bytes(change.to_execution_address)
+    )
 
 
 def process_sync_aggregate(state, aggregate, spec, verify_signatures, get_pubkey):

@@ -28,6 +28,11 @@ from .arrays import (
     WEIGHT_DENOMINATOR,
     ValidatorArrays,
 )
+from .forks import (
+    inactivity_penalty_quotient,
+    proportional_slashing_multiplier,
+    state_fork_name,
+)
 
 
 def _flags(state, which: str, n: int) -> np.ndarray:
@@ -89,6 +94,7 @@ def process_epoch_altair(state, spec: ChainSpec, device: bool | None = None) -> 
     process_justification_and_finalization(
         state, va, prev_flags, curr_flags, current, previous, spec
     )
+    fork = state_fork_name(state)
     if device and current > 0:
         from .per_epoch_jax import epoch_balance_pipeline
 
@@ -102,6 +108,8 @@ def process_epoch_altair(state, spec: ChainSpec, device: bool | None = None) -> 
             state.finalized_checkpoint.epoch,
             int(np.asarray(state.slashings, dtype=np.int64).sum()),
             spec,
+            multiplier=proportional_slashing_multiplier(fork, preset),
+            inactivity_quotient=inactivity_penalty_quotient(fork, preset),
         )
         state.inactivity_scores = [int(s) for s in new_scores]
         va.balances = balances
@@ -114,7 +122,10 @@ def process_epoch_altair(state, spec: ChainSpec, device: bool | None = None) -> 
             state, va, prev_flags, current, previous, spec
         )
         process_registry_updates(state, va, current, spec)
-        process_slashings(state, va, current, spec)
+        process_slashings(
+            state, va, current, spec,
+            multiplier=proportional_slashing_multiplier(fork, preset),
+        )
         process_eth1_data_reset(state, current, preset)
         process_effective_balance_updates(va, spec)
     process_slashings_reset(state, current, preset)
@@ -258,12 +269,15 @@ def process_rewards_and_penalties(state, va, prev_flags, current, previous, spec
         delta += np.where(eligible & participated, rewards, 0)
         delta -= np.where(eligible & ~participated, penalties, 0)
 
-    # inactivity penalties (altair: score-scaled quadratic leak)
+    # inactivity penalties (altair: score-scaled quadratic leak; the
+    # quotient drops 3·2^24 → 2^24 at bellatrix, chain_spec.rs)
     scores = _scores_array(state, len(delta))
     target_ok = _unslashed_participating(
         va, prev_flags, TIMELY_TARGET_FLAG_INDEX, previous
     )
-    penalty_den = preset.inactivity_score_bias * preset.inactivity_penalty_quotient
+    penalty_den = preset.inactivity_score_bias * inactivity_penalty_quotient(
+        state_fork_name(state), preset
+    )
     inactivity_pen = (va.effective_balance * scores) // penalty_den
     delta -= np.where(eligible & ~target_ok, inactivity_pen, 0)
 
@@ -340,7 +354,8 @@ def _initiate_exit(va, index: int, current: int, spec) -> None:
 
 def process_slashings(state, va, current, spec, multiplier: int = 2):
     """slashings.rs: proportional penalty at the halfway point.
-    ``multiplier`` scales the phase0 base (1): altair 2, bellatrix+ 3."""
+    ``multiplier`` IS the full proportional multiplier relative to the
+    preset base: phase0 1, altair 2, bellatrix+ 3 (forks.py)."""
     preset = spec.preset
     epoch_to_penalize = current + preset.epochs_per_slashings_vector // 2
     targeted = va.slashed & (va.withdrawable_epoch == epoch_to_penalize)

@@ -165,6 +165,7 @@ def kernel_inputs(
     total_slashings: int,
     spec,
     multiplier: int = 2,
+    inactivity_quotient: int | None = None,
 ) -> tuple[list, dict]:
     """Marshal host state into the kernel's (positional, static) arguments —
     the ONE place the scalar prep (base reward per increment, leak flag,
@@ -198,7 +199,11 @@ def kernel_inputs(
     static = dict(
         inactivity_score_bias=preset.inactivity_score_bias,
         inactivity_score_recovery_rate=preset.inactivity_score_recovery_rate,
-        inactivity_penalty_quotient=preset.inactivity_penalty_quotient,
+        inactivity_penalty_quotient=(
+            inactivity_quotient
+            if inactivity_quotient is not None
+            else preset.inactivity_penalty_quotient
+        ),
         effective_balance_increment=incr,
         max_effective_balance=spec.max_effective_balance,
     )
@@ -215,6 +220,7 @@ def epoch_balance_pipeline(
     total_slashings: int,
     spec,
     multiplier: int = 2,
+    inactivity_quotient: int | None = None,
 ):
     """Run the fused device pipeline; returns (balances, scores, eff_bal)
     as numpy arrays.  Mirrors the order inactivity→rewards→slashings→
@@ -222,7 +228,7 @@ def epoch_balance_pipeline(
     kernel = _build_kernel()
     positional, static = kernel_inputs(
         va, prev_flags, scores, current, previous, finalized_epoch,
-        total_slashings, spec, multiplier,
+        total_slashings, spec, multiplier, inactivity_quotient,
     )
     out = kernel(*positional, **static)
     return tuple(np.asarray(x) for x in out)

@@ -28,9 +28,13 @@ def process_slot(state, spec: ChainSpec) -> None:
     state.block_roots = br
 
 
-def process_slots(state, target_slot: int, spec: ChainSpec) -> None:
+def process_slots(state, target_slot: int, spec: ChainSpec):
     """per_slot_processing: advance to target_slot, epoch work on
-    boundaries."""
+    boundaries, fork upgrades at scheduled epochs.  Returns the state —
+    a fork upgrade swaps the container class, so callers must re-bind
+    (`state = process_slots(state, ...)`)."""
+    from .upgrades import upgrade_state_at_epoch
+
     if target_slot < state.slot:
         raise BlockProcessingError(
             f"cannot rewind: state at {state.slot}, target {target_slot}"
@@ -41,6 +45,11 @@ def process_slots(state, target_slot: int, spec: ChainSpec) -> None:
         if (state.slot + 1) % preset.slots_per_epoch == 0:
             process_epoch(state, spec)
         state.slot += 1
+        if state.slot % preset.slots_per_epoch == 0:
+            state = upgrade_state_at_epoch(
+                state, state.slot // preset.slots_per_epoch, spec
+            )
+    return state
 
 
 def state_transition(
@@ -49,12 +58,14 @@ def state_transition(
     spec: ChainSpec,
     verify_signatures: bool = True,
     verify_state_root: bool = True,
-) -> None:
-    """The spec's state_transition: slots -> block -> state-root check."""
+):
+    """The spec's state_transition: slots -> block -> state-root check.
+    Returns the post-state (re-bound across fork upgrades)."""
     block = signed_block.message
-    process_slots(state, block.slot, spec)
+    state = process_slots(state, block.slot, spec)
     process_block(
         state, signed_block, spec, verify_signatures=verify_signatures
     )
     if verify_state_root and bytes(block.state_root) != state.root():
         raise BlockProcessingError("post-state root mismatch")
+    return state

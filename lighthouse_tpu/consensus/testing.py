@@ -77,12 +77,18 @@ def interop_state(
         for _, pk in keypairs
     ]
     state_cls = T.BeaconState_BY_FORK[fork]
+    # A genesis state at a scheduled fork carries that fork's version (the
+    # reference harness does the same when spawning e.g. a bellatrix-genesis
+    # chain); forks-off specs keep the genesis version everywhere.
+    version = spec.genesis_fork_version
+    if fork != "base" and getattr(spec, f"{fork}_fork_epoch", None) is not None:
+        version = getattr(spec, f"{fork}_fork_version")
     state = state_cls(
         genesis_time=spec.min_genesis_time,
         slot=0,
         fork=Fork(
-            previous_version=spec.genesis_fork_version,
-            current_version=spec.genesis_fork_version,
+            previous_version=version,
+            current_version=version,
             epoch=0,
         ),
         latest_block_header=BeaconBlockHeader(),

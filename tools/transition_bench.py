@@ -79,7 +79,7 @@ def main() -> None:
         cache = cm.CommitteeCache(state, slot // spec.preset.slots_per_epoch,
                                   spec.preset)
     with timed("per_slot_processing"):
-        process_slots(state, slot, spec)
+        state = process_slots(state, slot, spec)
     with timed("tree_hash_state_root"):
         state.root()
     with timed("batch_signature_verify"):
