@@ -52,6 +52,19 @@ class BlockError(ChainError):
     pass
 
 
+class AvailabilityPendingError(BlockError):
+    """A deneb block whose committed blobs have not all arrived/verified —
+    the caller parks it (reprocess queue) instead of rejecting it
+    (data_availability_checker.rs Availability::MissingComponents)."""
+
+    def __init__(self, block_root: bytes, missing: list[int]):
+        super().__init__(
+            f"block {block_root.hex()[:8]} awaiting blobs {missing}"
+        )
+        self.block_root = block_root
+        self.missing = missing
+
+
 @dataclass
 class ChainConfig:
     state_cache_size: int = 8
@@ -93,6 +106,12 @@ class BeaconChain:
         # anything with new_payload()/build_payload() — EngineApiClient or
         # MockExecutionEngine (execution.py)
         self.execution = execution
+        # deneb data availability (beacon_chain.rs:486 data_availability_checker)
+        from .blobs import DataAvailabilityChecker
+
+        self.da_checker = DataAvailabilityChecker(
+            setup=getattr(execution, "kzg_setup", None)
+        )
         self.store = store or HotColdDB(types_family=self.types)
         self.log = get_logger("beacon_chain")
         self.slot_clock = slot_clock
@@ -219,6 +238,16 @@ class BeaconChain:
                     raise BlockError("execution engine rejected payload")
                 # SYNCING/ACCEPTED: optimistic import, same as the
                 # reference's optimistic-sync path
+        # --- data availability gate (deneb) --------------------------------
+        commitments = list(getattr(block.body, "blob_kzg_commitments", []))
+        if commitments:
+            missing = self.da_checker.missing_indices(block_root, commitments)
+            if missing:
+                raise AvailabilityPendingError(block_root, missing)
+            if not self.da_checker.verify_batch(block_root, commitments):
+                raise BlockError("blob kzg batch verification failed")
+            for sc in self.da_checker.get(block_root):
+                self.store.put_blob(block_root, int(sc.index), sc)
         # --- import: fork choice + store + caches --------------------------
         jc = state.current_justified_checkpoint
         fc = state.finalized_checkpoint
@@ -300,6 +329,33 @@ class BeaconChain:
         self.op_pool.insert_attestation(attestation)
         ATTS_PROCESSED.inc()
 
+    # ------------------------------------------------------------- blobs
+
+    def process_blob_sidecar(self, sidecar) -> bytes:
+        """Gossip blob ladder (blob_verification.rs GossipVerifiedBlob):
+        verify then record in the availability checker.  Returns the block
+        root the sidecar belongs to."""
+        from .blobs import verify_blob_sidecar_for_gossip
+
+        state = self.head_state()
+        verify_blob_sidecar_for_gossip(
+            sidecar,
+            self.spec,
+            self.get_pubkey,
+            state.fork,
+            bytes(state.genesis_validators_root),
+            setup=self.da_checker.setup,
+        )
+        return self.da_checker.put_sidecar(sidecar)
+
+    def blobs_bundle_for(self, block_hash: bytes):
+        """(commitments, proofs, blobs) the EL bundled with a produced
+        payload (engine_getPayload's BlobsBundle), or None."""
+        if self.execution is None:
+            return None
+        getter = getattr(self.execution, "get_blobs_bundle", None)
+        return getter(block_hash) if getter is not None else None
+
     # --------------------------------------------------------------- head
 
     def recompute_head(self) -> bytes:
@@ -354,9 +410,12 @@ class BeaconChain:
         )
         if "execution_payload" in body_cls._fields and self.execution is not None:
             payload_cls = body_cls._fields["execution_payload"].cls
-            body_kwargs["execution_payload"] = self.execution.build_payload(
-                state, self.spec, payload_cls
-            )
+            payload = self.execution.build_payload(state, self.spec, payload_cls)
+            body_kwargs["execution_payload"] = payload
+            if "blob_kzg_commitments" in body_cls._fields:
+                bundle = self.blobs_bundle_for(bytes(payload.block_hash))
+                if bundle is not None:
+                    body_kwargs["blob_kzg_commitments"] = list(bundle[0])
         body = body_cls(**body_kwargs)
         block_cls = self.types.BeaconBlock_BY_FORK[fork_now]
         block = block_cls(

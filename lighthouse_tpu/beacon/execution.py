@@ -157,11 +157,29 @@ class MockExecutionEngine:
     hashes INVALID to drive the payload-invalidation path
     (beacon_chain/tests/payload_invalidation.rs pattern)."""
 
-    def __init__(self):
+    def __init__(self, blobs_per_block: int = 0):
         self.invalid_hashes: set[bytes] = set()
         self.syncing = False
         self.calls: list[tuple[str, object]] = []
         self._head: bytes = b"\x00" * 32
+        # deneb: blobs bundled with produced payloads (get_payload's
+        # BlobsBundle — commitments, proofs, blobs — keyed by block hash)
+        self.blobs_per_block = blobs_per_block
+        self._bundles: dict[bytes, tuple[list, list, list]] = {}
+
+    @property
+    def kzg_setup(self):
+        """Known-tau dev setup when this mock serves blobs (lazy: building
+        it costs ~25 s once per process), else None."""
+        if self.blobs_per_block <= 0:
+            return None
+        from ..crypto.kzg.kzg import dev_setup
+
+        return dev_setup()
+
+    def get_blobs_bundle(self, block_hash: bytes):
+        """(commitments, proofs, blobs) for a produced payload, or None."""
+        return self._bundles.get(bytes(block_hash))
 
     def inject_invalid(self, block_hash: bytes) -> None:
         self.invalid_hashes.add(block_hash)
@@ -218,7 +236,30 @@ class MockExecutionEngine:
         if "blob_gas_used" in payload_cls._fields:
             kwargs["blob_gas_used"] = 0
             kwargs["excess_blob_gas"] = 0
+            if self.blobs_per_block > 0:
+                self._bundles[block_hash] = self._make_bundle(number)
         return payload_cls(**kwargs)
+
+    def _make_bundle(self, block_number: int):
+        """Deterministic canonical blobs + commitments + proofs."""
+        from ..crypto.kzg import kzg as K
+
+        setup = self.kzg_setup
+        blobs, commitments, proofs = [], [], []
+        for i in range(self.blobs_per_block):
+            seed = block_number * 64 + i
+            blob = b"".join(
+                b"\x00" + hashlib.sha256(
+                    seed.to_bytes(8, "big") + j.to_bytes(4, "big")
+                ).digest()[:31]
+                for j in range(K.FIELD_ELEMENTS_PER_BLOB)
+            )
+            c = K.blob_to_kzg_commitment(blob, setup)
+            p = K.compute_blob_kzg_proof(blob, c, setup)
+            blobs.append(blob)
+            commitments.append(c)
+            proofs.append(p)
+        return commitments, proofs, blobs
 
 
 @dataclass

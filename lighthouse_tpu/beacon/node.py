@@ -47,6 +47,7 @@ class BeaconNode:
         udp_port: int | None = None,
         store=None,
         slasher: bool = False,
+        execution=None,
     ):
         self.spec = spec
         self.fork = fork
@@ -54,7 +55,9 @@ class BeaconNode:
         self.block_cls = self.types.SignedBeaconBlock_BY_FORK[fork]
         self.keypairs = keypairs or []
         # 1. chain over the (optional) store
-        self.chain = BeaconChain(spec, genesis_state.copy(), store, fork=fork)
+        self.chain = BeaconChain(
+            spec, genesis_state.copy(), store, fork=fork, execution=execution
+        )
         self.digest = topics_mod.fork_digest(
             spec, 0, bytes(genesis_state.genesis_validators_root)
         )
@@ -87,6 +90,16 @@ class BeaconNode:
         # 3. gossip subscriptions -> chain
         self.host.subscribe(self.block_topic, self._on_gossip_block)
         self.host.subscribe(self.attestation_topic, self._on_gossip_aggregate)
+        # deneb blob sidecar subnets (topics.rs:107 blob_sidecar_{index})
+        self.blob_topics = [
+            topics_mod.blob_sidecar_topic(i, self.digest)
+            for i in range(spec.preset.max_blobs_per_block)
+        ]
+        for t in self.blob_topics:
+            self.host.subscribe(t, self._on_gossip_blob)
+        # blocks parked awaiting blob availability (reprocess-queue analog
+        # for Availability::MissingComponents)
+        self._pending_availability: dict[bytes, object] = {}
         # 4. req/resp handlers
         self.host.rpc_handlers["status"] = self._on_status
         self.host.rpc_handlers["ping"] = lambda req, pid: (
@@ -98,6 +111,8 @@ class BeaconNode:
         )
         self.host.rpc_handlers["beacon_blocks_by_range"] = self._on_blocks_by_range
         self.host.rpc_handlers["beacon_blocks_by_root"] = self._on_blocks_by_root
+        self.host.rpc_handlers["blob_sidecars_by_range"] = self._on_blobs_by_range
+        self.host.rpc_handlers["blob_sidecars_by_root"] = self._on_blobs_by_root
         # 5. HTTP API
         self.api = BeaconApiServer(self.chain, port=http_port)
         self._dialed: set[bytes] = set()
@@ -228,6 +243,19 @@ class BeaconNode:
                         self.chain.process_block(block)
                     imported += 1
                 except Exception as exc:  # noqa: BLE001
+                    from .chain import AvailabilityPendingError
+
+                    if isinstance(exc, AvailabilityPendingError):
+                        # deneb: pull the committed blobs from the same
+                        # peer, then retry the import once
+                        if self._fetch_blobs_for_block(conn, block):
+                            try:
+                                with self._chain_lock:
+                                    self.chain.process_block(block)
+                                imported += 1
+                                continue
+                            except Exception as rexc:  # noqa: BLE001
+                                log.debug("post-blob import: %s", rexc)
                     log.debug("range-sync import: %s", exc)
             if imported == 0:
                 return  # peer has nothing more for us (or all invalid)
@@ -258,6 +286,88 @@ class BeaconNode:
                     rpc_mod.SUCCESS, blk.encode()
                 )
         return rpc_mod.RAW_CHUNKS, out
+
+    def _on_blobs_by_range(self, req: bytes, peer_id):
+        """Serve blob sidecars for canonical blocks in a slot range
+        (rpc_methods.rs BlobsByRange)."""
+        r = rpc_mod.BlobsByRangeRequest.deserialize_value(req)
+        out = b""
+        served = 0
+        for slot in range(int(r.start_slot), int(r.start_slot) + int(r.count)):
+            root = self._canonical_root_at_slot(slot)
+            if root is None:
+                continue
+            for sc in self.chain.store.get_blobs(
+                root, self.spec.preset.max_blobs_per_block
+            ):
+                out += rpc_mod.encode_response_chunk(rpc_mod.SUCCESS, sc.encode())
+                served += 1
+                if served >= 128:
+                    return rpc_mod.RAW_CHUNKS, out
+        return rpc_mod.RAW_CHUNKS, out
+
+    def _canonical_root_at_slot(self, slot: int):
+        """Canonical block root at a slot via the head state's history
+        (the same walk serve_blocks_by_range does)."""
+        head = self.chain.head_state()
+        if slot > int(head.slot):
+            return None
+        if slot == int(head.slot):
+            return self.chain.head_root
+        return bytes(
+            head.block_roots[slot % self.spec.preset.slots_per_historical_root]
+        )
+
+    def _on_blobs_by_root(self, req: bytes, peer_id):
+        """Serve sidecars addressed by BlobIdentifier(block_root, index)."""
+        from ..consensus.ssz import SSZList
+        from ..consensus.containers import F as _F  # noqa: N814
+
+        ids_t = SSZList(_F(rpc_mod.BlobIdentifier), 1024)
+        out = b""
+        for ident in ids_t.deserialize(req)[:128]:
+            root = bytes(ident.block_root)
+            want = int(ident.index)
+            # the store first, then the availability checker (pre-import)
+            sidecars = self.chain.store.get_blobs(
+                root, self.spec.preset.max_blobs_per_block
+            ) or self.chain.da_checker.get(root)
+            for sc in sidecars:
+                if int(sc.index) == want:
+                    out += rpc_mod.encode_response_chunk(
+                        rpc_mod.SUCCESS, sc.encode()
+                    )
+        return rpc_mod.RAW_CHUNKS, out
+
+    def _fetch_blobs_for_block(self, conn, block) -> bool:
+        """Availability recovery during sync: BlobsByRoot for every
+        committed index, feed the checker.  True if all arrived."""
+        from ..consensus.ssz import SSZList
+        from ..consensus.containers import F as _F  # noqa: N814
+
+        commitments = list(getattr(block.message.body, "blob_kzg_commitments", []))
+        if not commitments:
+            return True
+        root = block.message.root()
+        ids_t = SSZList(_F(rpc_mod.BlobIdentifier), 1024)
+        req = ids_t.serialize(
+            [
+                rpc_mod.BlobIdentifier(block_root=root, index=i)
+                for i in range(len(commitments))
+            ]
+        )
+        chunks = conn.request_multi("blob_sidecars_by_root", req, timeout=10.0)
+        for code, ssz in chunks:
+            if code != rpc_mod.SUCCESS:
+                continue
+            try:
+                sc = self.types.BlobSidecar.deserialize_value(ssz)
+                with self._chain_lock:
+                    self.chain.process_blob_sidecar(sc)
+            except Exception as exc:  # noqa: BLE001
+                log.debug("fetched blob rejected: %s", exc)
+        with self._chain_lock:
+            return not self.chain.da_checker.missing_indices(root, commitments)
 
     def _parent_lookup(self, conn, block, max_depth: int = 32,
                        budget_secs: float = 30.0) -> bool:
@@ -379,9 +489,15 @@ class BeaconNode:
                     self.chain.head_state().slot
                 ):
                     block = self.chain.produce_block(slot, self.keypairs)
-                    self.chain.process_block(block)
                 else:
                     block = None
+            if block is not None:
+                # sidecars feed the own-node availability checker before
+                # the import gate sees the commitments
+                self.publish_blob_sidecars(block)
+                with self._chain_lock:
+                    self.chain.process_block(block)
+            with self._chain_lock:
                 self.chain.recompute_head()
             if block is not None:
                 self.publish_block(block)
@@ -394,6 +510,8 @@ class BeaconNode:
     # -- gossip ------------------------------------------------------------
 
     def _on_gossip_block(self, payload: bytes, peer_id) -> str:
+        from .chain import AvailabilityPendingError
+
         try:
             block = self.block_cls.deserialize_value(payload)
         except Exception:  # noqa: BLE001
@@ -403,6 +521,11 @@ class BeaconNode:
                 self.chain.process_block(block)
             self._feed_slasher_header(block)
             return "accept"
+        except AvailabilityPendingError as pend:
+            # park until the committed blobs arrive over gossip
+            # (work_reprocessing_queue semantics for missing components)
+            self._pending_availability[pend.block_root] = block
+            return "ignore"
         except Exception as exc:  # noqa: BLE001
             if "unknown parent" in str(exc):
                 conn = self.host.connections.get(peer_id)
@@ -478,8 +601,63 @@ class BeaconNode:
             log.debug("gossip aggregate dropped: %s", exc)
             return "ignore"
 
+    def _on_gossip_blob(self, payload: bytes, peer_id) -> str:
+        """blob_sidecar_{i} topic -> gossip verification -> availability
+        checker; retries any block parked on this sidecar's root."""
+        try:
+            sidecar = self.types.BlobSidecar.deserialize_value(payload)
+        except Exception:  # noqa: BLE001
+            return "reject"
+        try:
+            with self._chain_lock:
+                root = self.chain.process_blob_sidecar(sidecar)
+        except Exception as exc:  # noqa: BLE001
+            log.debug("gossip blob rejected: %s", exc)
+            return "reject"
+        self._retry_pending_availability(root)
+        return "accept"
+
+    def _retry_pending_availability(self, root: bytes) -> None:
+        block = self._pending_availability.get(root)
+        if block is None:
+            return
+        from .chain import AvailabilityPendingError
+
+        try:
+            with self._chain_lock:
+                self.chain.process_block(block)
+            self._pending_availability.pop(root, None)
+            self._feed_slasher_header(block)
+        except AvailabilityPendingError:
+            pass  # still missing some indices
+        except Exception as exc:  # noqa: BLE001
+            self._pending_availability.pop(root, None)
+            log.debug("parked block rejected on retry: %s", exc)
+
     def publish_block(self, signed_block) -> None:
         self.host.publish(self.block_topic, signed_block.encode())
+
+    def publish_blob_sidecars(self, signed_block) -> list:
+        """Build + publish this block's sidecars from the EL bundle
+        (produce path: blobs ride their index topics alongside the block)."""
+        body = signed_block.message.body
+        commitments = list(getattr(body, "blob_kzg_commitments", []))
+        if not commitments:
+            return []
+        bundle = self.chain.blobs_bundle_for(
+            bytes(body.execution_payload.block_hash)
+        )
+        if bundle is None:
+            return []
+        from .blobs import build_blob_sidecars
+
+        _, proofs, blobs = bundle
+        sidecars = build_blob_sidecars(signed_block, blobs, proofs, self.types)
+        for sc in sidecars:
+            with self._chain_lock:
+                self.chain.da_checker.put_sidecar(sc)  # own blobs: pre-verified
+            self.host.publish(self.blob_topics[int(sc.index)], sc.encode())
+        return sidecars
 
     def publish_aggregate(self, signed_aggregate) -> None:
         self.host.publish(self.attestation_topic, signed_aggregate.encode())
@@ -489,6 +667,10 @@ class BeaconNode:
     def produce_and_publish(self, slot: int):
         with self._chain_lock:
             block = self.chain.produce_block(slot, self.keypairs)
+        # sidecars first (they gate the block's import on receivers), then
+        # import + publish the block itself
+        self.publish_blob_sidecars(block)
+        with self._chain_lock:
             self.chain.process_block(block)
         self.publish_block(block)
         return block

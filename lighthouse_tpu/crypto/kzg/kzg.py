@@ -152,6 +152,18 @@ def mainnet_setup() -> TrustedSetup:
     return _MAINNET
 
 
+_DEV: TrustedSetup | None = None
+
+
+def dev_setup() -> TrustedSetup:
+    """Process-cached known-tau setup (building the 4096 Lagrange points
+    takes ~25 s; every test consumer shares one)."""
+    global _DEV
+    if _DEV is None:
+        _DEV = TrustedSetup.dev()
+    return _DEV
+
+
 # ---------------------------------------------------------------------------
 # Polynomial evaluation
 # ---------------------------------------------------------------------------

@@ -62,6 +62,25 @@ class BlocksByRangeRequest(Container):
     }
 
 
+class BlobsByRangeRequest(Container):
+    """protocol.rs:149-174 BlobsByRange (deneb)."""
+
+    fields = {
+        "start_slot": U64,
+        "count": U64,
+    }
+
+
+class BlobIdentifier(Container):
+    """types/src/blob_sidecar.rs BlobIdentifier — BlobsByRoot addresses a
+    single (block, index) pair."""
+
+    fields = {
+        "block_root": Root,
+        "index": U64,
+    }
+
+
 PROTOCOLS = {
     # name -> (version, request type or None, response type tag)
     "status": ("1", StatusMessage, StatusMessage),
@@ -70,6 +89,8 @@ PROTOCOLS = {
     "metadata": ("2", None, MetaData),
     "beacon_blocks_by_range": ("2", BlocksByRangeRequest, "signed_block"),
     "beacon_blocks_by_root": ("1", None, "signed_block"),
+    "blob_sidecars_by_range": ("1", BlobsByRangeRequest, "blob_sidecar"),
+    "blob_sidecars_by_root": ("1", None, "blob_sidecar"),
 }
 
 PROTOCOL_PREFIX = "/eth2/beacon_chain/req"
@@ -182,6 +203,8 @@ DEFAULT_LIMITS = {
     "metadata": (2, 0.5),
     "beacon_blocks_by_range": (1024, 100.0),
     "beacon_blocks_by_root": (128, 20.0),
+    "blob_sidecars_by_range": (768, 100.0),
+    "blob_sidecars_by_root": (128, 20.0),
     # gossipsub IWANT retransmission budget (ids/sec, not requests)
     "gossip_iwant": (256, 32.0),
 }

@@ -84,6 +84,25 @@ class HotColdDB:
             for c in (DBColumn.BEACON_BLOCK, DBColumn.COLD_BLOCK)
         )
 
+    # ------------------------------------------------------------- blobs
+
+    def put_blob(self, block_root: bytes, index: int, sidecar) -> None:
+        """Blob sidecars keyed (block_root, index) — store/src's blobs
+        column (DBColumn::BeaconBlob)."""
+        self.db.put(
+            DBColumn.BEACON_BLOB, block_root + bytes([index]), sidecar.encode()
+        )
+
+    def get_blobs(self, block_root: bytes, max_blobs: int = 16) -> list:
+        out = []
+        for i in range(max_blobs):
+            raw = self.db.get(DBColumn.BEACON_BLOB, block_root + bytes([i]))
+            if raw is None:
+                break
+            cls = self.types and self.types.BlobSidecar
+            out.append(cls.deserialize_value(raw) if cls else raw)
+        return out
+
     # ------------------------------------------------------------- states
 
     def put_state(self, state_root: bytes, state) -> None:

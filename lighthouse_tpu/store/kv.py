@@ -27,6 +27,7 @@ class DBColumn(Enum):
     ETH1_CACHE = b"e"
     COLD_BLOCK = b"B"
     COLD_STATE = b"S"
+    BEACON_BLOB = b"l"
 
 
 class KeyValueStore:
