@@ -112,6 +112,10 @@ class BeaconChain:
         self.da_checker = DataAvailabilityChecker(
             setup=getattr(execution, "kzg_setup", None)
         )
+        # sync-committee aggregation (the sync half of naive_aggregation_pool)
+        from .sync_committee import SyncContributionPool
+
+        self.sync_pool = SyncContributionPool(spec)
         self.store = store or HotColdDB(types_family=self.types)
         self.log = get_logger("beacon_chain")
         self.slot_clock = slot_clock
@@ -208,9 +212,29 @@ class BeaconChain:
         if verify_signatures:
             self.pubkey_cache.update(state)
             verifier = BlockSignatureVerifier(state, self.get_pubkey, self.spec)
+            sync_parts = None
+            prev_root = None
+            if hasattr(block.body, "sync_aggregate"):
+                from .sync_committee import sync_committee_indices
+
+                idxs = sync_committee_indices(state)
+                sync_parts = [
+                    vi
+                    for bit, vi in zip(
+                        block.body.sync_aggregate.sync_committee_bits, idxs
+                    )
+                    if bit
+                ]
+                prev_root = bytes(
+                    state.block_roots[
+                        (block.slot - 1) % self.preset.slots_per_historical_root
+                    ]
+                )
             verifier.include_all(
                 signed_block,
                 lambda e: cache if e == epoch else self.committee_cache(state, e),
+                sync_participants=sync_parts,
+                block_root_at_prev=prev_root,
             )
             if not verifier.verify():
                 raise BlockError("block signature verification failed")
@@ -348,6 +372,23 @@ class BeaconChain:
         )
         return self.da_checker.put_sidecar(sidecar)
 
+    # ----------------------------------------------------- sync committee
+
+    def process_sync_committee_message(self, msg, subnet_id: int) -> None:
+        """Gossip sync message ladder (sync_committee_verification.rs:290)
+        then into the aggregation pool."""
+        from .sync_committee import verify_sync_committee_message
+
+        verify_sync_committee_message(self, msg, subnet_id)
+        self.sync_pool.insert_message(msg, self.head_state())
+
+    def process_sync_contribution(self, signed) -> None:
+        """Gossip contribution ladder (:617 — the 3-set batch) then pool."""
+        from .sync_committee import verify_sync_contribution
+
+        verify_sync_contribution(self, signed)
+        self.sync_pool.insert_contribution(signed.message.contribution)
+
     def blobs_bundle_for(self, block_hash: bytes):
         """(commitments, proofs, blobs) the EL bundled with a produced
         payload (engine_getPayload's BlobsBundle), or None."""
@@ -408,6 +449,12 @@ class BeaconChain:
             attester_slashings=asl,
             voluntary_exits=exits,
         )
+        if "sync_aggregate" in body_cls._fields:
+            # pack the pool's contributions for the parent root (participants
+            # signed the PREVIOUS slot's head — altair/sync_committee.rs)
+            body_kwargs["sync_aggregate"] = self.sync_pool.get_sync_aggregate(
+                slot - 1, bytes(parent_root), self.types
+            )
         if "execution_payload" in body_cls._fields and self.execution is not None:
             payload_cls = body_cls._fields["execution_payload"].cls
             payload = self.execution.build_payload(state, self.spec, payload_cls)

@@ -90,6 +90,19 @@ class BeaconNode:
         # 3. gossip subscriptions -> chain
         self.host.subscribe(self.block_topic, self._on_gossip_block)
         self.host.subscribe(self.attestation_topic, self._on_gossip_aggregate)
+        # sync-committee subnets + contribution topic (topics.rs:107)
+        self.sync_subnet_topics = [
+            topics_mod.sync_subnet_topic(i, self.digest)
+            for i in range(spec.sync_committee_subnet_count)
+        ]
+        for i, t in enumerate(self.sync_subnet_topics):
+            self.host.subscribe(
+                t, lambda p, pid, subnet=i: self._on_gossip_sync_message(p, pid, subnet)
+            )
+        self.contribution_topic = topics_mod.topic(
+            "sync_committee_contribution_and_proof", self.digest
+        )
+        self.host.subscribe(self.contribution_topic, self._on_gossip_contribution)
         # deneb blob sidecar subnets (topics.rs:107 blob_sidecar_{index})
         self.blob_topics = [
             topics_mod.blob_sidecar_topic(i, self.digest)
@@ -633,6 +646,40 @@ class BeaconNode:
         except Exception as exc:  # noqa: BLE001
             self._pending_availability.pop(root, None)
             log.debug("parked block rejected on retry: %s", exc)
+
+    def _on_gossip_sync_message(self, payload: bytes, peer_id, subnet: int) -> str:
+        try:
+            msg = self.types.SyncCommitteeMessage.deserialize_value(payload)
+        except Exception:  # noqa: BLE001
+            return "reject"
+        try:
+            with self._chain_lock:
+                self.chain.process_sync_committee_message(msg, subnet)
+            return "accept"
+        except Exception as exc:  # noqa: BLE001
+            log.debug("gossip sync message dropped: %s", exc)
+            return "ignore"
+
+    def _on_gossip_contribution(self, payload: bytes, peer_id) -> str:
+        try:
+            signed = self.types.SignedContributionAndProof.deserialize_value(
+                payload
+            )
+        except Exception:  # noqa: BLE001
+            return "reject"
+        try:
+            with self._chain_lock:
+                self.chain.process_sync_contribution(signed)
+            return "accept"
+        except Exception as exc:  # noqa: BLE001
+            log.debug("gossip contribution dropped: %s", exc)
+            return "ignore"
+
+    def publish_sync_message(self, subnet: int, msg) -> None:
+        self.host.publish(self.sync_subnet_topics[subnet], msg.encode())
+
+    def publish_contribution(self, signed) -> None:
+        self.host.publish(self.contribution_topic, signed.encode())
 
     def publish_block(self, signed_block) -> None:
         self.host.publish(self.block_topic, signed_block.encode())

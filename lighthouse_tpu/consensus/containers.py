@@ -147,6 +147,15 @@ class SignedAggregateAndProof(Container):
     }
 
 
+class SyncAggregatorSelectionData(Container):
+    """altair sync aggregator selection (sync_selection_proof.rs)."""
+
+    fields = {
+        "slot": U64,
+        "subcommittee_index": U64,
+    }
+
+
 class Eth1Data(Container):
     fields = {
         "deposit_root": Root,

@@ -330,6 +330,94 @@ def sync_aggregate_signature_set(
     return SignatureSet(sig, pubkeys, message)
 
 
+def sync_committee_message_signature_set(
+    state, get_pubkey: GetPubkey, validator_index: int, slot: int,
+    block_root: bytes, signature, preset,
+) -> SignatureSet:
+    """signature_sets.rs:462 sync_committee_message_set: one validator
+    signing the head block root at DOMAIN_SYNC_COMMITTEE."""
+    from ..ssz import ByteVector
+
+    domain = get_domain(
+        state.fork,
+        state.genesis_validators_root,
+        S.DOMAIN_SYNC_COMMITTEE,
+        _epoch_at(slot, preset),
+    )
+    root_obj = ByteVector(32).hash_tree_root(block_root)
+    message = SigningData(object_root=root_obj, domain=domain).root()
+    return SignatureSet(
+        _sig(signature), [_pubkey(get_pubkey, validator_index)], message
+    )
+
+
+def sync_selection_proof_signature_set(
+    state, get_pubkey: GetPubkey, aggregator_index: int, slot: int,
+    subcommittee_index: int, selection_proof, preset,
+) -> SignatureSet:
+    """signature_sets.rs:500 signed_sync_aggregate_selection_proof: the
+    aggregator signs SyncAggregatorSelectionData(slot, subcommittee)."""
+    from ..containers import SyncAggregatorSelectionData
+
+    data = SyncAggregatorSelectionData(
+        slot=slot, subcommittee_index=subcommittee_index
+    )
+    domain = get_domain(
+        state.fork,
+        state.genesis_validators_root,
+        S.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        _epoch_at(slot, preset),
+    )
+    message = _signing_root(data, domain)
+    return SignatureSet(
+        _sig(selection_proof), [_pubkey(get_pubkey, aggregator_index)], message
+    )
+
+
+def contribution_and_proof_signature_set(
+    state, get_pubkey: GetPubkey, signed_contribution, preset
+) -> SignatureSet:
+    """signature_sets.rs:529 signed_sync_contribution_and_proof: the outer
+    envelope over ContributionAndProof."""
+    msg = signed_contribution.message
+    domain = get_domain(
+        state.fork,
+        state.genesis_validators_root,
+        S.DOMAIN_CONTRIBUTION_AND_PROOF,
+        _epoch_at(msg.contribution.slot, preset),
+    )
+    message = _signing_root(msg, domain)
+    return SignatureSet(
+        _sig(signed_contribution.signature),
+        [_pubkey(get_pubkey, msg.aggregator_index)],
+        message,
+    )
+
+
+def sync_contribution_signature_set(
+    state, contribution, participant_pubkeys: list, preset
+) -> SignatureSet:
+    """signature_sets.rs:553-ish contribution body set: the aggregate of
+    the subcommittee participants over the beacon block root."""
+    from ..ssz import ByteVector
+
+    domain = get_domain(
+        state.fork,
+        state.genesis_validators_root,
+        S.DOMAIN_SYNC_COMMITTEE,
+        _epoch_at(contribution.slot, preset),
+    )
+    root_obj = ByteVector(32).hash_tree_root(
+        bytes(contribution.beacon_block_root)
+    )
+    message = SigningData(object_root=root_obj, domain=domain).root()
+    if not participant_pubkeys:
+        raise SignatureSetError("contribution with no participants")
+    return SignatureSet(
+        _sig(contribution.signature), participant_pubkeys, message
+    )
+
+
 def bls_execution_change_signature_set(
     state, signed_change, spec: S.ChainSpec
 ) -> SignatureSet:

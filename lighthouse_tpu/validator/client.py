@@ -93,6 +93,47 @@ class ValidatorStore:
         ).root()
         return self.keys[pubkey].sign(root)
 
+    # --- sync-committee signing (not slashable: no DB gate) ---------------
+
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, block_root: bytes, state, preset
+    ):
+        from ..consensus.containers import SigningData
+        from ..consensus.ssz import ByteVector
+
+        domain = sets.get_domain(
+            state.fork, state.genesis_validators_root,
+            S.DOMAIN_SYNC_COMMITTEE, slot // preset.slots_per_epoch,
+        )
+        root = SigningData(
+            object_root=ByteVector(32).hash_tree_root(block_root),
+            domain=domain,
+        ).root()
+        return self.keys[pubkey].sign(root)
+
+    def sign_sync_selection_proof(
+        self, pubkey: bytes, slot: int, subcommittee_index: int, state, preset
+    ):
+        from ..consensus.containers import SyncAggregatorSelectionData
+
+        domain = sets.get_domain(
+            state.fork, state.genesis_validators_root,
+            S.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+            slot // preset.slots_per_epoch,
+        )
+        data = SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        return self.keys[pubkey].sign(S.compute_signing_root(data, domain))
+
+    def sign_contribution_and_proof(self, pubkey: bytes, msg, state, preset):
+        domain = sets.get_domain(
+            state.fork, state.genesis_validators_root,
+            S.DOMAIN_CONTRIBUTION_AND_PROOF,
+            int(msg.contribution.slot) // preset.slots_per_epoch,
+        )
+        return self.keys[pubkey].sign(S.compute_signing_root(msg, domain))
+
 
 class DutiesService:
     """Compute per-epoch attester + proposer duties for managed keys."""
@@ -266,6 +307,95 @@ class BlockService:
         sig = self.store.sign_block(pubkey, signed.message, state, preset)
         signed.signature = sig.to_bytes()
         return self.chain.process_block(signed, verify_signatures=False)
+
+
+class SyncCommitteeService:
+    """The sync-duty family (validator_client/src/sync_committee_service.rs,
+    647 LoC): every managed validator in the current sync committee signs
+    the head root each slot; selected aggregators build contributions from
+    the BN pool at 2/3 slot and wrap them in SignedContributionAndProof."""
+
+    def __init__(self, chain, store: ValidatorStore, spec):
+        self.chain = chain
+        self.store = store
+        self.spec = spec
+        self.log = get_logger("validator.sync")
+
+    def _managed_committee_members(self, state):
+        from ..beacon.sync_committee import subnets_for_validator
+
+        out = []
+        for pk, vi in self.store.index_by_pubkey.items():
+            subnets = subnets_for_validator(state, vi, self.spec)
+            if subnets:
+                out.append((pk, vi, subnets))
+        return out
+
+    def produce_messages(self, slot: int):
+        """[(subnet_id, SyncCommitteeMessage)] for every managed member —
+        signed over the CURRENT head root (the 1/3-slot product)."""
+        from ..consensus.containers import types_for
+
+        state = self.chain.head_state()
+        preset = self.spec.preset
+        head_root = self.chain.head_root
+        T = types_for(preset)
+        out = []
+        for pk, vi, subnets in self._managed_committee_members(state):
+            sig = self.store.sign_sync_committee_message(
+                pk, slot, bytes(head_root), state, preset
+            )
+            msg = T.SyncCommitteeMessage(
+                slot=slot,
+                beacon_block_root=bytes(head_root),
+                validator_index=vi,
+                signature=sig.to_bytes(),
+            )
+            for subnet in subnets:
+                out.append((subnet, msg))
+        return out
+
+    def produce_contributions(self, slot: int):
+        """[SignedContributionAndProof] from managed aggregators (2/3 slot):
+        selection proof → hash-mod gate → pool aggregate → envelope."""
+        from ..beacon.sync_committee import is_sync_committee_aggregator
+        from ..consensus.containers import types_for
+
+        state = self.chain.head_state()
+        preset = self.spec.preset
+        head_root = bytes(self.chain.head_root)
+        T = types_for(preset)
+        out = []
+        claimed: set[int] = set()
+        for pk, vi, subnets in self._managed_committee_members(state):
+            for subnet in subnets:
+                if subnet in claimed:
+                    continue
+                proof = self.store.sign_sync_selection_proof(
+                    pk, slot, subnet, state, preset
+                )
+                if not is_sync_committee_aggregator(proof.to_bytes(), self.spec):
+                    continue
+                contribution = self.chain.sync_pool.build_contribution(
+                    slot, head_root, subnet
+                )
+                if contribution is None:
+                    continue
+                claimed.add(subnet)
+                msg = T.ContributionAndProof(
+                    aggregator_index=vi,
+                    contribution=contribution,
+                    selection_proof=proof.to_bytes(),
+                )
+                sig = self.store.sign_contribution_and_proof(
+                    pk, msg, state, preset
+                )
+                out.append(
+                    T.SignedContributionAndProof(
+                        message=msg, signature=sig.to_bytes()
+                    )
+                )
+        return out
 
 
 class DoppelgangerService:

@@ -1,0 +1,195 @@
+"""Sync-committee pipelines, BN + VC, end to end.
+
+Covers sync_committee_verification.rs (message ladder :290, contribution
+3-set batch :617), the sync half of naive_aggregation_pool.rs, the VC
+sync_committee_service.rs duty family, and the production path: a produced
+block carries a SyncAggregate with nonzero participation that verifies
+through the bulk signature path and pays participant rewards.
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.beacon.sync_committee import (
+    SyncCommitteeError,
+    is_sync_committee_aggregator,
+    subnets_for_validator,
+    sync_committee_indices,
+)
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+from lighthouse_tpu.validator.client import (
+    DutiesService,
+    SyncCommitteeService,
+    ValidatorStore,
+)
+from lighthouse_tpu.validator.slashing_protection import SlashingDatabase
+
+N = 16
+
+
+@pytest.fixture()
+def rig():
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(N, spec, fork="altair")
+    chain = BeaconChain(spec, state, None, fork="altair")
+    store = ValidatorStore(
+        keys={kp[1].to_bytes(): kp[0] for kp in keys},
+        slashing_db=SlashingDatabase(":memory:"),
+        index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+    )
+    svc = SyncCommitteeService(chain, store, spec)
+    return spec, chain, keys, store, svc
+
+
+def test_membership_and_subnets(rig):
+    spec, chain, *_ = rig
+    state = chain.head_state()
+    indices = sync_committee_indices(state)
+    assert len(indices) == spec.preset.sync_committee_size
+    covered = set()
+    for vi in set(indices):
+        subnets = subnets_for_validator(state, vi, spec)
+        assert subnets
+        covered |= subnets
+    assert covered == set(range(spec.sync_committee_subnet_count))
+    # a validator outside the committee has no subnets
+    outsider = next(
+        (i for i in range(N) if i not in set(indices)), None
+    )
+    if outsider is not None:
+        assert subnets_for_validator(state, outsider, spec) == set()
+
+
+def test_minimal_preset_everyone_aggregates(rig):
+    spec, *_ = rig
+    # modulo = max(1, 32/4/16) = 1: every selection proof selects
+    assert is_sync_committee_aggregator(b"\x11" * 96, spec)
+
+
+def test_message_ladder_rejects_wrong_subnet_and_outsider(rig):
+    spec, chain, keys, store, svc = rig
+    state = chain.head_state()
+    msgs = svc.produce_messages(0)
+    assert msgs
+    subnet, msg = msgs[0]
+    # valid on its own subnet
+    chain.process_sync_committee_message(msg, subnet)
+    # wrong subnet rejected
+    wrong = (subnet + 1) % spec.sync_committee_subnet_count
+    if wrong not in subnets_for_validator(state, int(msg.validator_index), spec):
+        with pytest.raises(SyncCommitteeError, match="subnet"):
+            chain.process_sync_committee_message(msg, wrong)
+    # forged signature rejected
+    forged = msg.copy()
+    forged.signature = bytes(keys[0][0].sign(b"\x00" * 32).to_bytes())
+    with pytest.raises(SyncCommitteeError, match="signature"):
+        chain.process_sync_committee_message(forged, subnet)
+
+
+def test_contribution_three_set_batch(rig):
+    spec, chain, keys, store, svc = rig
+    for subnet, msg in svc.produce_messages(0):
+        chain.process_sync_committee_message(msg, subnet)
+    contributions = svc.produce_contributions(0)
+    assert contributions
+    for signed in contributions:
+        chain.process_sync_contribution(signed)
+    # a tampered envelope fails the batch
+    bad = contributions[0].copy()
+    bad.signature = b"\xaa" * 96
+    with pytest.raises(Exception):
+        chain.process_sync_contribution(bad)
+
+
+def test_block_carries_live_sync_aggregate(rig):
+    """The VERDICT item-4 'done' shape: duties end-to-end, produced block
+    has nonzero participation, imports with full signature verification,
+    and participants earn the sync reward."""
+    spec, chain, keys, store, svc = rig
+    b1 = chain.produce_block(1, keys)
+    chain.process_block(b1)
+    # slot 1 duties: messages over the new head, aggregated
+    for subnet, msg in svc.produce_messages(1):
+        chain.process_sync_committee_message(msg, subnet)
+    for signed in svc.produce_contributions(1):
+        chain.process_sync_contribution(signed)
+    pre_balance = chain.head_state().balances[0]
+    b2 = chain.produce_block(2, keys)
+    agg = b2.message.body.sync_aggregate
+    participation = sum(1 for b in agg.sync_committee_bits if b)
+    assert participation == spec.preset.sync_committee_size
+    root = chain.process_block(b2)  # full signature verification path
+    post = chain.state_for_block(root)
+    # all validators participate (the committee is drawn with duplicates
+    # from 16 validators), so every balance strictly increases
+    assert all(
+        post.balances[i] > chain.state_for_block(b1.message.root()).balances[i]
+        for i in range(N)
+    )
+
+
+def test_empty_aggregate_is_infinity_and_verifies(rig):
+    spec, chain, keys, *_ = rig
+    b1 = chain.produce_block(1, keys)
+    agg = b1.message.body.sync_aggregate
+    assert sum(1 for b in agg.sync_committee_bits if b) == 0
+    assert bytes(agg.sync_committee_signature)[:1] == b"\xc0"
+    chain.process_block(b1)  # verifies with the None-set (valid empty)
+
+
+def test_node_gossip_sync_committee_end_to_end():
+    """Two nodes over real sockets: messages + contribution ride their
+    topics; the receiver's pool fills and its next produced block carries
+    the participation."""
+    import time
+
+    from lighthouse_tpu.beacon.node import BeaconNode
+
+    spec = phase0_spec(S.MINIMAL)
+    genesis, keys = interop_state(N, spec, fork="altair")
+    a = BeaconNode(spec, genesis, keypairs=keys, fork="altair")
+    b = BeaconNode(spec, genesis, keypairs=keys, fork="altair")
+    a.start()
+    b.start()
+    try:
+        conn = a.host.dial("127.0.0.1", b.host.port)
+        a._status_handshake(conn)
+        time.sleep(1.0)
+        blk = a.produce_and_publish(1)
+        root = blk.message.root()
+        for _ in range(40):
+            if b.chain.fork_choice.contains_block(root):
+                break
+            time.sleep(0.25)
+        assert b.chain.fork_choice.contains_block(root)
+        # a's VC performs sync duties, publishing over gossip
+        store = ValidatorStore(
+            keys={kp[1].to_bytes(): kp[0] for kp in keys},
+            slashing_db=SlashingDatabase(":memory:"),
+            index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+        )
+        svc = SyncCommitteeService(a.chain, store, spec)
+        for subnet, msg in svc.produce_messages(1):
+            with a._chain_lock:
+                a.chain.process_sync_committee_message(msg, subnet)
+            a.publish_sync_message(subnet, msg)
+        for signed in svc.produce_contributions(1):
+            with a._chain_lock:
+                a.chain.process_sync_contribution(signed)
+            a.publish_contribution(signed)
+        # b's pool fills via gossip; then b produces the next block
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            agg = b.chain.sync_pool.get_sync_aggregate(
+                1, bytes(root), b.types
+            )
+            if sum(1 for x in agg.sync_committee_bits if x) > 0:
+                break
+            time.sleep(0.25)
+        blk2 = b.produce_and_publish(2)
+        agg2 = blk2.message.body.sync_aggregate
+        assert sum(1 for x in agg2.sync_committee_bits if x) > 0
+    finally:
+        a.stop()
+        b.stop()
