@@ -116,6 +116,10 @@ class BeaconChain:
         from .sync_committee import SyncContributionPool
 
         self.sync_pool = SyncContributionPool(spec)
+        # BN-side aggregation of gossip singles (naive_aggregation_pool.rs)
+        from .naive_pool import NaiveAggregationPool
+
+        self.naive_pool = NaiveAggregationPool()
         self.store = store or HotColdDB(types_family=self.types)
         self.log = get_logger("beacon_chain")
         self.slot_clock = slot_clock
@@ -372,6 +376,55 @@ class BeaconChain:
         )
         return self.da_checker.put_sidecar(sidecar)
 
+    def process_unaggregated_attestation(
+        self, attestation, subnet_id: int | None = None,
+        current_slot: int | None = None,
+    ):
+        """Gossip single-attestation ladder (attestation_verification.rs
+        unaggregated path): exactly one bit, correct subnet, committee
+        membership, signature — then fork choice + the naive pool so the
+        node can pack its OWN aggregates at production."""
+        data = attestation.data
+        bits = [bool(b) for b in attestation.aggregation_bits]
+        if sum(bits) != 1:
+            raise ChainError("unaggregated attestation must set exactly one bit")
+        target_root = bytes(data.beacon_block_root)
+        if not self.fork_choice.contains_block(target_root):
+            raise ChainError("attestation references unknown block")
+        state = self._states.get(target_root) or self.head_state()
+        cache = self.committee_cache(
+            state, int(data.slot) // self.preset.slots_per_epoch
+        )
+        if subnet_id is not None:
+            from ..network.topics import compute_subnet_for_attestation
+
+            expected = compute_subnet_for_attestation(
+                self.spec, int(data.slot), int(data.index),
+                cache.committees_per_slot,
+            )
+            if expected != subnet_id:
+                raise ChainError(
+                    f"attestation on subnet {subnet_id}, expected {expected}"
+                )
+        committee = cache.committee(int(data.slot), int(data.index))
+        indexed = cm.get_indexed_attestation(committee, attestation)
+        s = sets.indexed_attestation_signature_set(
+            state, self.get_pubkey, indexed, self.preset
+        )
+        if not s.verify():
+            raise ChainError("attestation signature invalid")
+        cur = (
+            current_slot
+            if current_slot is not None
+            else (self.slot_clock.current_slot() if self.slot_clock else None)
+        )
+        for vi in indexed.attesting_indices:
+            self.fork_choice.process_attestation(
+                int(vi), target_root, int(data.target.epoch), cur
+            )
+        self.naive_pool.insert(attestation)
+        ATTS_PROCESSED.inc()
+
     # ----------------------------------------------------- sync committee
 
     def process_sync_committee_message(self, msg, subnet_id: int) -> None:
@@ -438,6 +491,10 @@ class BeaconChain:
         randao_root = SigningData(
             object_root=U64.hash_tree_root(epoch), domain=randao_domain
         ).root()
+        # drain the naive pool: aggregates the node built from gossip
+        # singles compete in max-cover packing alongside delivered ones
+        for agg in self.naive_pool.get_aggregates():
+            self.op_pool.insert_attestation(agg)
         atts = self.op_pool.get_attestations_for_block(state, self.preset)
         ps, asl, exits = self.op_pool.get_slashings_and_exits(state, self.preset)
         body_cls = self.types.BeaconBlockBody_BY_FORK[fork_now]

@@ -1,0 +1,73 @@
+"""BN-side naive aggregation of gossip attestation singles.
+
+Twin of beacon_node/beacon_chain/src/naive_aggregation_pool.rs (792 LoC):
+the node observes unaggregated attestations on their subnets and merges
+them per AttestationData, so produced blocks pack aggregates the node
+built ITSELF from gossip singles — not only what aggregators delivered.
+"""
+
+from __future__ import annotations
+
+from ..crypto.bls import api as bls
+
+
+class NaiveAggregationPool:
+    """Merge single-bit attestations per data root; aggregate lazily."""
+
+    def __init__(self, max_data: int = 1024):
+        # data_root -> (data, bits list, [Signature]) — a sig per NEW bit
+        self._groups: dict[bytes, tuple[object, list[bool], list]] = {}
+        self.max_data = max_data
+
+    def insert(self, attestation) -> bool:
+        """True if the attestation added at least one new attester bit
+        (naive_aggregation_pool.rs InsertOutcome::NewItemAdded)."""
+        key = attestation.data.root()
+        bits = [bool(b) for b in attestation.aggregation_bits]
+        entry = self._groups.get(key)
+        if entry is None:
+            if len(self._groups) >= self.max_data:
+                self._groups.pop(next(iter(self._groups)))
+            self._groups[key] = (
+                attestation.data,
+                bits,
+                [bls.Signature.from_bytes(bytes(attestation.signature))],
+            )
+            return True
+        data, have, sigs = entry
+        new = [b and not h for b, h in zip(bits, have)]
+        if not any(new):
+            return False  # every attester already known
+        if any(b and h for b, h in zip(bits, have)):
+            return False  # overlapping aggregate: cannot merge soundly
+        for i, b in enumerate(bits):
+            if b:
+                have[i] = True
+        sigs.append(bls.Signature.from_bytes(bytes(attestation.signature)))
+        return True
+
+    def get_aggregates(self) -> list:
+        """One merged Attestation per data (the produce_block feed)."""
+        from ..consensus.containers import Attestation
+
+        out = []
+        for data, bits, sigs in self._groups.values():
+            out.append(
+                Attestation(
+                    aggregation_bits=list(bits),
+                    data=data,
+                    signature=bls.AggregateSignature.aggregate(sigs).to_bytes(),
+                )
+            )
+        return out
+
+    def prune(self, current_slot: int, preset) -> None:
+        """Drop data older than one epoch (the pool's retention window)."""
+        keep = {}
+        for key, (data, bits, sigs) in self._groups.items():
+            if int(data.slot) + preset.slots_per_epoch >= current_slot:
+                keep[key] = (data, bits, sigs)
+        self._groups = keep
+
+    def __len__(self) -> int:
+        return len(self._groups)

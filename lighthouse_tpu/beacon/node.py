@@ -90,6 +90,24 @@ class BeaconNode:
         # 3. gossip subscriptions -> chain
         self.host.subscribe(self.block_topic, self._on_gossip_block)
         self.host.subscribe(self.attestation_topic, self._on_gossip_aggregate)
+        # attestation subnets (beacon_attestation_{i}) + the subnet service
+        # deciding long-lived/duty subscriptions + ENR advertisement
+        from ..network.subnets import AttestationSubnetService
+
+        self.attestation_subnet_topics = [
+            topics_mod.attestation_subnet_topic(i, self.digest)
+            for i in range(spec.attestation_subnet_count)
+        ]
+        for i, t in enumerate(self.attestation_subnet_topics):
+            self.host.subscribe(
+                t,
+                lambda p, pid, subnet=i: self._on_gossip_attestation_single(
+                    p, pid, subnet
+                ),
+            )
+        self.subnet_service = AttestationSubnetService(
+            spec=spec, node_id=self.host.peer_id[:32].ljust(32, b"\x00")
+        )
         # sync-committee subnets + contribution topic (topics.rs:107)
         self.sync_subnet_topics = [
             topics_mod.sync_subnet_topic(i, self.digest)
@@ -646,6 +664,47 @@ class BeaconNode:
         except Exception as exc:  # noqa: BLE001
             self._pending_availability.pop(root, None)
             log.debug("parked block rejected on retry: %s", exc)
+
+    def _on_gossip_attestation_single(
+        self, payload: bytes, peer_id, subnet: int
+    ) -> str:
+        """beacon_attestation_{subnet} -> the unaggregated ladder + naive
+        aggregation (gossip_methods.rs:228's batch entry, single here)."""
+        from ..consensus.containers import Attestation
+
+        try:
+            att = Attestation.deserialize_value(payload)
+        except Exception:  # noqa: BLE001
+            return "reject"
+        try:
+            with self._chain_lock:
+                self.chain.process_unaggregated_attestation(att, subnet)
+            return "accept"
+        except Exception as exc:  # noqa: BLE001
+            log.debug("gossip single attestation dropped: %s", exc)
+            return "ignore"
+
+    def publish_attestation_single(self, subnet: int, attestation) -> None:
+        self.host.publish(
+            self.attestation_subnet_topics[subnet], attestation.encode()
+        )
+
+    def update_enr_subnets(self, epoch: int) -> None:
+        """Advertise long-lived attestation subnets in the ENR attnets
+        field (discovery subnet predicates match on it)."""
+        if self.discovery is None:
+            return
+        from ..network.enr import build_enr
+
+        attnets = self.subnet_service.enr_attnets(epoch)
+        self.discovery.enr = build_enr(
+            self.host.key,
+            seq=int(self.discovery.enr.seq) + 1,
+            ip4="127.0.0.1",
+            udp=self.discovery.port,
+            tcp=self.host.port,
+            extra={b"eth2": self.digest + bytes(12), b"attnets": attnets},
+        )
 
     def _on_gossip_sync_message(self, payload: bytes, peer_id, subnet: int) -> str:
         try:

@@ -22,9 +22,15 @@ class OperationPool:
     # ---------------------------------------------------------------- insert
 
     def insert_attestation(self, attestation) -> None:
-        """Group by attestation data root (mergeable aggregates)."""
+        """Group by attestation data root (mergeable aggregates); identical
+        bit patterns are dropped (re-inserted naive-pool aggregates)."""
         key = attestation.data.root()
-        self.attestations.setdefault(key, []).append(attestation)
+        group = self.attestations.setdefault(key, [])
+        bits = [bool(b) for b in attestation.aggregation_bits]
+        for existing in group:
+            if [bool(b) for b in existing.aggregation_bits] == bits:
+                return
+        group.append(attestation)
 
     def insert_proposer_slashing(self, slashing) -> None:
         self.proposer_slashings[

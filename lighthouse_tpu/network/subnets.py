@@ -1,0 +1,100 @@
+"""Duty-driven subnet subscription + ENR advertisement.
+
+Twin of beacon_node/network/src/subnet_service/attestation_subnets.rs (679
+LoC) and sync_subnets.rs: decide WHICH attestation/sync subnets a node
+joins and when — long-lived subnets advertised in the ENR `attnets` /
+`syncnets` bitfields (discovery predicates match on them), short-lived
+duty subscriptions joined one epoch ahead of the duty slot and dropped
+after it passes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .topics import compute_subnet_for_attestation
+
+SUBNETS_PER_NODE = 2  # spec `SUBNETS_PER_NODE`: long-lived subscriptions
+EPOCHS_PER_SUBNET_SUBSCRIPTION = 256
+
+
+def attnets_bitfield(subnets: set[int], count: int = 64) -> bytes:
+    """The ENR `attnets` value: a fixed 8-byte little-endian bitfield."""
+    out = bytearray(count // 8)
+    for s in subnets:
+        out[s // 8] |= 1 << (s % 8)
+    return bytes(out)
+
+
+def syncnets_bitfield(subnets: set[int], count: int = 4) -> bytes:
+    out = bytearray(1)
+    for s in subnets:
+        out[0] |= 1 << (s % 8)
+    return bytes(out)
+
+
+def bitfield_to_subnets(raw: bytes) -> set[int]:
+    return {
+        i * 8 + j
+        for i, byte in enumerate(raw)
+        for j in range(8)
+        if byte >> j & 1
+    }
+
+
+def long_lived_subnets(node_id: bytes, epoch: int, spec) -> set[int]:
+    """Deterministic long-lived subnets from the node id + subscription
+    period (attestation_subnets.rs compute_subscribed_subnets shape:
+    id-prefix-derived, rotating every EPOCHS_PER_SUBNET_SUBSCRIPTION)."""
+    prefix = int.from_bytes(node_id[:8], "big")
+    period = epoch // EPOCHS_PER_SUBNET_SUBSCRIPTION
+    return {
+        (prefix + period + i) % spec.attestation_subnet_count
+        for i in range(SUBNETS_PER_NODE)
+    }
+
+
+@dataclass
+class Subscription:
+    subnet_id: int
+    slot: int  # the duty slot; unsubscribe after it passes
+
+
+@dataclass
+class AttestationSubnetService:
+    """Tracks wanted subnets = long-lived ∪ duty-driven; the node diffs
+    `wanted()` against its live topic set each epoch tick."""
+
+    spec: object
+    node_id: bytes = b"\x00" * 32
+    _duty_subs: list[Subscription] = field(default_factory=list)
+
+    def on_duties(self, duties, committees_per_slot: int) -> list[Subscription]:
+        """Register duty-driven subscriptions (one per attester duty —
+        validator_subscriptions in attestation_subnets.rs)."""
+        added = []
+        for duty in duties:
+            subnet = compute_subnet_for_attestation(
+                self.spec, duty.slot, duty.committee_index, committees_per_slot
+            )
+            sub = Subscription(subnet_id=subnet, slot=duty.slot)
+            self._duty_subs.append(sub)
+            added.append(sub)
+        return added
+
+    def tick(self, current_slot: int) -> None:
+        """Expire duty subscriptions whose slot has passed."""
+        self._duty_subs = [s for s in self._duty_subs if s.slot >= current_slot]
+
+    def wanted(self, epoch: int) -> set[int]:
+        return long_lived_subnets(self.node_id, epoch, self.spec) | {
+            s.subnet_id for s in self._duty_subs
+        }
+
+    def enr_attnets(self, epoch: int) -> bytes:
+        """Only LONG-LIVED subnets are advertised (duty subs churn too
+        fast for discovery — same split as the reference)."""
+        return attnets_bitfield(
+            long_lived_subnets(self.node_id, epoch, self.spec),
+            self.spec.attestation_subnet_count,
+        )
