@@ -210,7 +210,13 @@ class BeaconNode:
                 continue
             conn = None
             try:
-                conn = self.host.dial(rec.ip4 or "127.0.0.1", tcp)
+                from ..network.noise import peer_id_from_pubkey
+
+                pub = rec.kv.get(b"secp256k1")
+                expected = peer_id_from_pubkey(pub) if pub else None
+                conn = self.host.dial(
+                    rec.ip4 or "127.0.0.1", tcp, expected_peer_id=expected
+                )
                 self._status_handshake(conn)
                 # only a COMPLETED handshake counts as a usable peer and
                 # excludes it from future rounds; failures stay retryable

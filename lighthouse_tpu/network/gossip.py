@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict, defaultdict
-from dataclasses import dataclass, field
 from typing import Callable
 
 from ..ops import sha256
@@ -59,46 +58,14 @@ class SeenCache:
         return mid in self._d
 
 
-# peer scoring (gossipsub_scoring_parameters.rs / peer_manager shape)
-GREYLIST_THRESHOLD = -16.0
-BAN_THRESHOLD = -40.0
-
-
-@dataclass
-class PeerInfo:
-    score: float = 0.0
-    connected: bool = True
-    banned: bool = False
-    topics: set[str] = field(default_factory=set)
-
-
-class PeerManager:
-    def __init__(self):
-        self.peers: dict[str, PeerInfo] = {}
-
-    def connect(self, peer_id: str) -> None:
-        info = self.peers.setdefault(peer_id, PeerInfo())
-        if info.banned:
-            raise PermissionError(f"peer {peer_id} is banned")
-        info.connected = True
-
-    def report(self, peer_id: str, delta: float, reason: str = "") -> None:
-        """Behavioral score adjustment; crossing the ban threshold
-        disconnects + bans (peer_manager ban policy)."""
-        info = self.peers.setdefault(peer_id, PeerInfo())
-        info.score += delta
-        if info.score <= BAN_THRESHOLD:
-            info.banned = True
-            info.connected = False
-
-    def is_banned(self, peer_id: str) -> bool:
-        return self.peers.get(peer_id, PeerInfo()).banned
-
-    def greylisted(self, peer_id: str) -> bool:
-        return self.peers.get(peer_id, PeerInfo()).score <= GREYLIST_THRESHOLD
-
-    def connected_peers(self) -> list[str]:
-        return [p for p, i in self.peers.items() if i.connected]
+# peer scoring: the full decay/ban-expiry/per-topic model lives in
+# peer_manager.py (peerdb.rs + gossipsub_scoring_parameters.rs twin);
+# re-exported here for the in-process router + older call sites.
+from .peer_manager import (  # noqa: E402,F401
+    BAN_THRESHOLD,
+    GREYLIST_THRESHOLD,
+    PeerManager,
+)
 
 
 class GossipNode:

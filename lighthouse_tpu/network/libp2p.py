@@ -398,10 +398,13 @@ class Libp2pHost:
                 log.debug("heartbeat: %s", exc)
 
     def heartbeat(self) -> None:
-        """gossipsub heartbeat: mesh maintenance + IHAVE gossip + mcache
-        window shift (the vendored gossipsub's heartbeat())."""
+        """gossipsub heartbeat: score decay + score-driven mesh maintenance
+        + IHAVE gossip + mcache window shift (the vendored gossipsub's
+        heartbeat(), with the v1.1 score gates)."""
         import random as _random
 
+        self.peer_manager.maybe_decay()
+        self._enforce_bans()
         for topic in list(self.subscriptions):
             grafts, prunes = [], []
             with self._mesh_lock:
@@ -411,17 +414,29 @@ class Libp2pHost:
                     if topic in c.topics and c.alive
                 ]
                 mesh.intersection_update(subscribed)
-                # grow toward D when below D_LO
+                # negative-score members are pruned FIRST (score gate)
+                for pid_hex in self.peer_manager.mesh_prunable(
+                    [p.hex() for p in mesh]
+                ):
+                    pid = bytes.fromhex(pid_hex)
+                    mesh.discard(pid)
+                    prunes.append(pid)
+                # grow toward D when below D_LO — best score first, and
+                # never below-zero peers (accept_graft gate)
                 if len(mesh) < self.D_LO:
-                    candidates = [p for p in subscribed if p not in mesh]
-                    _random.shuffle(candidates)
-                    for pid in candidates[: self.D - len(mesh)]:
+                    ranked = self.peer_manager.graft_candidates(
+                        [p.hex() for p in subscribed if p not in mesh]
+                    )
+                    for pid_hex in ranked[: self.D - len(mesh)]:
+                        pid = bytes.fromhex(pid_hex)
                         mesh.add(pid)
                         grafts.append(pid)
-                # shrink toward D when above D_HI
+                # shrink toward D when above D_HI (drop worst scores)
                 elif len(mesh) > self.D_HI:
-                    for pid in _random.sample(sorted(mesh),
-                                              len(mesh) - self.D):
+                    worst = sorted(
+                        mesh, key=lambda p: self.peer_manager.score(p.hex())
+                    )
+                    for pid in worst[: len(mesh) - self.D]:
                         mesh.discard(pid)
                         prunes.append(pid)
                 lazy = [p for p in subscribed if p not in mesh]
@@ -443,6 +458,15 @@ class Libp2pHost:
         conn = self.connections.get(peer_id)
         if conn is not None:
             conn.send_gossip_rpc(encode_gossip_rpc(control=ctl))
+
+    def _enforce_bans(self) -> None:
+        """Disconnect any live connection whose peer crossed the ban
+        threshold (peer_manager ban policy: ban implies disconnect)."""
+        for pid, conn in list(self.connections.items()):
+            if conn.alive and self.peer_manager.is_banned(pid.hex()):
+                log.debug("disconnecting banned peer %s", pid.hex()[:8])
+                self._drop_connection(conn)
+                conn.close()
 
     def stop(self) -> None:
         self._running = False
@@ -468,7 +492,8 @@ class Libp2pHost:
 
         return read_exact
 
-    def _upgrade(self, sock: socket.socket, dialer: bool) -> Connection:
+    def _upgrade(self, sock: socket.socket, dialer: bool,
+                 expected_peer_id: bytes | None = None) -> Connection:
         sock.settimeout(10.0)
         read_exact = self._sock_reader(sock)
         reader = _MsgReader(read_exact)
@@ -510,10 +535,35 @@ class Libp2pHost:
         muxer = Session(n_write, mux_recv, is_dialer=dialer,
                         on_stream=None)
         conn = Connection(self, sock, noise, muxer)
+        # identity pinning (ADVICE r3): a dialer that knows who it meant to
+        # reach (from the ENR) must reject an endpoint proving a different
+        # identity — rust-libp2p rejects mismatched /p2p/<peer-id> the same
+        # way.
+        if expected_peer_id is not None and conn.peer_id != expected_peer_id:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise Libp2pError(
+                f"remote proved identity {conn.peer_id.hex()[:8]}, "
+                f"expected {expected_peer_id.hex()[:8]}"
+            )
+        if self.peer_manager.is_banned(conn.peer_id.hex()):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise Libp2pError(f"peer {conn.peer_id.hex()[:8]} is banned")
         muxer._on_stream = lambda st: self._spawn_stream_handler(conn, st)
         muxer._on_close = lambda: self._drop_connection(conn)
         muxer.start()
         sock.settimeout(None)
+        old = self.connections.get(conn.peer_id)
+        if old is not None and old is not conn:
+            # replacing a live duplicate would leak its socket + pump
+            # threads for the connection's remaining lifetime (ADVICE r3)
+            self._drop_connection(old)
+            old.close()
         self.connections[conn.peer_id] = conn
         self.peer_manager.connect(conn.peer_id.hex())
         # announce our subscriptions
@@ -536,16 +586,21 @@ class Libp2pHost:
     def _inbound(self, sock: socket.socket) -> None:
         try:
             self._upgrade(sock, dialer=False)
-        except (Libp2pError, NoiseError, OSError) as exc:
+        except (Libp2pError, NoiseError, OSError, PermissionError) as exc:
             log.debug("inbound upgrade failed: %s", exc)
             try:
                 sock.close()
             except OSError:
                 pass
 
-    def dial(self, ip: str, port: int) -> Connection:
+    def dial(self, ip: str, port: int,
+             expected_peer_id: bytes | None = None) -> Connection:
+        """``expected_peer_id``: pin the identity the noise handshake must
+        prove (derived from the discovered ENR's secp256k1 key) — a
+        hijacked endpoint cannot impersonate the discovered peer."""
         sock = socket.create_connection((ip, port), timeout=10.0)
-        return self._upgrade(sock, dialer=True)
+        return self._upgrade(sock, dialer=True,
+                             expected_peer_id=expected_peer_id)
 
     def _drop_connection(self, conn: Connection) -> None:
         """Muxer died (peer hung up or send failed): forget the connection
@@ -594,8 +649,8 @@ class Libp2pHost:
                 n = idle_reader.read_uvarint(MAX_GOSSIP_RPC_SIZE)
             except Libp2pError:
                 # oversized/malformed: drop + penalize, never buffer
-                self.peer_manager.report(
-                    conn.peer_id.hex(), -10.0, "oversized gossip rpc"
+                self.peer_manager.on_behaviour_penalty(
+                    conn.peer_id.hex(), 3.0, "oversized gossip rpc"
                 )
                 st.reset()
                 return
@@ -619,25 +674,34 @@ class Libp2pHost:
         try:
             payload = snappy.decompress_block(data)
         except snappy.SnappyError:
-            self.peer_manager.report(conn.peer_id.hex(), -10.0, "invalid snappy")
+            self.peer_manager.on_invalid_message(conn.peer_id.hex(), topic)
             return
         outcome = handler(payload, conn.peer_id)
         if outcome == "accept":
             self.received.append((topic, payload))
             self.mcache.put(mid, topic, data)
+            self.peer_manager.on_first_delivery(conn.peer_id.hex(), topic)
             self._forward(topic, data, skip=conn.peer_id)
         elif outcome == "reject":
-            self.peer_manager.report(conn.peer_id.hex(), -10.0, "invalid gossip")
+            # per-topic invalid delivery: the squared penalty is what makes
+            # repeat offenders fall past the ban threshold
+            self.peer_manager.on_invalid_message(conn.peer_id.hex(), topic)
+            if self.peer_manager.is_banned(conn.peer_id.hex()):
+                self._drop_connection(conn)
+                conn.close()
 
     def _on_gossip_control(self, conn: Connection, ctl: GossipControl) -> None:
         """GRAFT/PRUNE mesh membership; IHAVE -> IWANT for unseen ids;
         IWANT served from the mcache."""
         for topic in ctl.graft:
-            if topic in self.subscriptions:
+            if topic in self.subscriptions and self.peer_manager.accept_graft(
+                conn.peer_id.hex()
+            ):
                 with self._mesh_lock:
                     self.mesh.setdefault(topic, set()).add(conn.peer_id)
             else:
-                # not subscribed: refuse the graft (spec: prune back)
+                # not subscribed, or the peer's score fails the graft
+                # gate: refuse (spec: prune back)
                 self._send_control(conn.peer_id, GossipControl(prune=[topic]))
         for topic in ctl.prune:
             with self._mesh_lock:
@@ -656,8 +720,8 @@ class Libp2pHost:
                 conn.peer_id.hex(), "gossip_iwant",
                 cost=float(min(len(ctl.iwant), 64)),  # the actual serve cost
             ):
-                self.peer_manager.report(
-                    conn.peer_id.hex(), -1.0, "iwant flood"
+                self.peer_manager.on_behaviour_penalty(
+                    conn.peer_id.hex(), 1.0, "iwant flood"
                 )
                 return
             sends = []

@@ -266,7 +266,7 @@ class TestHost:
         a.publish(TOPIC, b"bad payload")
         deadline = time.time() + 5
         while time.time() < deadline:
-            scores = [i.score for i in b.peer_manager.peers.values()]
+            scores = [i.score() for i in b.peer_manager.peers.values()]
             if any(s < 0 for s in scores):
                 break
             time.sleep(0.05)
