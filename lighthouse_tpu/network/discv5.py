@@ -368,6 +368,10 @@ class Discv5Service:
         self.sessions: dict[bytes, Session] = {}
         self.known_enrs: dict[bytes, Enr] = {}  # node-id -> freshest record
         self.addr_of: dict[bytes, tuple[str, int]] = {}
+        # nonces of recently-sent message packets per peer: a WHOAREYOU
+        # must echo one of them, else an off-path attacker could forge
+        # session resets from arbitrary addresses (ADVICE r3; spec 7.2)
+        self._sent_nonces: dict[bytes, list[bytes]] = {}
         self._challenges: dict[tuple[str, int], _Challenge] = {}
         self._pending: dict[bytes, list[_PendingSend]] = {}
         self._requests: dict[bytes, dict] = {}  # req-id -> waiter state
@@ -407,6 +411,7 @@ class Discv5Service:
         self.addr_of[nid] = addr
         sess = self.sessions.get(nid)
         nonce = secrets.token_bytes(12)
+        self._record_sent_nonce(nid, nonce)
         if sess is not None:
             authdata = self.node_id
             header = _header(FLAG_MESSAGE, nonce, authdata)
@@ -481,6 +486,12 @@ class Discv5Service:
         self._challenges[addr] = _Challenge(iv2 + header_w, nonce)
         self.sock.sendto(iv2 + _ctr_mask(src_id, iv2, header_w), addr)
 
+    def _record_sent_nonce(self, nid: bytes, nonce: bytes) -> None:
+        lst = self._sent_nonces.setdefault(nid, [])
+        lst.append(nonce)
+        if len(lst) > 32:
+            del lst[: len(lst) - 32]
+
     def _on_whoareyou(self, nonce, authdata, header, iv, addr):
         if len(authdata) != 24:
             raise ValueError("bad WHOAREYOU authdata")
@@ -488,6 +499,10 @@ class Discv5Service:
         # find who we were talking to at this address
         nid = next((n for n, a in self.addr_of.items() if a == addr), None)
         if nid is None:
+            return
+        if nonce not in self._sent_nonces.get(nid, []):
+            # the echoed nonce must belong to a packet WE actually sent;
+            # anything else is a forgeable session-reset attempt — drop
             return
         dest = self.known_enrs.get(nid)
         if dest is None:
@@ -510,6 +525,7 @@ class Discv5Service:
             queued = [_PendingSend(ping(secrets.token_bytes(8), self.enr.seq))]
         first, rest = queued[0], queued[1:]
         new_nonce = secrets.token_bytes(12)
+        self._record_sent_nonce(nid, new_nonce)
         header_h = _header(FLAG_HANDSHAKE, new_nonce, authdata_h)
         iv2 = secrets.token_bytes(16)
         ct = AESGCM(send_key).encrypt(new_nonce, first.msg_plain, iv2 + header_h)

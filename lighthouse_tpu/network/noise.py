@@ -212,23 +212,26 @@ def _x25519_pub_raw(priv: X25519PrivateKey) -> bytes:
 
 
 def _sign_identity(identity_key: ec.EllipticCurvePrivateKey, static_pub: bytes) -> bytes:
-    from .enr import _sig_to_raw64
-
-    der = identity_key.sign(
+    # libp2p-noise ships the DER ECDSA signature (rust-libp2p encoding);
+    # raw64 r||s stays confined to the ENR v4 identity scheme (ADVICE r3).
+    return identity_key.sign(
         STATIC_KEY_DOMAIN + static_pub, ec.ECDSA(hashes.SHA256())
     )
-    return _sig_to_raw64(der)
 
 
 def _verify_identity(pub_compressed: bytes, static_pub: bytes, sig: bytes) -> bool:
-    from .enr import _raw64_to_der
-
     try:
         pub = ec.EllipticCurvePublicKey.from_encoded_point(
             ec.SECP256K1(), pub_compressed
         )
+        if len(sig) == 64:
+            # tolerate the legacy raw64 encoding from older peers of this
+            # stack; spec-conformant peers send DER (0x30-prefixed)
+            from .enr import _raw64_to_der
+
+            sig = _raw64_to_der(sig)
         pub.verify(
-            _raw64_to_der(sig),
+            sig,
             STATIC_KEY_DOMAIN + static_pub,
             ec.ECDSA(hashes.SHA256()),
         )

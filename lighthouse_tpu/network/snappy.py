@@ -251,10 +251,17 @@ def decompress_framed_prefix(data: bytes, want: int) -> tuple[bytes, int]:
             out += chunk
             data_frames += 1
             if len(out) >= want and data_frames >= 1:
-                # Stop at the payload boundary, like the reference's
-                # streaming readers that read exactly `want` decompressed
-                # bytes per chunk; trailing skippable frames would belong
-                # to the NEXT coded chunk's parse.
+                # Payload complete.  Consume any CONTIGUOUS trailing
+                # skippable frames (types 0x80-0xFE incl. padding) that
+                # still belong to THIS snappy stream — other spec-legal
+                # encoders may emit them, and leaving them unconsumed
+                # would make the next coded chunk's parse start inside a
+                # padding frame (ADVICE r3).
+                while pos + 4 <= len(data) and 0x80 <= data[pos] <= 0xFE:
+                    skip_len = int.from_bytes(data[pos + 1 : pos + 4], "little")
+                    if pos + 4 + skip_len > len(data):
+                        break  # truncated padding: leave for the caller
+                    pos += 4 + skip_len
                 break
         elif 0x80 <= ctype <= 0xFE:  # skippable (0xFE = padding)
             continue

@@ -260,3 +260,39 @@ class TestBootNodeCli:
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+
+
+class TestWhoareyouNonceCheck:
+    """ADVICE r4: WHOAREYOU must echo a nonce we actually sent."""
+
+    def test_forged_whoareyou_dropped(self):
+        import secrets as _secrets
+
+        from lighthouse_tpu.network.discv5 import Discv5Service
+
+        a = Discv5Service()
+        b = Discv5Service()
+        nid = b.node_id
+        addr = ("127.0.0.1", 9999)
+        a.addr_of[nid] = addr
+        a.known_enrs[nid] = b.enr
+        # forged: nonce never sent by a -> no session, no handshake reply
+        a._on_whoareyou(
+            _secrets.token_bytes(12),
+            _secrets.token_bytes(16) + (1).to_bytes(8, "big"),
+            b"\x00" * 23,
+            b"\x00" * 16,
+            addr,
+        )
+        assert nid not in a.sessions
+        # a nonce a actually recorded passes the gate (session derives)
+        real_nonce = _secrets.token_bytes(12)
+        a._record_sent_nonce(nid, real_nonce)
+        a._on_whoareyou(
+            real_nonce,
+            _secrets.token_bytes(16) + (1).to_bytes(8, "big"),
+            b"\x00" * 23,
+            b"\x00" * 16,
+            addr,
+        )
+        assert nid in a.sessions

@@ -236,3 +236,44 @@ class TestBeaconApi:
         _, _, client = rig
         text = client.metrics()
         assert "beacon_blocks_imported_total" in text
+
+
+class TestAdviceR4Fixes:
+    """Round-4 hardening: DER noise identity sigs, snappy padding frames."""
+
+    def test_noise_identity_signature_is_der(self):
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        from lighthouse_tpu.network.noise import (
+            _sign_identity,
+            _verify_identity,
+        )
+        from lighthouse_tpu.network.enr import _sig_to_raw64
+
+        key = ec.generate_private_key(ec.SECP256K1())
+        from cryptography.hazmat.primitives import serialization
+
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint,
+        )
+        static = b"\x42" * 32
+        sig = _sign_identity(key, static)
+        # DER SEQUENCE, not raw64 (the libp2p/rust-libp2p encoding)
+        assert sig[:1] == b"\x30" and len(sig) != 64
+        assert _verify_identity(pub, static, sig)
+        # legacy raw64 from older peers still accepted
+        assert _verify_identity(pub, static, _sig_to_raw64(sig))
+        assert not _verify_identity(pub, b"\x43" * 32, sig)
+
+    def test_snappy_prefix_consumes_trailing_padding(self):
+        payload = b"hello-snappy"
+        stream = snappy.compress_framed(payload)
+        padding = b"\xfe\x03\x00\x00xyz"  # spec-legal padding frame
+        tail = b"NEXTCHUNK"
+        out, consumed = snappy.decompress_framed_prefix(
+            stream + padding + tail, len(payload)
+        )
+        assert out == payload
+        # the padding frame belongs to THIS stream: consumed past it
+        assert (stream + padding + tail)[consumed:] == tail
