@@ -120,6 +120,10 @@ class BeaconChain:
         from .naive_pool import NaiveAggregationPool
 
         self.naive_pool = NaiveAggregationPool()
+        # observable chain milestones (events.rs SSE hub)
+        from .events import EventBroadcaster
+
+        self.events = EventBroadcaster()
         self.store = store or HotColdDB(types_family=self.types)
         self.log = get_logger("beacon_chain")
         self.slot_clock = slot_clock
@@ -286,6 +290,7 @@ class BeaconChain:
                 self.slot_clock.current_slot() == block.slot
                 and into < self.spec.seconds_per_slot / 3
             )
+        finalized_before = self.fork_choice.finalized_checkpoint
         self.fork_choice.on_block(
             FcBlock(
                 slot=int(block.slot),
@@ -305,6 +310,24 @@ class BeaconChain:
         self._observed_blocks.add(block_root)
         self.pubkey_cache.update(state)
         BLOCKS_IMPORTED.inc()
+        self.events.emit(
+            "block",
+            {
+                "slot": str(int(block.slot)),
+                "block": "0x" + block_root.hex(),
+                "execution_optimistic": False,
+            },
+        )
+        finalized_now = self.fork_choice.finalized_checkpoint
+        if finalized_now != finalized_before and finalized_now[0] > 0:
+            self.events.emit(
+                "finalized_checkpoint",
+                {
+                    "epoch": str(int(finalized_now[0])),
+                    "block": "0x" + bytes(finalized_now[1]).hex(),
+                    "state": "0x" + state.root().hex(),
+                },
+            )
         log_with(
             self.log, logging.DEBUG, "Block imported",
             slot=int(block.slot), root=block_root.hex()[:8],
@@ -356,6 +379,14 @@ class BeaconChain:
         self._observed_attestations.add(att_key)
         self.op_pool.insert_attestation(attestation)
         ATTS_PROCESSED.inc()
+        self.events.emit(
+            "attestation",
+            {
+                "slot": str(int(data.slot)),
+                "index": str(int(data.index)),
+                "beacon_block_root": "0x" + bytes(data.beacon_block_root).hex(),
+            },
+        )
 
     # ------------------------------------------------------------- blobs
 
@@ -461,10 +492,22 @@ class BeaconChain:
             np.int64,
             len(state.validators),
         )
+        old = self.head_root
         self.head_root = self.fork_choice.get_head(
             balances,
             self.slot_clock.current_slot() if self.slot_clock else None,
         )
+        if self.head_root != old:
+            head_state = self._states.get(self.head_root)
+            self.events.emit(
+                "head",
+                {
+                    "slot": str(int(head_state.slot)) if head_state else "0",
+                    "block": "0x" + bytes(self.head_root).hex(),
+                    "state": "0x" + (head_state.root().hex() if head_state else "00" * 32),
+                    "epoch_transition": False,
+                },
+            )
         return self.head_root
 
     # ------------------------------------------------------- production

@@ -145,7 +145,7 @@ class BeaconNode:
         self.host.rpc_handlers["blob_sidecars_by_range"] = self._on_blobs_by_range
         self.host.rpc_handlers["blob_sidecars_by_root"] = self._on_blobs_by_root
         # 5. HTTP API
-        self.api = BeaconApiServer(self.chain, port=http_port)
+        self.api = BeaconApiServer(self.chain, port=http_port, node=self)
         self._dialed: set[bytes] = set()
         # chain.py is single-writer by design (the beacon_processor's
         # worker model); with gossip threads + the slot timer feeding one

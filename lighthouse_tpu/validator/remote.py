@@ -87,9 +87,14 @@ class RemoteChain:
 def run_validator_client(
     beacon_url: str, n_keys: int, slots: int | None = None,
     spec=None, fork: str = "altair", poll: float = 0.2,
+    use_sse: bool = False,
 ) -> int:
     """The `lighthouse vc` loop over HTTP: interop keys, duties each
-    epoch, sign + publish attestations as head slots arrive."""
+    epoch, sign + publish attestations as head slots arrive.
+
+    ``use_sse=True`` follows the BN's `/eth/v1/events` head stream
+    instead of polling (the events.rs consumer mode) — each head event
+    triggers the attestation round for its slot."""
     import time
 
     from ..consensus import spec as S
@@ -126,6 +131,25 @@ def run_validator_client(
     log.info("vc up: %d managed keys against %s", len(store.keys), beacon_url)
     published = 0
     last_attested = -1
+    if use_sse:
+        # push mode: the BN tells us when the head moves (events.rs)
+        for kind, data in client.stream_events(["head"], timeout=3600.0):
+            if kind != "head":
+                continue
+            chain.refresh()
+            slot = int(data["slot"])
+            if slot <= last_attested:
+                continue
+            atts = attester.attest(slot)
+            if atts:
+                chain.publish_attestations(atts)
+                published += len(atts)
+                log.info("sse head slot %d: published %d attestations",
+                         slot, len(atts))
+            last_attested = slot
+            if slots is not None and slot >= slots:
+                return published
+        return published
     try:
         while True:
             chain.refresh()  # one consistent (root, state) snapshot/tick
