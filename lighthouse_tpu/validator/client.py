@@ -49,11 +49,20 @@ class ValidatorStore:
     keys: dict[bytes, bls.SecretKey]  # pubkey bytes -> sk
     slashing_db: SlashingDatabase
     index_by_pubkey: dict[bytes, int] = field(default_factory=dict)
+    # signing_method.rs: None = local keystore; a RemoteSigner routes every
+    # signature over the web3signer wire instead (keys dict then only
+    # carries pubkeys as dict keys; secret values may be None)
+    signer: object = None
 
     def __post_init__(self):
         for pk in self.keys:
             self.slashing_db.register_validator(pk)
         self.pk_by_index = {v: k for k, v in self.index_by_pubkey.items()}
+
+    def _sign(self, pubkey: bytes, root: bytes):
+        if self.signer is not None:
+            return self.signer.sign(pubkey, root)
+        return self.keys[pubkey].sign(root)
 
     def sign_attestation(self, pubkey: bytes, data: AttestationData, state, preset):
         domain = sets.get_domain(
@@ -66,7 +75,7 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             pubkey, int(data.source.epoch), int(data.target.epoch), root
         )
-        return self.keys[pubkey].sign(root)
+        return self._sign(pubkey, root)
 
     def sign_block(self, pubkey: bytes, block, state, preset):
         epoch = int(block.slot) // preset.slots_per_epoch
@@ -78,7 +87,7 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_block_proposal(
             pubkey, int(block.slot), root
         )
-        return self.keys[pubkey].sign(root)
+        return self._sign(pubkey, root)
 
     def sign_selection_proof(self, pubkey: bytes, slot: int, state, preset):
         from ..consensus.containers import SigningData
@@ -91,7 +100,7 @@ class ValidatorStore:
         root = SigningData(
             object_root=U64.hash_tree_root(slot), domain=domain
         ).root()
-        return self.keys[pubkey].sign(root)
+        return self._sign(pubkey, root)
 
     # --- sync-committee signing (not slashable: no DB gate) ---------------
 
@@ -109,7 +118,7 @@ class ValidatorStore:
             object_root=ByteVector(32).hash_tree_root(block_root),
             domain=domain,
         ).root()
-        return self.keys[pubkey].sign(root)
+        return self._sign(pubkey, root)
 
     def sign_sync_selection_proof(
         self, pubkey: bytes, slot: int, subcommittee_index: int, state, preset
@@ -124,7 +133,7 @@ class ValidatorStore:
         data = SyncAggregatorSelectionData(
             slot=slot, subcommittee_index=subcommittee_index
         )
-        return self.keys[pubkey].sign(S.compute_signing_root(data, domain))
+        return self._sign(pubkey, S.compute_signing_root(data, domain))
 
     def sign_contribution_and_proof(self, pubkey: bytes, msg, state, preset):
         domain = sets.get_domain(
@@ -132,7 +141,7 @@ class ValidatorStore:
             S.DOMAIN_CONTRIBUTION_AND_PROOF,
             int(msg.contribution.slot) // preset.slots_per_epoch,
         )
-        return self.keys[pubkey].sign(S.compute_signing_root(msg, domain))
+        return self._sign(pubkey, S.compute_signing_root(msg, domain))
 
 
 class DutiesService:
@@ -277,7 +286,7 @@ class AttestationService:
                 state.fork, state.genesis_validators_root,
                 S.DOMAIN_AGGREGATE_AND_PROOF, slot // preset.slots_per_epoch,
             )
-            sig = self.store.keys[pubkey].sign(S.compute_signing_root(msg, domain))
+            sig = self.store._sign(pubkey, S.compute_signing_root(msg, domain))
             out.append(
                 SignedAggregateAndProof(message=msg, signature=sig.to_bytes())
             )

@@ -1,0 +1,121 @@
+"""Multi-BN failover for the validator client.
+
+Twin of validator_client/src/beacon_node_fallback.rs (748 LoC): the VC
+holds N beacon-node endpoints, health-checks them, ranks candidates
+(synced first, then by recent failures), and retries every API call down
+the ranking until one succeeds — a dying primary BN must not stop duties.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..utils.logging import get_logger
+
+log = get_logger("vc_fallback")
+
+
+@dataclass
+class CandidateHealth:
+    """beacon_node_fallback.rs CandidateInfo: health + failure memory."""
+
+    synced: bool = False
+    reachable: bool = False
+    consecutive_failures: int = 0
+    last_check: float = 0.0
+    latency: float = float("inf")
+
+
+@dataclass
+class Candidate:
+    client: object  # BeaconApiClient
+    health: CandidateHealth = field(default_factory=CandidateHealth)
+
+    @property
+    def base(self) -> str:
+        return getattr(self.client, "base", "?")
+
+
+class AllCandidatesFailed(IOError):
+    pass
+
+
+class BeaconNodeFallback:
+    """Rank + retry over N BeaconApiClients.  Use ``first_success`` for
+    explicit calls, or attribute access (``fallback.block_header(...)``)
+    for drop-in BeaconApiClient compatibility."""
+
+    def __init__(self, clients: list, health_interval: float = 2.0):
+        self.candidates = [Candidate(client=c) for c in clients]
+        self.health_interval = health_interval
+
+    # -- health ------------------------------------------------------------
+
+    def check_health(self, force: bool = False) -> None:
+        """One health round (fallback.rs update_all_candidates): syncing
+        status + latency per candidate."""
+        now = time.monotonic()
+        for cand in self.candidates:
+            h = cand.health
+            if not force and now - h.last_check < self.health_interval:
+                continue
+            h.last_check = now
+            t0 = time.monotonic()
+            try:
+                syncing = cand.client.node_syncing()
+                h.reachable = True
+                h.synced = not syncing.get("is_syncing", False)
+                h.latency = time.monotonic() - t0
+                h.consecutive_failures = 0
+            except Exception:  # noqa: BLE001
+                h.reachable = False
+                h.synced = False
+                h.consecutive_failures += 1
+                h.latency = float("inf")
+
+    def ranked(self) -> list[Candidate]:
+        """Synced+reachable first, fewest failures, lowest latency —
+        the fallback.rs candidate ordering."""
+        return sorted(
+            self.candidates,
+            key=lambda c: (
+                not c.health.synced,
+                not c.health.reachable,
+                c.health.consecutive_failures,
+                c.health.latency,
+            ),
+        )
+
+    # -- request routing ---------------------------------------------------
+
+    def first_success(self, fn_name: str, *args, **kwargs):
+        """Try the call on each candidate in rank order; a failure demotes
+        the candidate and moves on (fallback.rs first_success)."""
+        self.check_health()
+        errors = []
+        for cand in self.ranked():
+            try:
+                out = getattr(cand.client, fn_name)(*args, **kwargs)
+                cand.health.consecutive_failures = 0
+                cand.health.reachable = True
+                return out
+            except Exception as exc:  # noqa: BLE001
+                cand.health.consecutive_failures += 1
+                cand.health.reachable = False
+                errors.append(f"{cand.base}: {exc}")
+                log.debug("candidate %s failed %s: %s", cand.base, fn_name, exc)
+        raise AllCandidatesFailed(
+            f"every BN failed {fn_name}: {'; '.join(errors[:4])}"
+        )
+
+    def __getattr__(self, name: str):
+        """Drop-in BeaconApiClient surface: unknown attributes become
+        fallback-routed method calls."""
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            return self.first_success(name, *args, **kwargs)
+
+        return call

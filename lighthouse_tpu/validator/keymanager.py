@@ -1,0 +1,147 @@
+"""Keymanager HTTP API: the VC's own key-management surface.
+
+Twin of validator_client/src/http_api/ (1,410 LoC keymanager routes):
+bearer-token-authenticated list/import/delete of local keystores
+(eth/v1/keystores per the keymanager-APIs spec), plus remotekeys
+registration for web3signer-backed validators.  Deleting a key exports
+its EIP-3076 slashing-protection history in the response — the key's
+history must travel with it.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..crypto import keystore as ks
+from ..crypto.bls import api as bls
+from ..utils.logging import get_logger
+
+log = get_logger("keymanager")
+
+
+class KeymanagerServer:
+    """Serves the keymanager API over a ValidatorStore."""
+
+    def __init__(self, store, port: int = 0, token: str | None = None):
+        self.store = store
+        self.token = token or secrets.token_hex(16)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _auth(self) -> bool:
+                header = self.headers.get("Authorization", "")
+                return header == f"Bearer {outer.token}"
+
+            def _send(self, code: int, payload) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(json.dumps(payload).encode())
+
+            def do_GET(self):
+                if not self._auth():
+                    self._send(401, {"message": "missing bearer token"})
+                    return
+                if self.path.rstrip("/") == "/eth/v1/keystores":
+                    self._send(200, {"data": [
+                        {
+                            "validating_pubkey": "0x" + pk.hex(),
+                            "derivation_path": "",
+                            "readonly": outer.store.signer is not None,
+                        }
+                        for pk in outer.store.keys
+                    ]})
+                    return
+                if self.path.rstrip("/") == "/eth/v1/remotekeys":
+                    signer = outer.store.signer
+                    url = getattr(signer, "url", "") if signer else ""
+                    self._send(200, {"data": [
+                        {"pubkey": "0x" + pk.hex(), "url": url,
+                         "readonly": False}
+                        for pk in (outer.store.keys if signer else ())
+                    ]})
+                    return
+                self._send(404, {"message": "no route"})
+
+            def do_POST(self):
+                if not self._auth():
+                    self._send(401, {"message": "missing bearer token"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.rstrip("/") == "/eth/v1/keystores":
+                    statuses = []
+                    for raw, password in zip(
+                        body.get("keystores", []), body.get("passwords", [])
+                    ):
+                        try:
+                            data = (
+                                json.loads(raw) if isinstance(raw, str) else raw
+                            )
+                            sk_bytes = ks.decrypt(data, password)
+                            sk = bls.SecretKey(
+                                int.from_bytes(sk_bytes, "big")
+                            )
+                            pk = sk.public_key().to_bytes()
+                            if pk in outer.store.keys:
+                                statuses.append({"status": "duplicate"})
+                                continue
+                            outer.store.keys[pk] = sk
+                            outer.store.slashing_db.register_validator(pk)
+                            statuses.append({"status": "imported"})
+                        except Exception as exc:  # noqa: BLE001
+                            statuses.append(
+                                {"status": "error", "message": str(exc)}
+                            )
+                    self._send(200, {"data": statuses})
+                    return
+                self._send(404, {"message": "no route"})
+
+            def do_DELETE(self):
+                if not self._auth():
+                    self._send(401, {"message": "missing bearer token"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.rstrip("/") == "/eth/v1/keystores":
+                    statuses = []
+                    deleted = []
+                    for hexpk in body.get("pubkeys", []):
+                        pk = bytes.fromhex(hexpk.removeprefix("0x"))
+                        if pk in outer.store.keys:
+                            del outer.store.keys[pk]
+                            deleted.append(pk)
+                            statuses.append({"status": "deleted"})
+                        else:
+                            statuses.append({"status": "not_found"})
+                    interchange = (
+                        outer.store.slashing_db.export_interchange(bytes(32))
+                        if deleted
+                        else {}
+                    )
+                    self._send(200, {
+                        "data": statuses,
+                        "slashing_protection": json.dumps(interchange),
+                    })
+                    return
+                self._send(404, {"message": "no route"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="keymanager"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -85,13 +85,16 @@ class RemoteChain:
 
 
 def run_validator_client(
-    beacon_url: str, n_keys: int, slots: int | None = None,
+    beacon_url: str | list, n_keys: int, slots: int | None = None,
     spec=None, fork: str = "altair", poll: float = 0.2,
     use_sse: bool = False,
 ) -> int:
     """The `lighthouse vc` loop over HTTP: interop keys, duties each
     epoch, sign + publish attestations as head slots arrive.
 
+    ``beacon_url`` may be a LIST of BN endpoints: requests then route
+    through BeaconNodeFallback (beacon_node_fallback.rs) — ranked,
+    health-checked, retried — so a dying primary does not stop duties.
     ``use_sse=True`` follows the BN's `/eth/v1/events` head stream
     instead of polling (the events.rs consumer mode) — each head event
     triggers the attestation round for its slot."""
@@ -104,7 +107,14 @@ def run_validator_client(
     from .slashing_protection import SlashingDatabase
 
     spec = spec or phase0_spec(S.MINIMAL)
-    client = BeaconApiClient(beacon_url)
+    if isinstance(beacon_url, (list, tuple)):
+        from .fallback import BeaconNodeFallback
+
+        client = BeaconNodeFallback(
+            [BeaconApiClient(u) for u in beacon_url]
+        )
+    else:
+        client = BeaconApiClient(beacon_url)
     chain = RemoteChain(client, spec, fork=fork)
     state = chain.head_state()
     pubkey_to_index = {

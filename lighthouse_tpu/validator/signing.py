@@ -1,0 +1,139 @@
+"""Signing methods: local keystore vs remote signer (web3signer wire).
+
+Twin of validator_client/src/signing_method.rs:80-91 (SigningMethod::
+{LocalKeystore, Web3Signer}) plus a minimal in-process web3signer-shaped
+server for tests (the testing/web3signer_tests analog, no container):
+POST /api/v1/eth2/sign/{pubkey} with {"signing_root": 0x...} returns
+{"signature": 0x...}; GET /api/v1/eth2/publicKeys lists held keys.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..crypto.bls import api as bls
+
+
+class SigningError(Exception):
+    pass
+
+
+class LocalSigner:
+    """signing_method.rs LocalKeystore: sks held in-process."""
+
+    def __init__(self, keys: dict[bytes, bls.SecretKey]):
+        self.keys = keys
+
+    def sign(self, pubkey: bytes, signing_root: bytes) -> bls.Signature:
+        sk = self.keys.get(bytes(pubkey))
+        if sk is None:
+            raise SigningError(f"no key for {bytes(pubkey).hex()[:12]}")
+        return sk.sign(signing_root)
+
+    def public_keys(self) -> list[bytes]:
+        return list(self.keys)
+
+
+class RemoteSigner:
+    """signing_method.rs Web3Signer: HTTPS POST per signature."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def sign(self, pubkey: bytes, signing_root: bytes) -> bls.Signature:
+        body = json.dumps(
+            {"signing_root": "0x" + bytes(signing_root).hex(), "type": "RAW"}
+        ).encode()
+        req = urllib.request.Request(
+            f"{self.url}/api/v1/eth2/sign/0x{bytes(pubkey).hex()}",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                out = json.loads(r.read())
+        except Exception as exc:  # noqa: BLE001
+            raise SigningError(f"remote signer: {exc}") from None
+        return bls.Signature.from_bytes(
+            bytes.fromhex(out["signature"].removeprefix("0x"))
+        )
+
+    def public_keys(self) -> list[bytes]:
+        with urllib.request.urlopen(
+            f"{self.url}/api/v1/eth2/publicKeys", timeout=self.timeout
+        ) as r:
+            return [
+                bytes.fromhex(x.removeprefix("0x")) for x in json.loads(r.read())
+            ]
+
+
+class Web3SignerServer:
+    """In-process signer double serving the web3signer wire shape."""
+
+    def __init__(self, keys: dict[bytes, bls.SecretKey], port: int = 0):
+        signer = LocalSigner(keys)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/api/v1/eth2/publicKeys":
+                    body = json.dumps(
+                        ["0x" + k.hex() for k in signer.public_keys()]
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):
+                parts = self.path.rstrip("/").split("/")
+                if len(parts) >= 6 and parts[-2] == "sign":
+                    pubkey = bytes.fromhex(parts[-1].removeprefix("0x"))
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    root = bytes.fromhex(
+                        payload["signing_root"].removeprefix("0x")
+                    )
+                    try:
+                        sig = signer.sign(pubkey, root)
+                    except SigningError:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps(
+                        {"signature": "0x" + sig.to_bytes().hex()}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="web3signer"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -24,7 +24,11 @@ class NotRegistered(SlashingProtectionError):
 
 class SlashingDatabase:
     def __init__(self, path: str = ":memory:", genesis_validators_root: bytes = b""):
-        self.conn = sqlite3.connect(path)
+        # cross-thread access (keymanager HTTP handlers + VC services share
+        # one DB — the reference pools its SQLite connections the same
+        # way); sqlite's serialized mode + the GIL make this safe for the
+        # short statement bursts used here
+        self.conn = sqlite3.connect(path, check_same_thread=False)
         self.conn.execute("PRAGMA journal_mode=WAL")
         self.conn.executescript(
             """
