@@ -1,28 +1,25 @@
-"""Slashing detection over dense per-validator epoch arrays.
+"""Slashing detection over chunked, disk-backed per-validator surfaces.
 
 Twin of slasher/src (Slasher::process_queued :79, process_batch :204,
-min/max-target chunked arrays array.rs, attestation/block queues).  The
-reference persists chunked u16 distance arrays in MDBX and updates them
-per-attestation; here the two surround-detection surfaces are dense numpy
-arrays over (validator, epoch % history):
+min/max-target chunked arrays array.rs, attestation/block queues, the
+database/ backend split).  The two surround-detection surfaces are
+chunked int32 tiles persisted through a KeyValueStore (slasher/store.py —
+the MDBX/LMDB equivalent on the native slabdb engine), with an LRU of hot
+tiles bounding memory:
 
 * ``min_targets[v, e]`` — the minimum attestation target seen for source
   epochs  > e  (detects "new attestation is surrounded by an old one")
 * ``max_targets[v, e]`` — the maximum target seen for source epochs < e
   (detects "new attestation surrounds an old one")
 
-Both updates are vectorized scatter/sweep ops — the same shape as the
-epoch-processing kernels, so the slasher rides the framework's array core
-(and is a natural device workload at mainnet scale: 1M x 4096 u16 = 8 GB
-per surface in HBM, or chunked like the reference on host).
-
-Double proposals/votes are exact-match lookups keyed in a dict store, as
-in the reference's block queue + attestation dedup.
+Double proposals/votes are exact-match lookups persisted in their own
+columns, so a restarted slasher resumes with full history (the reference
+re-opens its MDBX environment the same way).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -32,35 +29,38 @@ from ..consensus.containers import (
     ProposerSlashing,
     SignedBeaconBlockHeader,
 )
+from ..store.kv import DBColumn, KeyValueStore, MemoryStore
+from .store import ChunkedSurface
+
+_INT32_MAX = np.iinfo(np.int32).max
 
 
 @dataclass
 class SlasherConfig:
     history_length: int = 4096  # epochs of lookback (the reference default)
-    chunk_size: int = 16
-    validator_capacity: int = 1024  # grows on demand
-
-
-@dataclass
-class _Records:
-    """Exact-match stores for doubles (attestation data by (v, target))."""
-
-    attestations: dict[tuple[int, int], IndexedAttestation] = field(
-        default_factory=dict
-    )
-    blocks: dict[tuple[int, int], SignedBeaconBlockHeader] = field(
-        default_factory=dict
-    )
+    chunk_size: int = 256  # epochs per tile (array.rs chunk_size)
+    validator_chunk_size: int = 64  # validators per tile
+    max_cached_tiles: int = 128  # LRU bound: tiles held in memory
 
 
 class Slasher:
-    def __init__(self, config: SlasherConfig | None = None):
+    def __init__(self, config: SlasherConfig | None = None,
+                 db: KeyValueStore | None = None):
+        """``db=None`` → ephemeral MemoryStore; pass a SlabStore for the
+        disk-backed, restart-surviving configuration."""
         self.config = config or SlasherConfig()
-        H = self.config.history_length
-        V = self.config.validator_capacity
-        self.min_targets = np.full((V, H), np.iinfo(np.int32).max, np.int32)
-        self.max_targets = np.zeros((V, H), np.int32)
-        self.records = _Records()
+        self.db = db if db is not None else MemoryStore()
+        c = self.config
+        self.min_targets = ChunkedSurface(
+            self.db, DBColumn.SLASHER_MIN_TARGETS, _INT32_MAX,
+            c.history_length, c.validator_chunk_size, c.chunk_size,
+            c.max_cached_tiles,
+        )
+        self.max_targets = ChunkedSurface(
+            self.db, DBColumn.SLASHER_MAX_TARGETS, 0,
+            c.history_length, c.validator_chunk_size, c.chunk_size,
+            c.max_cached_tiles,
+        )
         self.attestation_queue: list[IndexedAttestation] = []
         self.block_queue: list[SignedBeaconBlockHeader] = []
         self.found_attester_slashings: list[AttesterSlashing] = []
@@ -74,22 +74,34 @@ class Slasher:
     def accept_block_header(self, header: SignedBeaconBlockHeader) -> None:
         self.block_queue.append(header)
 
-    def _ensure_capacity(self, max_validator: int) -> None:
-        V = self.min_targets.shape[0]
-        if max_validator < V:
-            return
-        newV = max(V * 2, max_validator + 1)
-        H = self.config.history_length
-        grown_min = np.full((newV, H), np.iinfo(np.int32).max, np.int32)
-        grown_min[:V] = self.min_targets
-        grown_max = np.zeros((newV, H), np.int32)
-        grown_max[:V] = self.max_targets
-        self.min_targets, self.max_targets = grown_min, grown_max
+    # -------------------------------------------------- persisted records
+
+    @staticmethod
+    def _att_key(v: int, tgt: int) -> bytes:
+        return v.to_bytes(8, "big") + tgt.to_bytes(8, "big")
+
+    def _get_attestation(self, v: int, tgt: int) -> IndexedAttestation | None:
+        raw = self.db.get(DBColumn.SLASHER_ATTESTATIONS, self._att_key(v, tgt))
+        return IndexedAttestation.deserialize_value(raw) if raw else None
+
+    def _put_attestation(self, v: int, tgt: int, att) -> None:
+        self.db.put(
+            DBColumn.SLASHER_ATTESTATIONS, self._att_key(v, tgt), att.encode()
+        )
+
+    def _attestations_of(self, v: int):
+        prefix = v.to_bytes(8, "big")
+        for key in self.db.keys(DBColumn.SLASHER_ATTESTATIONS):
+            if key[:8] == prefix:
+                raw = self.db.get(DBColumn.SLASHER_ATTESTATIONS, key)
+                if raw:
+                    yield IndexedAttestation.deserialize_value(raw)
 
     # ------------------------------------------------------------ process
 
     def process_queued(self, current_epoch: int) -> tuple[list, list]:
-        """Slasher::process_queued: drain both queues, detect, return the
+        """Slasher::process_queued: drain both queues, detect, persist the
+        surface updates (flush = the reference's MDBX commit), return the
         (attester, proposer) slashings found this pass."""
         att_found: list[AttesterSlashing] = []
         for indexed in self.attestation_queue:
@@ -101,6 +113,8 @@ class Slasher:
             if ps is not None:
                 prop_found.append(ps)
         self.block_queue.clear()
+        self.min_targets.flush()
+        self.max_targets.flush()
         self.found_attester_slashings.extend(att_found)
         self.found_proposer_slashings.extend(prop_found)
         return att_found, prop_found
@@ -114,22 +128,21 @@ class Slasher:
         validators = [int(v) for v in indexed.attesting_indices]
         if not validators:
             return []
-        self._ensure_capacity(max(validators))
         out = []
         vs = np.array(validators)
         # --- double vote: same target, different data -------------------
         for v in validators:
-            prior = self.records.attestations.get((v, tgt))
+            prior = self._get_attestation(v, tgt)
             if prior is not None and prior.data.root() != indexed.data.root():
                 out.append(
                     AttesterSlashing(attestation_1=prior, attestation_2=indexed)
                 )
             else:
-                self.records.attestations[(v, tgt)] = indexed
-        # --- surround checks against the dense surfaces -----------------
+                self._put_attestation(v, tgt, indexed)
+        # --- surround checks against the chunked surfaces ---------------
         # min_targets[v, src] = min target over priors with source > src:
         # if it is < tgt, the NEW attestation surrounds that prior.
-        does_surround = self.min_targets[vs, src % H] < tgt
+        does_surround = self.min_targets.read(vs, src % H) < tgt
         for i, v in enumerate(validators):
             if does_surround[i]:
                 prior = self._find_surround_witness(v, src, tgt, surrounding=True)
@@ -141,7 +154,7 @@ class Slasher:
                     )
         # max_targets[v, src] = max target over priors with source < src:
         # if it is > tgt, a prior attestation surrounds the NEW one.
-        is_surrounded = self.max_targets[vs, src % H] > tgt
+        is_surrounded = self.max_targets.read(vs, src % H) > tgt
         for i, v in enumerate(validators):
             if is_surrounded[i]:
                 prior = self._find_surround_witness(v, src, tgt, surrounding=False)
@@ -151,27 +164,19 @@ class Slasher:
                             attestation_1=prior, attestation_2=indexed
                         )
                     )
-        # --- update the surfaces (vectorized sweeps) --------------------
-        # every epoch e in (src, tgt): a future attestation with source e..
-        # reference array.rs semantics:
+        # --- update the surfaces (array.rs sweeps, tile-wise) -----------
         #   min_targets[v, e] = min target over atts with source > e
         #   max_targets[v, e] = max target over atts with source < e
         lo = np.arange(0, src)  # epochs below src: this att has source > e
-        self.min_targets[np.ix_(vs, lo % H)] = np.minimum(
-            self.min_targets[np.ix_(vs, lo % H)], tgt
-        )
+        self.min_targets.combine(vs, lo % H, tgt, np.minimum)
         hi = np.arange(src + 1, min(tgt, src + H) + 1)
-        self.max_targets[np.ix_(vs, hi % H)] = np.maximum(
-            self.max_targets[np.ix_(vs, hi % H)], tgt
-        )
+        self.max_targets.combine(vs, hi % H, tgt, np.maximum)
         return out
 
     def _find_surround_witness(self, v, src, tgt, surrounding: bool):
         """Locate a concrete prior attestation forming the slashing pair
-        (the reference re-reads the database for the indexed attestation)."""
-        for (rv, rtgt), att in self.records.attestations.items():
-            if rv != v:
-                continue
+        (the reference re-reads its database the same way)."""
+        for att in self._attestations_of(v):
             s2, t2 = int(att.data.source.epoch), int(att.data.target.epoch)
             if surrounding and src < s2 and t2 < tgt:
                 return att  # the new (src, tgt) surrounds this prior
@@ -183,19 +188,24 @@ class Slasher:
 
     def _process_block_header(self, signed_header):
         h = signed_header.message
-        key = (int(h.proposer_index), int(h.slot))
-        prior = self.records.blocks.get(key)
+        key = int(h.proposer_index).to_bytes(8, "big") + int(h.slot).to_bytes(
+            8, "big"
+        )
+        raw = self.db.get(DBColumn.SLASHER_BLOCKS, key)
+        prior = SignedBeaconBlockHeader.deserialize_value(raw) if raw else None
         if prior is not None and prior.message.root() != h.root():
             return ProposerSlashing(
                 signed_header_1=prior, signed_header_2=signed_header
             )
-        self.records.blocks[key] = signed_header
+        self.db.put(DBColumn.SLASHER_BLOCKS, key, signed_header.encode())
         return None
 
     # ------------------------------------------------------------- prune
 
     def prune(self, finalized_epoch: int) -> None:
-        cutoff = finalized_epoch
-        self.records.attestations = {
-            k: v for k, v in self.records.attestations.items() if k[1] > cutoff
-        }
+        """Drop attestation records at/below finalization (the surfaces
+        wrap mod H and overwrite themselves)."""
+        for key in list(self.db.keys(DBColumn.SLASHER_ATTESTATIONS)):
+            tgt = int.from_bytes(key[8:], "big")
+            if tgt <= finalized_epoch:
+                self.db.delete(DBColumn.SLASHER_ATTESTATIONS, key)

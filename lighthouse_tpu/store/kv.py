@@ -28,6 +28,11 @@ class DBColumn(Enum):
     COLD_BLOCK = b"B"
     COLD_STATE = b"S"
     BEACON_BLOB = b"l"
+    # slasher database (the MDBX/LMDB equivalent rides the same engine)
+    SLASHER_MIN_TARGETS = b"1"
+    SLASHER_MAX_TARGETS = b"2"
+    SLASHER_ATTESTATIONS = b"3"
+    SLASHER_BLOCKS = b"4"
 
 
 class KeyValueStore:

@@ -88,7 +88,12 @@ def test_capacity_growth():
     s = Slasher()
     s.accept_attestation(att([5000], 0, 1))
     a, _ = s.process_queued(2)
-    assert a == [] and s.min_targets.shape[0] > 5000
+    # chunked surfaces have no fixed capacity: the tile for validator
+    # 5000 simply materializes on demand
+    assert a == []
+    import numpy as np
+
+    assert s.max_targets.read(np.array([5000]), 1)[0] == 1
 
 
 def test_prune_drops_old_records():
@@ -96,4 +101,58 @@ def test_prune_drops_old_records():
     s.accept_attestation(att([1], 0, 2))
     s.process_queued(3)
     s.prune(finalized_epoch=2)
-    assert not s.records.attestations
+    from lighthouse_tpu.store.kv import DBColumn
+
+    assert not s.db.keys(DBColumn.SLASHER_ATTESTATIONS)
+
+
+def test_bounded_memory_lru_evicts_tiles():
+    """Item-10 'done' (a): a bounded-memory config holds at most
+    max_cached_tiles in RAM while correctness is preserved across the
+    whole surface."""
+    import numpy as np
+
+    from lighthouse_tpu.slasher.slasher import SlasherConfig
+
+    cfg = SlasherConfig(chunk_size=64, validator_chunk_size=8,
+                        max_cached_tiles=4)
+    s = Slasher(cfg)
+    # touch many distinct validator chunks: far more tiles than the cache
+    for v in range(0, 256, 8):
+        s.accept_attestation(att([v], 1, 5))
+    s.process_queued(6)
+    assert s.min_targets.cached_tiles <= 4
+    assert s.max_targets.cached_tiles <= 4
+    # evicted tiles persisted: reads see the updates regardless of cache
+    assert s.max_targets.read(np.array([0]), 2)[0] == 5
+    assert s.max_targets.read(np.array([248]), 2)[0] == 5
+    # a surround against validator 248 is still caught (tile reloads)
+    s.accept_attestation(att([248], 0, 7))
+    found, _ = s.process_queued(8)
+    assert len(found) == 1
+
+
+def test_slasher_survives_restart(tmp_path):
+    """Item-10 'done' (b): surfaces + records persist on the slab store;
+    a NEW process (new Slasher over the same path) catches a surround
+    whose first half was seen before the restart."""
+    from lighthouse_tpu.store.kv import SlabStore
+
+    path = str(tmp_path / "slasher.db")
+    db = SlabStore(path)
+    s1 = Slasher(db=db)
+    s1.accept_attestation(att([3], 2, 3))  # inner attestation
+    found, _ = s1.process_queued(4)
+    assert found == []
+    db.flush()
+    db.close()
+    # --- restart ---
+    db2 = SlabStore(path)
+    s2 = Slasher(db=db2)
+    s2.accept_attestation(att([3], 1, 6))  # surrounds the pre-restart one
+    found, _ = s2.process_queued(7)
+    assert len(found) == 1
+    a1, a2 = found[0].attestation_1, found[0].attestation_2
+    assert (int(a1.data.source.epoch), int(a1.data.target.epoch)) == (2, 3)
+    assert (int(a2.data.source.epoch), int(a2.data.target.epoch)) == (1, 6)
+    db2.close()
