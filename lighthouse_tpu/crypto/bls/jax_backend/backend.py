@@ -144,6 +144,69 @@ def _verify_kernel(pk_aff, sig_aff, h_aff, wbits):
     return ok_pair & ok_sub
 
 
+def _segment_aggregate_g1(pk_aff, pad_inf, positions: int):
+    """Aggregate ``positions`` committee pubkeys per set ON DEVICE.
+
+    Layout is position-major: trailing axis = positions*B with element
+    ``pos*B + set`` — every tree-reduction step then slices a CONTIGUOUS
+    range of the last axis (pallas-friendly 2D limb shapes throughout),
+    halving the position count per step: log2(positions) complete
+    jac_adds over (B,)-wide lanes.  ``pad_inf`` marks absent members
+    (committees are shorter than the padded width); their lanes are the
+    infinity point, the identity of the reduction.
+
+    This is SURVEY §7's hard part (d): per-set aggregation of up to 2048
+    keys is the marshal bottleneck at epoch scale (~900k host G1 adds per
+    epoch); as a device segment-sum it rides the same limb kernels as the
+    pairing."""
+    p = P.from_affine(P.FP_OPS, pk_aff)
+    p = (p[0], p[1], p[2], p[3] | pad_inf)
+    total = pad_inf.shape[-1]
+    B = total // positions
+    n = positions
+    while n > 1:
+        half = n // 2
+        lo = _slice_pt(p, 0, half * B)
+        hi = _slice_pt(p, half * B, 2 * half * B)
+        p = P.jac_add(P.FP_OPS, lo, hi)
+        n = half
+    return p
+
+
+def _epoch_verify_kernel(pk_aff, pad_inf, sig_aff, h_aff, wbits,
+                         positions: int):
+    """Epoch-scale batch verify: device committee aggregation feeding the
+    standard multi-aggregate pipeline (blst.rs:35-117 semantics at the
+    BASELINE.json config-4 shape: one mainnet epoch's aggregates)."""
+    agg = _segment_aggregate_g1(pk_aff, pad_inf, positions)
+    agg_aff = P.to_affine(P.FP_OPS, agg, F.fp_inv)
+    return _verify_kernel(agg_aff, sig_aff, h_aff, wbits)
+
+
+def encode_committee_pubkeys(committees: list, positions: int):
+    """Host marshal for the segmented kernel: committees (lists of oracle
+    affine G1 points, ragged) -> position-major encoded pytree + padding
+    mask.  Padding lanes carry the generator (any valid point) under an
+    infinity flag."""
+    import numpy as np
+
+    from ..curve import G1_GENERATOR
+
+    B = len(committees)
+    flat = []
+    mask = np.zeros(positions * B, dtype=bool)
+    for pos in range(positions):
+        for b, committee in enumerate(committees):
+            if pos < len(committee):
+                flat.append(committee[pos])
+            else:
+                flat.append(G1_GENERATOR)
+                mask[pos * B + b] = True
+    import jax.numpy as jnp
+
+    return P.g1_encode(flat), jnp.asarray(mask)
+
+
 def _aggregate_verify_kernel(pk_aff, h_aff, sig_aff):
     """Distinct-message aggregate verification (blst.rs:244-255 semantics):
     check prod_i e(pk_i, H(m_i)) * e(-G1, sig) == 1 with ONE final exp.
