@@ -124,6 +124,12 @@ class BeaconChain:
         from .events import EventBroadcaster
 
         self.events = EventBroadcaster()
+        # per-validator performance + block latency attribution
+        # (validator_monitor.rs, block_times_cache.rs)
+        from .validator_monitor import BlockTimesCache, ValidatorMonitor
+
+        self.validator_monitor = ValidatorMonitor()
+        self.block_times = BlockTimesCache()
         self.store = store or HotColdDB(types_family=self.types)
         self.log = get_logger("beacon_chain")
         self.slot_clock = slot_clock
@@ -202,6 +208,7 @@ class BeaconChain:
                              from_rpc=False) -> bytes:
         block = signed_block.message
         block_root = block.root()
+        self.block_times.observe(block_root, int(block.slot))
         # --- gossip-tier structural checks ---------------------------------
         if block_root in self._observed_blocks:
             raise BlockError("block already known")
@@ -310,6 +317,19 @@ class BeaconChain:
         self._observed_blocks.add(block_root)
         self.pubkey_cache.update(state)
         BLOCKS_IMPORTED.inc()
+        self.block_times.imported(block_root, int(block.slot))
+        if self.validator_monitor.validators or self.validator_monitor.auto_register:
+            self.validator_monitor.process_block(
+                block,
+                lambda e: self.committee_cache(state, e),
+                self.preset,
+            )
+            if hasattr(block.body, "sync_aggregate"):
+                from .sync_committee import sync_committee_indices
+
+                self.validator_monitor.process_sync_aggregate(
+                    block.body.sync_aggregate, sync_committee_indices(state)
+                )
         self.events.emit(
             "block",
             {
@@ -498,6 +518,7 @@ class BeaconChain:
             self.slot_clock.current_slot() if self.slot_clock else None,
         )
         if self.head_root != old:
+            self.block_times.set_head(self.head_root)
             head_state = self._states.get(self.head_root)
             self.events.emit(
                 "head",

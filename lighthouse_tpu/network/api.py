@@ -652,6 +652,26 @@ class BeaconApiServer:
                 ] = signed
             h._send(200, {})
             return
+        if path.startswith("/eth/v1/validator/liveness/"):
+            # lighthouse's liveness endpoint (doppelganger_service.rs polls
+            # it): a validator is live in an epoch if the chain saw any
+            # participation flag for it in that epoch's participation list
+            epoch = int(path.split("/")[-1])
+            indices = [int(x) for x in json.loads(body)]
+            state = chain.head_state()
+            current = int(state.slot) // chain.preset.slots_per_epoch
+            if epoch == current:
+                participation = list(state.current_epoch_participation)
+            elif epoch == current - 1:
+                participation = list(state.previous_epoch_participation)
+            else:
+                participation = []
+            out = []
+            for i in indices:
+                live = i < len(participation) and participation[i] != 0
+                out.append({"index": str(i), "is_live": bool(live)})
+            h._send(200, {"data": out})
+            return
         if path.startswith("/eth/v1/validator/duties/sync/"):
             from ..beacon.sync_committee import sync_committee_indices
 
@@ -859,6 +879,11 @@ class BeaconApiClient:
         return self._get(
             f"/eth/v1/beacon/light_client/bootstrap/0x{block_root.hex()}"
         )
+
+    def validator_liveness(self, epoch: int, indices: list[int]) -> list[dict]:
+        return self._post(
+            f"/eth/v1/validator/liveness/{epoch}", [str(i) for i in indices]
+        )["data"]
 
     def stream_events(self, topics: list[str] | None = None,
                       timeout: float | None = None):

@@ -410,18 +410,37 @@ class SyncCommitteeService:
 class DoppelgangerService:
     """Liveness gate: refuse signing for the first N epochs after start if
     the validator appears already-active on the network
-    (doppelganger_service.rs)."""
+    (doppelganger_service.rs, 1,463 LoC — this keeps its two load-bearing
+    behaviors: the detection-window gate, and BN liveness polling over
+    HTTP via the /eth/v1/validator/liveness endpoint)."""
 
-    def __init__(self, detection_epochs: int = 2):
+    def __init__(self, detection_epochs: int = 2, client=None,
+                 indices: list[int] | None = None):
         self.detection_epochs = detection_epochs
         self.start_epoch: int | None = None
         self.seen_live: set[int] = set()
+        self.client = client  # BeaconApiClient (or fallback) for polling
+        self.indices = list(indices or [])
 
     def begin(self, epoch: int) -> None:
         self.start_epoch = epoch
 
     def observe_liveness(self, validator_index: int) -> None:
         self.seen_live.add(validator_index)
+
+    def poll(self, epoch: int) -> set[int]:
+        """One liveness poll against the BN (doppelganger_service.rs
+        beacon_node query): any index the CHAIN saw participating during
+        our detection window is a doppelganger — we have not signed yet."""
+        if self.client is None or not self.indices:
+            return set()
+        found = set()
+        for entry in self.client.validator_liveness(epoch, self.indices):
+            if entry.get("is_live"):
+                idx = int(entry["index"])
+                self.seen_live.add(idx)
+                found.add(idx)
+        return found
 
     def signing_enabled(self, validator_index: int, epoch: int) -> bool:
         if self.start_epoch is None:

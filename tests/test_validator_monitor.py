@@ -1,0 +1,153 @@
+"""Validator monitor, block times, liveness endpoint, doppelganger poll.
+
+Covers validator_monitor.rs (inclusion/proposal tracking + epoch summary),
+block_times_cache.rs (observed→imported→head attribution), the liveness
+HTTP endpoint, and doppelganger_service.rs's BN-polling half.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon.chain import BeaconChain
+from lighthouse_tpu.beacon.validator_monitor import (
+    BlockTimesCache,
+    ValidatorMonitor,
+)
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+from lighthouse_tpu.validator.client import (
+    AttestationService,
+    DoppelgangerService,
+    DutiesService,
+    ValidatorStore,
+)
+from lighthouse_tpu.validator.slashing_protection import SlashingDatabase
+
+N = 16
+
+
+@pytest.fixture()
+def rig():
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(N, spec, fork="altair")
+    chain = BeaconChain(spec, state, None, fork="altair")
+    store = ValidatorStore(
+        keys={kp[1].to_bytes(): kp[0] for kp in keys},
+        slashing_db=SlashingDatabase(":memory:"),
+        index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+    )
+    att_svc = AttestationService(chain, store, DutiesService(chain, store))
+    return spec, chain, keys, att_svc
+
+
+def test_monitor_tracks_proposals_and_inclusions(rig):
+    spec, chain, keys, att_svc = rig
+    chain.validator_monitor.register(*range(N))
+    b1 = chain.produce_block(1, keys)
+    chain.process_block(b1)
+    # slot-1 attesters land via the op pool into block 2
+    for att in att_svc.attest(1):
+        chain.op_pool.insert_attestation(att)
+    b2 = chain.produce_block(2, keys)
+    chain.process_block(b2)
+    mon = chain.validator_monitor
+    proposer1 = int(b1.message.proposer_index)
+    assert mon.validators[proposer1].blocks_proposed >= 1
+    included = [
+        v.index for v in mon.validators.values() if v.attestations_included
+    ]
+    assert included  # the slot-1 committee members got credited
+    for v in mon.validators.values():
+        if v.attestations_included:
+            assert v.inclusion_delay_sum >= v.attestations_included  # delay>=1
+    summary = mon.summary(0)
+    assert summary["monitored"] == N
+    assert summary["attested"] == len(included)
+    assert summary["blocks_proposed"] >= 2
+    assert set(summary["missed"]) == set(range(N)) - set(included)
+
+
+def test_block_times_attribution(rig):
+    spec, chain, keys, _ = rig
+    blk = chain.produce_block(1, keys)
+    root = chain.process_block(blk)
+    attr = chain.block_times.attribution(root)
+    assert attr is not None and attr["slot"] == 1
+    assert attr["observed_to_imported"] >= 0
+    assert attr["imported_to_head"] >= 0
+
+
+def test_block_times_cache_bounded():
+    cache = BlockTimesCache(capacity=4)
+    for i in range(10):
+        cache.observe(bytes([i]) * 32, i)
+    assert len(cache._d) <= 4
+    assert cache.attribution(bytes([0]) * 32) is None  # evicted
+
+
+def test_monitor_sync_participation(rig):
+    spec, chain, keys, _ = rig
+    from lighthouse_tpu.beacon.sync_committee import sync_committee_indices
+    from lighthouse_tpu.validator.client import SyncCommitteeService
+
+    chain.validator_monitor.register(*range(N))
+    store = ValidatorStore(
+        keys={kp[1].to_bytes(): kp[0] for kp in keys},
+        slashing_db=SlashingDatabase(":memory:"),
+        index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+    )
+    svc = SyncCommitteeService(chain, store, spec)
+    chain.process_block(chain.produce_block(1, keys))
+    for subnet, msg in svc.produce_messages(1):
+        chain.process_sync_committee_message(msg, subnet)
+    for signed in svc.produce_contributions(1):
+        chain.process_sync_contribution(signed)
+    chain.process_block(chain.produce_block(2, keys))
+    assert any(
+        v.sync_signatures_included for v in chain.validator_monitor.validators.values()
+    )
+
+
+def test_liveness_endpoint_and_doppelganger_poll(rig):
+    """A validator that attested shows live; the doppelganger service
+    polling the BN refuses to enable signing for it."""
+    from lighthouse_tpu.beacon.node import BeaconNode
+    from lighthouse_tpu.network.api import BeaconApiClient
+
+    spec, _, keys, _ = rig
+    genesis, _ = interop_state(N, spec, fork="altair")
+    node = BeaconNode(spec, genesis, keypairs=keys, fork="altair")
+    node.start()
+    try:
+        client = BeaconApiClient(f"http://127.0.0.1:{node.api.port}")
+        node.produce_and_publish(1)
+        store = ValidatorStore(
+            keys={kp[1].to_bytes(): kp[0] for kp in keys},
+            slashing_db=SlashingDatabase(":memory:"),
+            index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+        )
+        att_svc = AttestationService(
+            node.chain, store, DutiesService(node.chain, store)
+        )
+        atts = att_svc.attest(1)
+        for att in atts:
+            node.chain.op_pool.insert_attestation(att)
+        node.produce_and_publish(2)  # inclusion sets participation flags
+        live_entries = client.validator_liveness(0, list(range(N)))
+        live = {int(e["index"]) for e in live_entries if e["is_live"]}
+        assert live  # the slot-1 committee participated in epoch 0
+        # doppelganger: polling marks those indices as seen-live
+        dg = DoppelgangerService(
+            detection_epochs=2, client=client, indices=list(range(N))
+        )
+        dg.begin(epoch=0)
+        found = dg.poll(0)
+        assert found == live
+        for vi in live:
+            assert not dg.signing_enabled(vi, epoch=5)  # never signs
+        not_live = next(i for i in range(N) if i not in live)
+        assert not dg.signing_enabled(not_live, epoch=0)  # window holds
+        assert dg.signing_enabled(not_live, epoch=2)  # window passed
+    finally:
+        node.stop()
