@@ -14,9 +14,27 @@ from dataclasses import dataclass
 
 from .kv import DBColumn, KeyValueStore, MemoryStore
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 SPLIT_KEY = b"split"
 SCHEMA_KEY = b"schema"
+
+
+def _migrate_v1_to_v2(db: KeyValueStore) -> None:
+    """v2 adds the slot → block-root forward index (forwards_iter.rs's
+    chain-spine column): backfill it from every stored block."""
+    for col in (DBColumn.BEACON_BLOCK, DBColumn.COLD_BLOCK):
+        for root in db.keys(col):
+            raw = db.get(col, root)
+            slot = HotColdDB._block_slot(raw) if raw else None
+            if slot is not None:
+                db.put(
+                    DBColumn.BEACON_BLOCK_ROOTS, slot.to_bytes(8, "big"), root
+                )
+
+
+# schema upgrade registry (store/src/metadata.rs SchemaVersion +
+# beacon_chain/src/schema_change* walked by database_manager)
+_MIGRATIONS = {1: _migrate_v1_to_v2}
 
 
 @dataclass
@@ -52,11 +70,22 @@ class HotColdDB:
             )
         else:
             found = int.from_bytes(raw, "little")
-            if found != SCHEMA_VERSION:
+            if found > SCHEMA_VERSION:
                 raise IOError(
-                    f"schema v{found} needs migration to v{SCHEMA_VERSION} "
-                    "(database_manager analog)"
+                    f"database schema v{found} is NEWER than this build's "
+                    f"v{SCHEMA_VERSION}; refusing to downgrade"
                 )
+            while found < SCHEMA_VERSION:
+                migration = _MIGRATIONS.get(found)
+                if migration is None:
+                    raise IOError(f"no migration path from schema v{found}")
+                migration(self.db)
+                found += 1
+                self.db.put(
+                    DBColumn.BEACON_META, SCHEMA_KEY,
+                    found.to_bytes(4, "little"),
+                )
+            self.db.flush()
 
     # ------------------------------------------------------------- split
 
@@ -68,7 +97,15 @@ class HotColdDB:
     # ------------------------------------------------------------- blocks
 
     def put_block(self, block_root: bytes, signed_block) -> None:
-        self.db.put(DBColumn.BEACON_BLOCK, block_root, signed_block.encode())
+        raw = signed_block.encode()
+        self.db.put(DBColumn.BEACON_BLOCK, block_root, raw)
+        # slot → root forward index (last writer wins: the caller imports
+        # in fork-choice order, so the canonical chain overwrites forks)
+        self.db.put(
+            DBColumn.BEACON_BLOCK_ROOTS,
+            int(signed_block.message.slot).to_bytes(8, "big"),
+            block_root,
+        )
 
     def get_block(self, block_root: bytes, block_cls=None):
         for col in (DBColumn.BEACON_BLOCK, DBColumn.COLD_BLOCK):
@@ -176,6 +213,37 @@ class HotColdDB:
         if len(signed_block_bytes) < 108:
             return None
         return int.from_bytes(signed_block_bytes[100:108], "little")
+
+    # ------------------------------------------------------- iteration/GC
+
+    def forwards_block_roots_iterator(self, start_slot: int, end_slot: int):
+        """(slot, block_root) ascending over the canonical spine
+        (store/src/forwards_iter.rs): slots without a block are skipped
+        (empty slots have no root of their own)."""
+        for slot in range(start_slot, end_slot + 1):
+            root = self.db.get(
+                DBColumn.BEACON_BLOCK_ROOTS, slot.to_bytes(8, "big")
+            )
+            if root is not None:
+                yield slot, root
+
+    def garbage_collect(self, keep_state_roots: set[bytes]) -> dict:
+        """Drop abandoned hot states (pruned forks that never finalized —
+        store/src/garbage_collection.rs): anything hot, at/below the
+        split, and not in ``keep_state_roots``."""
+        split_slot = self.split.slot
+        dropped = 0
+        for root in list(self.db.keys(DBColumn.BEACON_STATE)):
+            slot = self.state_slot(root)
+            if slot is None or slot > split_slot:
+                continue
+            if root in keep_state_roots:
+                continue
+            self.db.delete(DBColumn.BEACON_STATE, root)
+            self.db.delete(DBColumn.BEACON_STATE_SUMMARY, root)
+            dropped += 1
+        self.db.flush()
+        return {"states_dropped": dropped}
 
     # ------------------------------------------------------------- misc
 
