@@ -71,6 +71,26 @@ class ChainConfig:
     committee_cache_size: int = 4
 
 
+@dataclass
+class GossipVerifiedBlock:
+    """Rung 1 of the type-state ladder (block_verification.rs:20-44):
+    structurally valid, parent state advanced to the block's slot."""
+
+    signed_block: object
+    block_root: bytes
+    state: object
+    epoch: int
+    cache: object
+    proposal_verified: bool = False
+
+
+@dataclass
+class SignatureVerifiedBlock:
+    """Rung 2: every signature of the block verified in one bulk batch."""
+
+    gossip: GossipVerifiedBlock
+
+
 class ValidatorPubkeyCache:
     """Index -> decompressed PublicKey (validator_pubkey_cache.rs:9-16).
     This is the marshaling table the device backend consumes; grows
@@ -194,22 +214,35 @@ class BeaconChain:
 
     def process_block(self, signed_block, verify_signatures: bool = True,
                       from_rpc: bool = False) -> bytes:
-        """The full ladder (block_verification.rs:20-44):
-        SignedBeaconBlock -> gossip checks -> bulk signature verify ->
-        state transition -> fork choice + store import.  Returns the block
+        """The full ladder (block_verification.rs:20-44) as a composition
+        of the STAGE methods below — SignedBeaconBlock →
+        gossip_verify_block → signature_verify_block →
+        import_verified_block — so the scheduler (beacon/processor.py) can
+        also run the rungs as separate pipeline stages.  Returns the block
         root.  ``from_rpc``: sync/RPC imports skip the gossip-tier clock
         check (the reference's gossip vs rpc block entry distinction)."""
         with BLOCK_TIMES.timer():
-            return self._process_block_inner(
-                signed_block, verify_signatures, from_rpc
+            # proposal signature rides the bulk batch (one device call for
+            # the whole block) rather than the gossip tier's single verify
+            gvb = self.gossip_verify_block(
+                signed_block, from_rpc=from_rpc, verify_proposal=False
             )
+            if verify_signatures:
+                svb = self.signature_verify_block(gvb, include_proposal=True)
+            else:
+                svb = SignatureVerifiedBlock(gossip=gvb)
+            return self.import_verified_block(svb)
 
-    def _process_block_inner(self, signed_block, verify_signatures,
-                             from_rpc=False) -> bytes:
+    # --- the type-state rungs (block_verification.rs:20-44) ---------------
+
+    def gossip_verify_block(self, signed_block, from_rpc: bool = False,
+                            verify_proposal: bool = True):
+        """Rung 1 — GossipVerifiedBlock: dedup, parent known, clock bound,
+        parent state advanced, and (in true gossip use) the proposer's
+        signature over the block root."""
         block = signed_block.message
         block_root = block.root()
         self.block_times.observe(block_root, int(block.slot))
-        # --- gossip-tier structural checks ---------------------------------
         if block_root in self._observed_blocks:
             raise BlockError("block already known")
         parent_state = self._states.get(bytes(block.parent_root))
@@ -218,42 +251,94 @@ class BeaconChain:
         if self.slot_clock is not None and not from_rpc:
             if block.slot > self.slot_clock.current_slot() + 1:
                 raise BlockError("block from the future")
-        # --- advance parent state to the block's slot ----------------------
         state = parent_state.copy()
         state = process_slots(state, block.slot, self.spec)
         epoch = block.slot // self.preset.slots_per_epoch
         cache = self.committee_cache(state, epoch)
-        # --- bulk signature verification (SignatureVerifiedBlock rung) -----
-        if verify_signatures:
+        if verify_proposal:
             self.pubkey_cache.update(state)
-            verifier = BlockSignatureVerifier(state, self.get_pubkey, self.spec)
-            sync_parts = None
-            prev_root = None
-            if hasattr(block.body, "sync_aggregate"):
-                from .sync_committee import sync_committee_indices
-
-                idxs = sync_committee_indices(state)
-                sync_parts = [
-                    vi
-                    for bit, vi in zip(
-                        block.body.sync_aggregate.sync_committee_bits, idxs
-                    )
-                    if bit
-                ]
-                prev_root = bytes(
-                    state.block_roots[
-                        (block.slot - 1) % self.preset.slots_per_historical_root
-                    ]
+            try:
+                s = sets.block_proposal_signature_set(
+                    state, self.get_pubkey, signed_block, self.preset,
+                    block_root=block_root,
                 )
-            verifier.include_all(
-                signed_block,
-                lambda e: cache if e == epoch else self.committee_cache(state, e),
-                sync_participants=sync_parts,
-                block_root_at_prev=prev_root,
+                ok = s.verify()
+            except sets.SignatureSetError as e:
+                raise BlockError(f"proposer signature undecodable: {e}") from None
+            if not ok:
+                raise BlockError("proposer signature invalid")
+        return GossipVerifiedBlock(
+            signed_block=signed_block,
+            block_root=block_root,
+            state=state,
+            epoch=epoch,
+            cache=cache,
+            proposal_verified=verify_proposal,
+        )
+
+    def signature_verify_block(self, gvb: "GossipVerifiedBlock",
+                               include_proposal: bool | None = None):
+        """Rung 2 — SignatureVerifiedBlock: every remaining signature of
+        the block in ONE bulk batch (block_signature_verifier.rs
+        verify_entire_block; the TPU batch path)."""
+        signed_block = gvb.signed_block
+        block = signed_block.message
+        state = gvb.state
+        if include_proposal is None:
+            include_proposal = not gvb.proposal_verified
+        self.pubkey_cache.update(state)
+        verifier = BlockSignatureVerifier(state, self.get_pubkey, self.spec)
+        sync_parts = None
+        prev_root = None
+        if hasattr(block.body, "sync_aggregate"):
+            from .sync_committee import sync_committee_indices
+
+            idxs = sync_committee_indices(state)
+            sync_parts = [
+                vi
+                for bit, vi in zip(
+                    block.body.sync_aggregate.sync_committee_bits, idxs
+                )
+                if bit
+            ]
+            prev_root = bytes(
+                state.block_roots[
+                    (block.slot - 1) % self.preset.slots_per_historical_root
+                ]
             )
-            if not verifier.verify():
-                raise BlockError("block signature verification failed")
-        # --- state transition (signatures already checked in bulk) ---------
+        cache_for = (
+            lambda e: gvb.cache if e == gvb.epoch
+            else self.committee_cache(state, e)
+        )
+        if include_proposal:
+            verifier.include_all(
+                signed_block, cache_for,
+                sync_participants=sync_parts, block_root_at_prev=prev_root,
+            )
+        else:
+            verifier.include_randao_reveal(block)
+            verifier.include_proposer_slashings(block)
+            verifier.include_attester_slashings(block)
+            verifier.include_attestations(block, cache_for)
+            verifier.include_exits(block)
+            if sync_parts is not None:
+                verifier.include_sync_aggregate(
+                    block, sync_parts, prev_root or bytes(32)
+                )
+            verifier.include_bls_to_execution_changes(block)
+        if not verifier.verify():
+            raise BlockError("block signature verification failed")
+        return SignatureVerifiedBlock(gossip=gvb)
+
+    def import_verified_block(self, svb: "SignatureVerifiedBlock") -> bytes:
+        """Rung 3+4 — ExecutionPending → import: state transition, EL
+        verdict, data availability, fork choice, store, caches, events."""
+        gvb = svb.gossip
+        signed_block = gvb.signed_block
+        block = signed_block.message
+        block_root = gvb.block_root
+        state = gvb.state
+        cache = gvb.cache
         try:
             st_process_block(
                 state,

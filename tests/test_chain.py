@@ -88,3 +88,124 @@ def test_real_crypto_short_chain():
     signed.signature = (b"\x00" * 95 + b"\x01") * 1
     with pytest.raises(Exception):
         h.chain.process_block(signed, verify_signatures=True)
+
+
+# ---------------------------------------------------------------------------
+# Round-4 depth: the type-state ladder, re-orgs, equivocation, caches
+# (round-3 weak items 6 + 9)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fresh():
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.consensus import spec as S
+    from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(16, spec, fork="altair")
+    return BeaconChain(spec, state, None, fork="altair"), keys
+
+
+def test_staged_ladder_entry_points(fresh):
+    """block_verification.rs rungs as separate calls: GossipVerified →
+    SignatureVerified → import, with the proposal checked at rung 1."""
+    chain, keys = fresh
+    blk = chain.produce_block(1, keys)
+    gvb = chain.gossip_verify_block(blk, verify_proposal=True)
+    assert gvb.proposal_verified and gvb.block_root == blk.message.root()
+    svb = chain.signature_verify_block(gvb)  # proposal not re-verified
+    root = chain.import_verified_block(svb)
+    assert chain.head_root == root
+
+
+def test_gossip_rung_rejects_bad_proposal_signature(fresh):
+    chain, keys = fresh
+    blk = chain.produce_block(1, keys)
+    forged = type(blk)(message=blk.message, signature=b"\xaa" * 96)
+    with pytest.raises(BlockError, match="proposer signature|signature"):
+        chain.gossip_verify_block(forged, verify_proposal=True)
+
+
+def test_reorg_between_competing_forks(fresh):
+    """Two blocks at the same slot: attestation weight moves the head to
+    the competing fork and back (proto_array re-org behavior under the
+    chain engine, not just the fork-choice unit tests)."""
+    chain, keys = fresh
+    a = chain.produce_block(1, keys, graffiti=b"fork-a")
+    root_a = chain.process_block(a)
+    # competing block at the SAME slot from the same proposer (re-signed),
+    # built on the same parent: rewind production to genesis
+    chain.head_root = chain.genesis_block_root
+    b = chain.produce_block(1, keys, graffiti=b"fork-b")
+    root_b = chain.process_block(b)
+    assert root_a != root_b
+    head0 = chain.recompute_head()
+    assert head0 in (root_a, root_b)
+    loser = root_b if head0 == root_a else root_a
+    # attestations vote the loser: head must re-org to it
+    state = chain.state_for_block(loser)
+    cache = chain.committee_cache(state, 0)
+    committee = cache.committee(1, 0)
+    for vi in committee:
+        chain.fork_choice.process_attestation(int(vi), loser, 0, None)
+    assert chain.recompute_head() == loser
+    # both fork states retained and internally consistent
+    assert chain.state_for_block(root_a).root() != chain.state_for_block(
+        root_b
+    ).root()
+
+
+def test_equivocation_imports_without_cache_corruption(fresh):
+    """A proposer equivocating at one slot yields two valid imports whose
+    descendants both extend cleanly — shuffle/committee caches keyed by
+    state identity must not cross-contaminate forks."""
+    chain, keys = fresh
+    a = chain.produce_block(1, keys, graffiti=b"equiv-a")
+    root_a = chain.process_block(a)
+    chain.head_root = chain.genesis_block_root
+    b = chain.produce_block(1, keys, graffiti=b"equiv-b")
+    root_b = chain.process_block(b)
+    # extend whichever fork is NOT the head, then the head fork
+    head = chain.recompute_head()
+    other = root_b if head == root_a else root_a
+    # force production on the non-head fork by pointing head at it
+    chain.head_root = other
+    c = chain.produce_block(2, keys, graffiti=b"child-of-other")
+    root_c = chain.process_block(c)
+    assert bytes(c.message.parent_root) == other
+    post = chain.state_for_block(root_c)
+    assert int(post.slot) == 2
+    # fork choice sees all three as known blocks
+    for r in (root_a, root_b, root_c):
+        assert chain.fork_choice.contains_block(r)
+
+
+def test_attestations_verify_on_both_forks(fresh):
+    """Cache consistency: committee lookups against either fork's state
+    produce verifiable attestations for that fork."""
+    from lighthouse_tpu.validator.client import (
+        AttestationService,
+        DutiesService,
+        ValidatorStore,
+    )
+    from lighthouse_tpu.validator.slashing_protection import SlashingDatabase
+
+    chain, keys = fresh
+    a = chain.produce_block(1, keys, graffiti=b"cc-a")
+    root_a = chain.process_block(a)
+    chain.head_root = chain.genesis_block_root
+    b = chain.produce_block(1, keys, graffiti=b"cc-b")
+    root_b = chain.process_block(b)
+    for target in (root_a, root_b):
+        chain.head_root = target
+        store = ValidatorStore(
+            keys={kp[1].to_bytes(): kp[0] for kp in keys},
+            slashing_db=SlashingDatabase(":memory:"),
+            index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+        )
+        svc = AttestationService(chain, store, DutiesService(chain, store))
+        atts = svc.attest(1)
+        assert atts
+        for att in atts:
+            chain.process_attestation(att)  # signature verifies per fork
