@@ -58,13 +58,8 @@ class BeaconNode:
         self.chain = BeaconChain(
             spec, genesis_state.copy(), store, fork=fork, execution=execution
         )
-        self.digest = topics_mod.fork_digest(
-            spec, 0, bytes(genesis_state.genesis_validators_root)
-        )
-        self.block_topic = topics_mod.topic("beacon_block", self.digest)
-        self.attestation_topic = topics_mod.topic(
-            "beacon_aggregate_and_proof", self.digest
-        )
+        self._gvr = bytes(genesis_state.genesis_validators_root)
+        self.digest = topics_mod.fork_digest(spec, 0, self._gvr)
         # 2. transports
         self.host = Libp2pHost(port=tcp_port)
         self.discovery = None
@@ -87,47 +82,14 @@ class BeaconNode:
                 tcp=self.host.port,
                 extra={b"eth2": self.digest + bytes(12)},
             )
-        # 3. gossip subscriptions -> chain
-        self.host.subscribe(self.block_topic, self._on_gossip_block)
-        self.host.subscribe(self.attestation_topic, self._on_gossip_aggregate)
-        # attestation subnets (beacon_attestation_{i}) + the subnet service
-        # deciding long-lived/duty subscriptions + ENR advertisement
+        # 3. gossip subscriptions -> chain (one family per fork digest;
+        # maybe_rotate_fork_digest re-runs this at fork boundaries)
         from ..network.subnets import AttestationSubnetService
 
-        self.attestation_subnet_topics = [
-            topics_mod.attestation_subnet_topic(i, self.digest)
-            for i in range(spec.attestation_subnet_count)
-        ]
-        for i, t in enumerate(self.attestation_subnet_topics):
-            self.host.subscribe(
-                t,
-                lambda p, pid, subnet=i: self._on_gossip_attestation_single(
-                    p, pid, subnet
-                ),
-            )
         self.subnet_service = AttestationSubnetService(
             spec=spec, node_id=self.host.peer_id[:32].ljust(32, b"\x00")
         )
-        # sync-committee subnets + contribution topic (topics.rs:107)
-        self.sync_subnet_topics = [
-            topics_mod.sync_subnet_topic(i, self.digest)
-            for i in range(spec.sync_committee_subnet_count)
-        ]
-        for i, t in enumerate(self.sync_subnet_topics):
-            self.host.subscribe(
-                t, lambda p, pid, subnet=i: self._on_gossip_sync_message(p, pid, subnet)
-            )
-        self.contribution_topic = topics_mod.topic(
-            "sync_committee_contribution_and_proof", self.digest
-        )
-        self.host.subscribe(self.contribution_topic, self._on_gossip_contribution)
-        # deneb blob sidecar subnets (topics.rs:107 blob_sidecar_{index})
-        self.blob_topics = [
-            topics_mod.blob_sidecar_topic(i, self.digest)
-            for i in range(spec.preset.max_blobs_per_block)
-        ]
-        for t in self.blob_topics:
-            self.host.subscribe(t, self._on_gossip_blob)
+        self._subscribe_topics(self.digest)
         # blocks parked awaiting blob availability (reprocess-queue analog
         # for Availability::MissingComponents)
         self._pending_availability: dict[bytes, object] = {}
@@ -161,6 +123,79 @@ class BeaconNode:
             self.slasher = Slasher()
         self.slot_timer = None
         self._running = False
+
+    def _subscribe_topics(self, digest: bytes) -> None:
+        """Subscribe every gossip topic family under ``digest`` and point
+        the publish-side attributes at it."""
+        spec = self.spec
+        self.block_topic = topics_mod.topic("beacon_block", digest)
+        self.attestation_topic = topics_mod.topic(
+            "beacon_aggregate_and_proof", digest
+        )
+        self.host.subscribe(self.block_topic, self._on_gossip_block)
+        self.host.subscribe(self.attestation_topic, self._on_gossip_aggregate)
+        self.attestation_subnet_topics = [
+            topics_mod.attestation_subnet_topic(i, digest)
+            for i in range(spec.attestation_subnet_count)
+        ]
+        for i, t in enumerate(self.attestation_subnet_topics):
+            self.host.subscribe(
+                t,
+                lambda p, pid, subnet=i: self._on_gossip_attestation_single(
+                    p, pid, subnet
+                ),
+            )
+        self.sync_subnet_topics = [
+            topics_mod.sync_subnet_topic(i, digest)
+            for i in range(spec.sync_committee_subnet_count)
+        ]
+        for i, t in enumerate(self.sync_subnet_topics):
+            self.host.subscribe(
+                t, lambda p, pid, subnet=i: self._on_gossip_sync_message(p, pid, subnet)
+            )
+        self.contribution_topic = topics_mod.topic(
+            "sync_committee_contribution_and_proof", digest
+        )
+        self.host.subscribe(self.contribution_topic, self._on_gossip_contribution)
+        self.blob_topics = [
+            topics_mod.blob_sidecar_topic(i, digest)
+            for i in range(spec.preset.max_blobs_per_block)
+        ]
+        for t in self.blob_topics:
+            self.host.subscribe(t, self._on_gossip_blob)
+
+    def maybe_rotate_fork_digest(self, epoch: int) -> bool:
+        """At a scheduled fork boundary the wire identity changes: compute
+        the digest for ``epoch`` and, if it differs, subscribe the new
+        topic families and re-advertise the ENR (the reference subscribes
+        the new fork's topics around the boundary; old-digest
+        subscriptions stay up for stragglers).  Returns True on rotation."""
+        new = topics_mod.fork_digest(self.spec, epoch, self._gvr)
+        if new == self.digest:
+            return False
+        log.info(
+            "fork digest rotates %s -> %s at epoch %d",
+            self.digest.hex(), new.hex(), epoch,
+        )
+        self.digest = new
+        self._subscribe_topics(new)
+        # wire container classes follow the active fork
+        name = self.spec.fork_name_at_epoch(epoch)
+        if name != "base":
+            self.fork = name
+            self.block_cls = self.types.SignedBeaconBlock_BY_FORK[name]
+        if self.discovery is not None:
+            from ..network.enr import build_enr
+
+            self.discovery.enr = build_enr(
+                self.host.key,
+                seq=int(self.discovery.enr.seq) + 1,
+                ip4="127.0.0.1",
+                udp=self.discovery.port,
+                tcp=self.host.port,
+                extra={b"eth2": new + bytes(12)},
+            )
+        return True
 
     # -- service lifecycle (builder.rs build order) ------------------------
 
@@ -521,6 +556,9 @@ class BeaconNode:
         from ..utils.slot_clock import SlotTimer
 
         def on_slot(slot: int) -> None:
+            self.maybe_rotate_fork_digest(
+                slot // self.spec.preset.slots_per_epoch
+            )
             with self._chain_lock:  # atomic check-then-produce
                 if auto_propose and self.keypairs and slot > int(
                     self.chain.head_state().slot

@@ -45,6 +45,15 @@ class RemoteChain:
         root = bytes.fromhex(hdr["root"].removeprefix("0x"))
         if root != self._cached_root:
             state_root = hdr["header"]["message"]["state_root"]
+            # fork follows the head's epoch through the schedule (a VC
+            # whose BN crossed a boundary must decode the NEW fork's
+            # state; forks-off test specs keep the configured default)
+            epoch = int(hdr["header"]["message"]["slot"]) // (
+                self.preset.slots_per_epoch
+            )
+            name = self.spec.fork_name_at_epoch(epoch)
+            if name != "base":
+                self.fork = name
             raw = self.client.get_state_ssz(state_root)
             state_cls = self.types.BeaconState_BY_FORK[self.fork]
             self._cached_state = state_cls.deserialize_value(raw)
