@@ -87,6 +87,46 @@ class EngineApiClient:
         JSON shape (engine_api/json_structures.rs) and send it."""
         return self.new_payload(payload_to_json(payload))
 
+    def get_payload(self, payload_id: str) -> dict:
+        return self.call("engine_getPayloadV2", [payload_id])
+
+    def build_payload(self, state, spec, payload_cls):
+        """The production flow (engine_api.rs get_payload):
+        forkchoiceUpdated with payload attributes → payloadId →
+        engine_getPayload → decode into the consensus container."""
+        from ..consensus.state_processing.per_block import (
+            compute_timestamp_at_slot,
+            get_expected_withdrawals,
+        )
+
+        parent = bytes(state.latest_execution_payload_header.block_hash)
+        preset = spec.preset
+        epoch = state.slot // preset.slots_per_epoch
+        attrs = {
+            "timestamp": hex(compute_timestamp_at_slot(state, state.slot, spec)),
+            "prevRandao": "0x"
+            + bytes(
+                state.randao_mixes[epoch % preset.epochs_per_historical_vector]
+            ).hex(),
+            "suggestedFeeRecipient": "0x" + "00" * 20,
+        }
+        if "withdrawals" in payload_cls._fields:
+            attrs["withdrawals"] = [
+                {
+                    "index": hex(w.index),
+                    "validatorIndex": hex(w.validator_index),
+                    "address": "0x" + bytes(w.address).hex(),
+                    "amount": hex(w.amount),
+                }
+                for w in get_expected_withdrawals(state, spec)
+            ]
+        res = self.forkchoice_updated(parent, parent, parent, attrs)
+        payload_id = res.get("payloadId")
+        if payload_id is None:
+            raise IOError("engine returned no payloadId")
+        out = self.get_payload(payload_id)
+        return json_to_payload(payload_cls, out["executionPayload"])
+
     def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes,
                            payload_attributes: dict | None = None) -> dict:
         state = {
@@ -140,6 +180,50 @@ def payload_to_json(payload) -> dict:
         out["blobGasUsed"] = _qty(payload.blob_gas_used)
         out["excessBlobGas"] = _qty(payload.excess_blob_gas)
     return out
+
+
+def json_to_payload(payload_cls, data: dict):
+    """Engine-API JSON → consensus ExecutionPayload container (the inverse
+    of payload_to_json)."""
+    def b(x):
+        return bytes.fromhex(x.removeprefix("0x"))
+
+    def q(x):
+        return int(x, 16)
+
+    kwargs = dict(
+        parent_hash=b(data["parentHash"]),
+        fee_recipient=b(data["feeRecipient"]),
+        state_root=b(data["stateRoot"]),
+        receipts_root=b(data["receiptsRoot"]),
+        logs_bloom=b(data["logsBloom"]),
+        prev_randao=b(data["prevRandao"]),
+        block_number=q(data["blockNumber"]),
+        gas_limit=q(data["gasLimit"]),
+        gas_used=q(data["gasUsed"]),
+        timestamp=q(data["timestamp"]),
+        extra_data=b(data["extraData"]),
+        base_fee_per_gas=q(data["baseFeePerGas"]),
+        block_hash=b(data["blockHash"]),
+        transactions=[b(tx) for tx in data["transactions"]],
+    )
+    if "withdrawals" in payload_cls._fields:
+        kwargs["withdrawals"] = [
+            {
+                "index": q(w["index"]),
+                "validator_index": q(w["validatorIndex"]),
+                "address": b(w["address"]),
+                "amount": q(w["amount"]),
+            }
+            for w in data.get("withdrawals", [])
+        ]
+        from ..consensus.containers import Withdrawal
+
+        kwargs["withdrawals"] = [Withdrawal(**w) for w in kwargs["withdrawals"]]
+    if "blob_gas_used" in payload_cls._fields:
+        kwargs["blob_gas_used"] = q(data.get("blobGasUsed", "0x0"))
+        kwargs["excess_blob_gas"] = q(data.get("excessBlobGas", "0x0"))
+    return payload_cls(**kwargs)
 
 
 def notify_new_payload(engine, payload) -> PayloadStatus:
@@ -260,6 +344,128 @@ class MockExecutionEngine:
             commitments.append(c)
             proofs.append(p)
         return commitments, proofs, blobs
+
+
+class MockELServer:
+    """HTTP JSON-RPC Engine-API double (execution_layer/src/test_utils/
+    mock_execution_layer.rs): serves engine_newPayloadV2 /
+    engine_forkchoiceUpdatedV2 / engine_getPayloadV2 over a real socket
+    with JWT-header validation, backed by a MockExecutionEngine — the
+    EngineApiClient path is then testable end-to-end over the wire."""
+
+    def __init__(self, jwt_secret: bytes, engine: "MockExecutionEngine",
+                 port: int = 0):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer_engine = engine
+        self.jwt_secret = jwt_secret
+        # payloadId -> (state-ish context for build) is driven by the
+        # forkchoice attributes: the mock builds the payload AT fcu time
+        self._payloads: dict[str, dict] = {}
+        self._next_id = [0]
+        mock = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                auth = self.headers.get("Authorization", "")
+                if not auth.startswith("Bearer "):
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                method, params = req["method"], req["params"]
+                result = None
+                if method == "engine_newPayloadV2":
+                    block_hash = bytes.fromhex(
+                        params[0]["blockHash"].removeprefix("0x")
+                    )
+                    status = outer_engine.new_payload(block_hash)
+                    result = {"status": status.value, "latestValidHash": None}
+                elif method == "engine_forkchoiceUpdatedV2":
+                    attrs = params[1]
+                    payload_id = None
+                    if attrs:
+                        mock._next_id[0] += 1
+                        payload_id = hex(mock._next_id[0])
+                        mock._payloads[payload_id] = {
+                            "head": params[0]["headBlockHash"],
+                            "attrs": attrs,
+                        }
+                    result = {
+                        "payloadStatus": {"status": "VALID"},
+                        "payloadId": payload_id,
+                    }
+                elif method == "engine_getPayloadV2":
+                    ctx = mock._payloads.pop(params[0], None)
+                    if ctx is None:
+                        result = None
+                    else:
+                        result = {
+                            "executionPayload": mock._assemble(ctx),
+                            "blockValue": "0x0",
+                        }
+                body = json.dumps(
+                    {"jsonrpc": "2.0", "id": req["id"], "result": result}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="mock-el"
+        )
+
+    def _assemble(self, ctx: dict) -> dict:
+        """Build the payload JSON from the stored forkchoice attributes
+        (the mock EL's block production)."""
+        parent = bytes.fromhex(ctx["head"].removeprefix("0x"))
+        attrs = ctx["attrs"]
+        # consensus checks parent_hash/randao/timestamp, not EL numbering;
+        # the timestamp gives a monotonic stand-in block number
+        number = int(attrs["timestamp"], 16) % 2**32
+        block_hash = hashlib.sha256(
+            b"mock-el-http" + parent + attrs["timestamp"].encode()
+        ).digest()
+        out = {
+            "parentHash": "0x" + parent.hex(),
+            "feeRecipient": attrs.get(
+                "suggestedFeeRecipient", "0x" + "00" * 20
+            ),
+            "stateRoot": "0x" + hashlib.sha256(block_hash).digest().hex(),
+            "receiptsRoot": "0x" + "00" * 32,
+            "logsBloom": "0x" + "00" * 256,
+            "prevRandao": attrs["prevRandao"],
+            "blockNumber": hex(number),
+            "gasLimit": hex(30_000_000),
+            "gasUsed": "0x0",
+            "timestamp": attrs["timestamp"],
+            "extraData": "0x",
+            "baseFeePerGas": "0x7",
+            "blockHash": "0x" + block_hash.hex(),
+            "transactions": [],
+        }
+        if "withdrawals" in attrs:
+            out["withdrawals"] = attrs["withdrawals"]
+        return out
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
 
 
 @dataclass

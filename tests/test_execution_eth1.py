@@ -143,3 +143,82 @@ def test_eth1_genesis_from_deposits():
     assert len(state.validators) == 8
     assert all(v.activation_epoch == 0 for v in state.validators)
     assert state.eth1_data.deposit_count == 8
+
+
+class TestEngineApiOverHttp:
+    """Round-4: the EngineApiClient production + verdict path end-to-end
+    over real HTTP JSON-RPC with JWT auth against the mock EL server
+    (execution_layer/src/test_utils/mock_execution_layer.rs analog)."""
+
+    def test_chain_produces_and_imports_via_http_engine(self):
+        from lighthouse_tpu.beacon.chain import BeaconChain
+        from lighthouse_tpu.beacon.execution import (
+            EngineApiClient,
+            MockELServer,
+            MockExecutionEngine,
+        )
+        from lighthouse_tpu.consensus import spec as S
+        from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+        from dataclasses import replace
+
+        spec = replace(
+            phase0_spec(S.MINIMAL),
+            altair_fork_epoch=0, bellatrix_fork_epoch=0,
+            capella_fork_epoch=0, deneb_fork_epoch=None,
+        )
+        state, keys = interop_state(16, spec, fork="capella")
+        secret = b"\x42" * 32
+        inner = MockExecutionEngine()
+        server = MockELServer(secret, inner)
+        server.start()
+        try:
+            client = EngineApiClient(server.url, secret)
+            chain = BeaconChain(
+                spec, state, None, fork="capella", execution=client
+            )
+            b1 = chain.produce_block(1, keys)
+            payload = b1.message.body.execution_payload
+            assert bytes(payload.parent_hash) == bytes(32)  # merge block
+            r1 = chain.process_block(b1)  # new_payload over HTTP
+            assert ("new_payload", bytes(payload.block_hash)) in inner.calls
+            b2 = chain.produce_block(2, keys)
+            assert bytes(b2.message.body.execution_payload.parent_hash) == (
+                bytes(payload.block_hash)
+            )
+            chain.process_block(b2)
+        finally:
+            server.stop()
+
+    def test_http_engine_invalid_payload_rejected(self):
+        from lighthouse_tpu.beacon.chain import BeaconChain, BlockError
+        from lighthouse_tpu.beacon.execution import (
+            EngineApiClient,
+            MockELServer,
+            MockExecutionEngine,
+        )
+        from lighthouse_tpu.consensus import spec as S
+        from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+        from dataclasses import replace
+
+        spec = replace(
+            phase0_spec(S.MINIMAL),
+            altair_fork_epoch=0, bellatrix_fork_epoch=0,
+            capella_fork_epoch=None, deneb_fork_epoch=None,
+        )
+        state, keys = interop_state(16, spec, fork="bellatrix")
+        inner = MockExecutionEngine()
+        server = MockELServer(b"\x01" * 32, inner)
+        server.start()
+        try:
+            client = EngineApiClient(server.url, b"\x01" * 32)
+            chain = BeaconChain(
+                spec, state, None, fork="bellatrix", execution=client
+            )
+            blk = chain.produce_block(1, keys)
+            inner.inject_invalid(
+                bytes(blk.message.body.execution_payload.block_hash)
+            )
+            with pytest.raises(BlockError, match="rejected"):
+                chain.process_block(blk)
+        finally:
+            server.stop()
