@@ -83,3 +83,118 @@ class WatchService:
 
     def export_json(self) -> str:
         return json.dumps([asdict(f) for _, f in sorted(self.facts.items())])
+
+
+# ---------------------------------------------------------------------------
+# Round-4 analytics depth (watch/src/updater/: rewards, suboptimal
+# attestations, packing efficiency, blockprint-style proposer profiling)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EpochRewards:
+    epoch: int
+    total_delta: int  # registry-wide balance delta over the epoch
+    per_validator: dict
+
+
+@dataclass
+class AttestationQuality:
+    """watch's suboptimal_attestations tracker: per epoch, how many
+    included attestations earned each timeliness flag."""
+
+    epoch: int
+    included: int
+    timely_source: int
+    timely_target: int
+    timely_head: int
+
+
+class WatchAnalytics:
+    """Deeper analytics over the same pull loop: balance-derived rewards
+    per epoch, attestation timeliness quality, block packing efficiency,
+    and graffiti-based proposer profiling (the blockprint analog —
+    fingerprinting by graffiti pattern rather than an ML classifier)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.rewards: dict[int, EpochRewards] = {}
+        self.quality: dict[int, AttestationQuality] = {}
+        self._epoch_start_balances: dict[int, list[int]] = {}
+
+    def snapshot_epoch_start(self, epoch: int) -> None:
+        state = self.chain.head_state()
+        self._epoch_start_balances[epoch] = [int(b) for b in state.balances]
+
+    def close_epoch(self, epoch: int) -> EpochRewards | None:
+        """Compute per-validator balance deltas across the epoch (the
+        rewards tracker: actual earned gwei, every component included)."""
+        start = self._epoch_start_balances.get(epoch)
+        if start is None:
+            return None
+        state = self.chain.head_state()
+        now = [int(b) for b in state.balances]
+        per_validator = {
+            i: now[i] - start[i]
+            for i in range(min(len(start), len(now)))
+            if now[i] != start[i]
+        }
+        rewards = EpochRewards(
+            epoch=epoch,
+            total_delta=sum(per_validator.values()),
+            per_validator=per_validator,
+        )
+        self.rewards[epoch] = rewards
+        return rewards
+
+    def record_participation(self, epoch: int) -> AttestationQuality:
+        """Timeliness flags straight from the participation registry —
+        the suboptimal-attestation signal (flags missing = late votes)."""
+        from ..consensus.state_processing.arrays import (
+            TIMELY_HEAD_FLAG_INDEX,
+            TIMELY_SOURCE_FLAG_INDEX,
+            TIMELY_TARGET_FLAG_INDEX,
+        )
+
+        state = self.chain.head_state()
+        current = int(state.slot) // self.chain.preset.slots_per_epoch
+        if epoch == current:
+            flags = list(state.current_epoch_participation)
+        else:
+            flags = list(state.previous_epoch_participation)
+        q = AttestationQuality(
+            epoch=epoch,
+            included=sum(1 for f in flags if f),
+            timely_source=sum(
+                1 for f in flags if f >> TIMELY_SOURCE_FLAG_INDEX & 1
+            ),
+            timely_target=sum(
+                1 for f in flags if f >> TIMELY_TARGET_FLAG_INDEX & 1
+            ),
+            timely_head=sum(
+                1 for f in flags if f >> TIMELY_HEAD_FLAG_INDEX & 1
+            ),
+        )
+        self.quality[epoch] = q
+        return q
+
+    def packing_efficiency(self, watch: WatchService) -> float:
+        """Included attestation slots vs available (the packing tracker):
+        1.0 = every produced block carried attestations."""
+        proposed = [f for f in watch.facts.values() if f.proposed and f.slot > 1]
+        if not proposed:
+            return 0.0
+        carrying = sum(1 for f in proposed if f.attestation_count > 0)
+        return carrying / len(proposed)
+
+    def proposer_fingerprints(self, watch: WatchService) -> dict[str, list[int]]:
+        """blockprint's question ("which client built this block?")
+        answered with the observable we have: graffiti prefix clusters
+        per proposer."""
+        out: dict[str, list[int]] = {}
+        for f in watch.facts.values():
+            if not f.proposed or f.proposer_index is None:
+                continue
+            key = f.graffiti.split("/")[0] if f.graffiti else "(none)"
+            out.setdefault(key, []).append(f.proposer_index)
+        return out
