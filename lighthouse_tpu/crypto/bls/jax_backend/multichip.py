@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as PS
 
-from ....parallel.mesh import allgather_tree, and_reduce, batch_spec
+from ....parallel.mesh import allgather_tree, and_reduce, batch_spec, ring_reduce
 from . import fp as F
 from . import pairing as PR
 from . import points as P
@@ -74,6 +74,47 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
         local_part,
         mesh=mesh,
         in_specs=(in_spec, in_spec, in_spec, in_spec),
+        out_specs=PS(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_pair_sharded_aggregate_verify(mesh: Mesh, axis: str = "batch"):
+    """Shard the PAIRS of one large accumulation across the mesh — the
+    SURVEY §2.8/§5 "sequence scaling" axis.  One aggregate-verify
+    (blst.rs:244-255: distinct messages, ONE signature) whose (pk_i, H_i)
+    pairs spread over devices; each device Miller-loops its pair shard and
+    multiplies its local GT partial, the partials combine with an fp12
+    RING-reduction over ICI (the exact ring-attention accumulation shape —
+    the GT product is associative), and the single final exponentiation
+    runs replicated.
+
+    Returns fn(pk_aff, h_aff, sig_aff) -> bool: pk/h carry the global pair
+    count on the trailing axis (divisible by the mesh size); sig is the
+    batch-1 aggregate signature, replicated."""
+    from jax import shard_map
+
+    pair_spec = batch_spec(2, axis=axis)
+
+    def local_part(pk_aff, h_aff, sig_aff):
+        ok_sub = jnp.all(P.g2_subgroup_check(sig_aff))
+        f_local = PR.miller_loop(pk_aff, h_aff)
+        g_local = PR.gt_product(f_local)  # one fp12 partial per device
+        # --- the ring: N-1 ppermute hops, each folding the neighbour's
+        # partial into the accumulator (ICI traffic = one fp12 per hop) ---
+        g = ring_reduce(g_local, T.fp12_mul, axis)
+        # --- replicated epilogue: fold e(-G1, sig), final exp ----------
+        neg_gen = _neg_gen_const()
+        f_last = PR.miller_loop(neg_gen, sig_aff)
+        total = T.fp12_mul(PR.gt_product(g), f_last)
+        ok_pair = PR.final_exp_is_one(total)
+        return jnp.reshape(ok_pair & ok_sub, ())
+
+    sharded = shard_map(
+        local_part,
+        mesh=mesh,
+        in_specs=(pair_spec, pair_spec, PS()),
         out_specs=PS(),
         check_vma=False,
     )

@@ -54,6 +54,31 @@ def allgather_tree(tree, axis: str = BATCH_AXIS):
     )
 
 
+def ring_reduce(tree, combine, axis: str = BATCH_AXIS):
+    """Ring-reduction of a per-device pytree with an arbitrary associative
+    ``combine(acc, incoming)`` — the accumulation pattern ring attention
+    uses for softmax partials (SURVEY §2.8/§5 "sequence scaling"): N-1
+    ppermute hops around the ring, each device folding its neighbour's
+    partial into its accumulator; after the loop every device holds the
+    full product.  For non-commutative-friendly shapes prefer this over
+    all_gather when the partials are large (one hop in flight instead of
+    an N-way gather)."""
+    n = jax.lax.axis_size(axis)  # static: the mesh extent
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(t):
+        return jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis, perm=perm), t
+        )
+
+    acc = tree
+    incoming = tree
+    for _ in range(n - 1):
+        incoming = hop(incoming)
+        acc = combine(acc, incoming)
+    return acc
+
+
 def and_reduce(ok, axis: str = BATCH_AXIS):
     """Global conjunction of per-device booleans (the AND-reduce of the
     reference's chunked batch verification)."""

@@ -33,3 +33,38 @@ def test_sharded_matches_single_chip():
     sharded = make_verify_sharded(mesh)
     single = jax.jit(_verify_kernel)
     assert bool(sharded(*args)) == bool(single(*args)) is True
+
+
+@pytest.mark.slow
+def test_pair_sharded_aggregate_verify_ring():
+    """SURVEY §2.8 'sequence scaling': the pairs of ONE aggregate-verify
+    accumulation shard across 8 devices and the GT partials combine via
+    the fp12 ring-reduction; accept + reject cases."""
+    import jax
+    from jax.sharding import Mesh
+
+    from lighthouse_tpu.crypto.bls.api import SecretKey
+    from lighthouse_tpu.crypto.bls.api import AggregateSignature
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lighthouse_tpu.crypto.bls.jax_backend import points as P
+    from lighthouse_tpu.crypto.bls.jax_backend.multichip import (
+        make_pair_sharded_aggregate_verify,
+    )
+
+    graft._enable_compile_cache(jax)
+    n_pairs = 8
+    sks = [SecretKey(7000 + i) for i in range(n_pairs)]
+    msgs = [bytes([i]) * 32 for i in range(n_pairs)]
+    sig = AggregateSignature.aggregate(
+        [sk.sign(m) for sk, m in zip(sks, msgs)]
+    )
+    pk_enc = P.g1_encode([sk.public_key().point for sk in sks])
+    h_enc = P.g2_encode([hash_to_g2(m) for m in msgs])
+    sig_enc = P.g2_encode([sig.signature.point])
+    mesh = Mesh(np.array(__import__("jax").devices()[:8]), ("batch",))
+    fn = make_pair_sharded_aggregate_verify(mesh)
+    assert bool(fn(pk_enc, h_enc, sig_enc)) is True
+    # one wrong pair poisons the whole accumulation
+    bad = [sk.public_key().point for sk in sks]
+    bad[3] = SecretKey(424242).public_key().point
+    assert bool(fn(P.g1_encode(bad), h_enc, sig_enc)) is False
