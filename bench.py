@@ -133,6 +133,8 @@ def run_measurement(force_cpu: bool) -> None:
         f"{sets_per_s:.1f} sets/s",
         file=sys.stderr,
     )
+    from lighthouse_tpu.crypto.bls.jax_backend import fp as _fp
+
     result = {
         "metric": "tpu_batch_verify",
         "value": round(sets_per_s, 1),
@@ -143,6 +145,8 @@ def run_measurement(force_cpu: bool) -> None:
         "compile_sec": round(t_compile, 1),
         "host_marshal_sets_per_s": round(B / t_marshal, 1),
         "device_h2c": device_h2c,
+        "kernel": "pallas" if _fp.pallas_enabled() else "scan",
+        "chains": _fp.chains_active(),
     }
     if "TPU" in str(dev):
         _record_tpu_history(result)

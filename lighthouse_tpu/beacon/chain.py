@@ -618,28 +618,34 @@ class BeaconChain:
 
     # ------------------------------------------------------- production
 
-    def produce_block(self, slot: int, keypairs, graffiti: bytes = b""):
-        """produce_block.rs condensed: advance head state, pack ops, sign
-        with the proposer's key (the harness holds keys; the real VC signs
-        remotely)."""
+    def _advance_for_production(self, slot: int):
+        """Copy the head state and run slot processing up to ``slot`` —
+        the (expensive) shared prologue of both production entrypoints."""
         state = self.head_state().copy()
+        return process_slots(state, slot, self.spec)
+
+    def produce_unsigned_block(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes = b"",
+        advanced_state=None,
+    ):
+        """Server-side half of block production (produce_block.rs:1 — the
+        BN packs the block; the VC supplies only the randao reveal and
+        signs the result).  This is the body behind the
+        `/eth/v3/validator/blocks/{slot}` endpoint: advance head state,
+        max-cover-pack the op pool, attach sync aggregate / payload /
+        blobs, and fill state_root by running the transition.  Returns
+        (unsigned block, fork_name).  ``advanced_state`` lets a caller
+        that already paid the slot advance (produce_block) hand it in."""
         parent_root = self.head_root
-        state = process_slots(state, slot, self.spec)
+        state = (
+            advanced_state
+            if advanced_state is not None
+            else self._advance_for_production(slot)
+        )
         # dynamic fork: the post-advance state is the fork witness, so a
         # proposal straddling a fork boundary uses the NEW fork's containers
         fork_now = state_fork_name(state)
         proposer = cm.get_beacon_proposer_index(state, slot, self.preset)
-        sk = keypairs[proposer][0]
-        epoch = slot // self.preset.slots_per_epoch
-        fork, gvr = state.fork, state.genesis_validators_root
-
-        from ..consensus.containers import SigningData
-        from ..consensus.ssz import U64
-
-        randao_domain = sets.get_domain(fork, gvr, S.DOMAIN_RANDAO, epoch)
-        randao_root = SigningData(
-            object_root=U64.hash_tree_root(epoch), domain=randao_domain
-        ).root()
         # drain the naive pool: aggregates the node built from gossip
         # singles compete in max-cover packing alongside delivered ones
         for agg in self.naive_pool.get_aggregates():
@@ -648,7 +654,7 @@ class BeaconChain:
         ps, asl, exits = self.op_pool.get_slashings_and_exits(state, self.preset)
         body_cls = self.types.BeaconBlockBody_BY_FORK[fork_now]
         body_kwargs = dict(
-            randao_reveal=sk.sign(randao_root).to_bytes(),
+            randao_reveal=randao_reveal,
             graffiti=graffiti.ljust(32, b"\x00")[:32],
             attestations=atts,
             proposer_slashings=ps,
@@ -688,6 +694,29 @@ class BeaconChain:
             get_pubkey=self.get_pubkey,
         )
         block.state_root = state.root()
+        return block, fork_now
+
+    def produce_block(self, slot: int, keypairs, graffiti: bytes = b""):
+        """produce_block.rs condensed for in-process harnesses: sign the
+        randao reveal and the packed block with the proposer's key (the
+        real VC signs remotely via `/eth/v3/validator/blocks/{slot}`)."""
+        state = self._advance_for_production(slot)
+        proposer = cm.get_beacon_proposer_index(state, slot, self.preset)
+        sk = keypairs[proposer][0]
+        epoch = slot // self.preset.slots_per_epoch
+        fork, gvr = state.fork, state.genesis_validators_root
+
+        from ..consensus.containers import SigningData
+        from ..consensus.ssz import U64
+
+        randao_domain = sets.get_domain(fork, gvr, S.DOMAIN_RANDAO, epoch)
+        randao_root = SigningData(
+            object_root=U64.hash_tree_root(epoch), domain=randao_domain
+        ).root()
+        block, fork_now = self.produce_unsigned_block(
+            slot, sk.sign(randao_root).to_bytes(), graffiti,
+            advanced_state=state,
+        )
         block_domain = sets.get_domain(fork, gvr, S.DOMAIN_BEACON_PROPOSER, epoch)
         sig = sk.sign(S.compute_signing_root(block, block_domain))
         return self.types.SignedBeaconBlock_BY_FORK[fork_now](

@@ -46,6 +46,22 @@ class NaiveAggregationPool:
         sigs.append(bls.Signature.from_bytes(bytes(attestation.signature)))
         return True
 
+    def get_aggregate(self, data_root: bytes):
+        """Best-known aggregate for one data root (the BN half of
+        `/eth/v1/validator/aggregate_attestation`,
+        http_api/src/lib.rs:319 route tree); None if unseen."""
+        from ..consensus.containers import Attestation
+
+        entry = self._groups.get(data_root)
+        if entry is None:
+            return None
+        data, bits, sigs = entry
+        return Attestation(
+            aggregation_bits=list(bits),
+            data=data,
+            signature=bls.AggregateSignature.aggregate(sigs).to_bytes(),
+        )
+
     def get_aggregates(self) -> list:
         """One merged Attestation per data (the produce_block feed)."""
         from ..consensus.containers import Attestation

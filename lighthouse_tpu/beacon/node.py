@@ -812,6 +812,12 @@ class BeaconNode:
     def publish_aggregate(self, signed_aggregate) -> None:
         self.host.publish(self.attestation_topic, signed_aggregate.encode())
 
+    def subscribe_committee_duties(self, duties, committees_per_slot: int) -> None:
+        """`beacon_committee_subscriptions` ingress: register duty-driven
+        subnet subscriptions from a remote VC (attestation_subnets.rs
+        validator_subscriptions path; expiry rides the epoch tick)."""
+        self.subnet_service.on_duties(duties, committees_per_slot)
+
     # -- production (auto-propose dev mode) --------------------------------
 
     def produce_and_publish(self, slot: int):

@@ -184,28 +184,41 @@ class ChainSpec:
     attestation_subnet_count: int = 64
     sync_committee_subnet_count: int = 4
 
+    # the ONE fork schedule every derivation below reads (chain_spec.rs);
+    # adding a fork means adding exactly one row here
+    _FORK_ORDER = ("altair", "bellatrix", "capella", "deneb")
+
+    def fork_schedule(self) -> list:
+        """Scheduled forks as ascending [(epoch, name, version)], genesis
+        included (None-epoch forks are not scheduled)."""
+        sched = [(0, "base", self.genesis_fork_version)]
+        for name in self._FORK_ORDER:
+            e = getattr(self, f"{name}_fork_epoch")
+            if e is not None:
+                sched.append((e, name, getattr(self, f"{name}_fork_version")))
+        sched.sort(key=lambda t: t[0])
+        return sched
+
+    def fork_at_epoch(self, epoch: int) -> tuple:
+        """(previous_version, current_version, current_fork_epoch) active
+        at ``epoch`` — exactly the Fork container a post-upgrade state
+        carries, derivable without any state (the stateless VC's need)."""
+        sched = self.fork_schedule()
+        current = previous = sched[0]
+        for boundary in sched:
+            if boundary[0] <= epoch:
+                previous, current = current, boundary
+            else:
+                break
+        return previous[2], current[2], current[0]
+
     def fork_version_at_epoch(self, epoch: int) -> bytes:
         """Active fork version for an epoch (chain_spec.rs fork schedule)."""
-        sched = [
-            (self.deneb_fork_epoch, self.deneb_fork_version),
-            (self.capella_fork_epoch, self.capella_fork_version),
-            (self.bellatrix_fork_epoch, self.bellatrix_fork_version),
-            (self.altair_fork_epoch, self.altair_fork_version),
-        ]
-        for fork_epoch, version in sched:
-            if fork_epoch is not None and epoch >= fork_epoch:
-                return version
-        return self.genesis_fork_version
+        return self.fork_at_epoch(epoch)[1]
 
     def fork_name_at_epoch(self, epoch: int) -> str:
-        names = [
-            (self.deneb_fork_epoch, "deneb"),
-            (self.capella_fork_epoch, "capella"),
-            (self.bellatrix_fork_epoch, "bellatrix"),
-            (self.altair_fork_epoch, "altair"),
-        ]
-        for fork_epoch, name in names:
-            if fork_epoch is not None and epoch >= fork_epoch:
+        for fork_epoch, name, _ in reversed(self.fork_schedule()):
+            if epoch >= fork_epoch:
                 return name
         return "base"
 

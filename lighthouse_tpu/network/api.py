@@ -98,6 +98,13 @@ class BeaconApiServer:
         self.chain = chain
         # optional BeaconNode back-reference: enables node/peers endpoints
         self.node = node
+        # per-route hit counts (http_metrics analog; also lets the soak
+        # tests assert the remote VC never touches the debug endpoints).
+        # Numeric path segments (slots/epochs/ids) normalize to {n} so a
+        # long soak doesn't grow one key per slot; the lock is for
+        # ThreadingHTTPServer's concurrent handlers.
+        self.request_counts: dict[str, int] = {}
+        self._count_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -119,6 +126,8 @@ class BeaconApiServer:
                     outer._get(self)
                 except KeyError as e:
                     self._send(404, {"code": 404, "message": str(e)})
+                except ValueError as e:  # malformed query/params = client error
+                    self._send(400, {"code": 400, "message": str(e)})
                 except Exception as e:  # noqa: BLE001
                     self._send(500, {"code": 500, "message": repr(e)})
 
@@ -138,8 +147,16 @@ class BeaconApiServer:
 
     # ----------------------------------------------------------- routing
 
+    def _count(self, path: str) -> None:
+        route = "/".join(
+            "{n}" if seg.isdigit() else seg for seg in path.split("/")
+        )
+        with self._count_lock:
+            self.request_counts[route] = self.request_counts.get(route, 0) + 1
+
     def _get(self, h) -> None:
         path = h.path.split("?")[0].rstrip("/")
+        self._count(path)
         chain = self.chain
         if path == "/eth/v1/node/health":
             h._send(200, {})
@@ -301,7 +318,14 @@ class BeaconApiServer:
                         "slot": str(slot),
                     }
                 )
-            h._send(200, {"data": duties, "dependent_root": "0x" + "00" * 32})
+            h._send(
+                200,
+                {
+                    "data": duties,
+                    "dependent_root": "0x"
+                    + self._dependent_root(state, epoch, attester=False).hex(),
+                },
+            )
             return
         if path.startswith("/eth/v1/validator/duties/attester/"):
             # GET variant (the reference serves POST with index filters;
@@ -327,7 +351,96 @@ class BeaconApiServer:
                             "slot": str(slot),
                         }
                     )
-            h._send(200, {"data": duties, "dependent_root": "0x" + "00" * 32})
+            h._send(
+                200,
+                {
+                    "data": duties,
+                    "dependent_root": "0x"
+                    + self._dependent_root(state, epoch, attester=True).hex(),
+                },
+            )
+            return
+        if path.startswith("/eth/v3/validator/blocks/"):
+            # produce_block.rs over the wire: the VC supplies only the
+            # randao reveal; the BN advances the head state, max-cover
+            # packs the op pool, and returns the UNSIGNED block (v3 says
+            # blinded-or-full; we always serve full + a zero consensus
+            # value — no local bid comparison data at this endpoint).
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(h.path).query)
+            slot = int(path.split("/")[-1])
+            reveal = q.get("randao_reveal", [None])[0]
+            if reveal is None:
+                raise ValueError("randao_reveal is required")
+            graffiti = bytes.fromhex(
+                q.get("graffiti", ["0x"])[0].removeprefix("0x")
+            )
+            block, fork_now = chain.produce_unsigned_block(
+                slot, bytes.fromhex(reveal.removeprefix("0x")), graffiti
+            )
+            h._send(
+                200,
+                {
+                    "version": fork_now,
+                    "execution_payload_blinded": False,
+                    "execution_payload_value": "0",
+                    "consensus_block_value": "0",
+                    "data": to_json(type(block), block),
+                },
+            )
+            return
+        if path == "/eth/v1/validator/attestation_data":
+            # the BN-side attestation template (the VC no longer needs the
+            # state: validator/attestation_data in http_api/src/lib.rs) —
+            # same head/target/source derivation as the in-process
+            # AttestationService.
+            from urllib.parse import parse_qs, urlparse
+
+            from ..consensus.containers import AttestationData, Checkpoint
+
+            q = parse_qs(urlparse(h.path).query)
+            if "slot" not in q or "committee_index" not in q:
+                raise ValueError("slot and committee_index are required")
+            slot = int(q["slot"][0])
+            index = int(q["committee_index"][0])
+            state = chain.head_state()
+            head_root = chain.head_root
+            preset = chain.preset
+            epoch = slot // preset.slots_per_epoch
+            target_slot = epoch * preset.slots_per_epoch
+            if int(state.slot) > target_slot:
+                target_root = bytes(
+                    state.block_roots[
+                        target_slot % preset.slots_per_historical_root
+                    ]
+                )
+            else:
+                target_root = head_root
+            data = AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=epoch, root=target_root),
+            )
+            h._send(200, {"data": to_json(AttestationData, data)})
+            return
+        if path == "/eth/v1/validator/aggregate_attestation":
+            from urllib.parse import parse_qs, urlparse
+
+            from ..consensus.containers import Attestation
+
+            q = parse_qs(urlparse(h.path).query)
+            if "attestation_data_root" not in q:
+                raise ValueError("attestation_data_root is required")
+            root = bytes.fromhex(
+                q["attestation_data_root"][0].removeprefix("0x")
+            )
+            agg = chain.naive_pool.get_aggregate(root)
+            if agg is None:
+                raise KeyError("no aggregate known for that data root")
+            h._send(200, {"data": to_json(Attestation, agg)})
             return
         if path == "/eth/v1/config/spec":
             import dataclasses
@@ -544,6 +657,7 @@ class BeaconApiServer:
 
     def _post(self, h, body: bytes) -> None:
         path = h.path.rstrip("/")
+        self._count(path)
         chain = self.chain
         if path in ("/eth/v1/beacon/blocks", "/eth/v2/beacon/blocks"):
             ctype = h.headers.get("Content-Type", "application/json")
@@ -566,7 +680,33 @@ class BeaconApiServer:
             for i, item in enumerate(payload):
                 att = from_json(Attestation, item)
                 try:
-                    chain.process_attestation(att)
+                    # the pool endpoint receives UNAGGREGATED attestations
+                    # from VCs (http_api/src/lib.rs attestation publish):
+                    # single-bit ones ride the unaggregated ladder into
+                    # the naive pool so the BN can serve them back via
+                    # aggregate_attestation; merged ones take the
+                    # aggregate pipeline
+                    bits = [bool(b) for b in att.aggregation_bits]
+                    if sum(bits) == 1:
+                        chain.process_unaggregated_attestation(att)
+                        if self.node is not None:
+                            from ..network.topics import (
+                                compute_subnet_for_attestation,
+                            )
+
+                            cache = chain.committee_cache(
+                                chain.head_state(),
+                                int(att.data.slot)
+                                // chain.preset.slots_per_epoch,
+                            )
+                            subnet = compute_subnet_for_attestation(
+                                chain.spec, int(att.data.slot),
+                                int(att.data.index),
+                                cache.committees_per_slot,
+                            )
+                            self.node.publish_attestation_single(subnet, att)
+                    else:
+                        chain.process_attestation(att)
                 except Exception as e:  # collect per-index failures
                     failures.append({"index": i, "message": str(e)})
             if failures:
@@ -672,6 +812,116 @@ class BeaconApiServer:
                 out.append({"index": str(i), "is_live": bool(live)})
             h._send(200, {"data": out})
             return
+        if path.startswith("/eth/v1/validator/duties/attester/"):
+            # POST variant — the reference's VC<->BN duties contract
+            # (validator/duties/attester in http_api/src/lib.rs:319): the
+            # VC sends its indices, the BN shuffles server-side.  This is
+            # what lets the remote VC drop the O(state) debug fetch.
+            from ..consensus import committees as cm
+
+            epoch = int(path.split("/")[-1])
+            want = {int(x) for x in json.loads(body)}
+            state = chain.head_state()
+            cache = chain.committee_cache(state, epoch)
+            per_slot = cache.committees_per_slot
+            duties = []
+            for slot, index, committee in cm.iter_epoch_committees(
+                cache, epoch, chain.preset
+            ):
+                for pos, vi in enumerate(committee):
+                    if int(vi) not in want:
+                        continue
+                    duties.append(
+                        {
+                            "pubkey": "0x"
+                            + bytes(state.validators[int(vi)].pubkey).hex(),
+                            "validator_index": str(int(vi)),
+                            "committee_index": str(index),
+                            "committee_length": str(len(committee)),
+                            "committees_at_slot": str(per_slot),
+                            "validator_committee_index": str(pos),
+                            "slot": str(slot),
+                        }
+                    )
+            h._send(
+                200,
+                {
+                    "data": duties,
+                    "dependent_root": "0x"
+                    + self._dependent_root(state, epoch, attester=True).hex(),
+                    "execution_optimistic": False,
+                },
+            )
+            return
+        if path == "/eth/v1/validator/aggregate_and_proofs":
+            # publish_aggregate_and_proofs (publish_blocks.rs sibling):
+            # verify the envelope exactly like the gossip path (selection
+            # proof + outer signature; the indexed attestation inside is
+            # checked by process_attestation), import, then re-gossip.
+            from ..consensus.containers import SignedAggregateAndProof
+            from ..consensus.state_processing import signature_sets as sets_mod
+            from ..crypto.bls import api as bls
+
+            payload = json.loads(body)
+            failures = []
+            state = chain.head_state()
+            for i, item in enumerate(payload):
+                signed = from_json(SignedAggregateAndProof, item)
+                try:
+                    envelope = [
+                        sets_mod.selection_proof_signature_set(
+                            state, chain.get_pubkey,
+                            int(signed.message.aggregator_index),
+                            int(signed.message.aggregate.data.slot),
+                            bytes(signed.message.selection_proof),
+                            chain.preset,
+                        ),
+                        sets_mod.aggregate_and_proof_signature_set(
+                            state, chain.get_pubkey, signed, chain.preset
+                        ),
+                    ]
+                    if not bls.verify_signature_sets(envelope):
+                        raise ValueError("aggregate envelope invalid")
+                    chain.process_attestation(signed.message.aggregate)
+                    if self.node is not None:
+                        self.node.publish_aggregate(signed)
+                except Exception as e:  # noqa: BLE001
+                    failures.append({"index": i, "message": str(e)})
+            if failures:
+                h._send(400, {"code": 400,
+                              "message": "some aggregates failed",
+                              "failures": failures})
+            else:
+                h._send(200, {})
+            return
+        if path == "/eth/v1/validator/beacon_committee_subscriptions":
+            # subscribe_to_subnets: route duty subscriptions into the
+            # attestation-subnet service so the BN joins/aggregates on the
+            # right subnets (validator/beacon_committee_subscriptions).
+            payload = json.loads(body)
+            if self.node is not None and payload:
+                from ..validator.client import Duty
+
+                # committees_at_slot feeds the subnet derivation and may
+                # differ across items (epochs in one batch): group by it
+                # rather than flattening to one global value
+                by_count: dict[int, list] = {}
+                for item in payload:
+                    by_count.setdefault(
+                        int(item["committees_at_slot"]), []
+                    ).append(
+                        Duty(
+                            validator_index=int(item["validator_index"]),
+                            slot=int(item["slot"]),
+                            committee_index=int(item["committee_index"]),
+                            committee_position=0,
+                            committee_size=0,
+                        )
+                    )
+                for per_slot, duties in by_count.items():
+                    self.node.subscribe_committee_duties(duties, per_slot)
+            h._send(200, {})
+            return
         if path.startswith("/eth/v1/validator/duties/sync/"):
             from ..beacon.sync_committee import sync_committee_indices
 
@@ -695,6 +945,24 @@ class BeaconApiServer:
         raise KeyError(f"no route {path}")
 
     # ----------------------------------------------------------- helpers
+
+    def _dependent_root(self, state, epoch: int, attester: bool) -> bytes:
+        """The shuffling-decision anchor duties depend on (duties_service
+        .rs contract): the last block before epoch-1 (attester) / the
+        epoch (proposer).  Stable across head changes WITHIN an epoch —
+        a VC caching duties on it must not see churn every slot."""
+        chain = self.chain
+        spe = chain.preset.slots_per_epoch
+        anchor = (epoch - (1 if attester else 0)) * spe - 1
+        if anchor < 0 or int(state.slot) == 0:
+            return chain.head_root
+        if anchor >= int(state.slot):
+            return chain.head_root
+        if int(state.slot) - anchor > chain.preset.slots_per_historical_root:
+            return chain.head_root
+        return bytes(
+            state.block_roots[anchor % chain.preset.slots_per_historical_root]
+        )
 
     def _resolve_state(self, state_id: str):
         chain = self.chain
@@ -818,6 +1086,47 @@ class BeaconApiClient:
 
     def proposer_duties(self, epoch: int) -> list[dict]:
         return self._get(f"/eth/v1/validator/duties/proposer/{epoch}")["data"]
+
+    def attester_duties_post(self, epoch: int, indices: list[int]) -> dict:
+        """POST duties contract (the production VC<->BN path): returns the
+        full response so callers can key caches on dependent_root."""
+        return self._post(
+            f"/eth/v1/validator/duties/attester/{epoch}",
+            [str(i) for i in indices],
+        )
+
+    def attestation_data(self, slot: int, committee_index: int) -> dict:
+        return self._get(
+            f"/eth/v1/validator/attestation_data?slot={slot}"
+            f"&committee_index={committee_index}"
+        )["data"]
+
+    def aggregate_attestation(self, slot: int, data_root: bytes) -> dict:
+        return self._get(
+            f"/eth/v1/validator/aggregate_attestation?slot={slot}"
+            f"&attestation_data_root=0x{data_root.hex()}"
+        )["data"]
+
+    def publish_aggregate_and_proofs(self, signed_aggregates) -> None:
+        self._post(
+            "/eth/v1/validator/aggregate_and_proofs",
+            [to_json(type(s), s) for s in signed_aggregates],
+        )
+
+    def subscribe_beacon_committees(self, subscriptions: list[dict]) -> None:
+        self._post(
+            "/eth/v1/validator/beacon_committee_subscriptions", subscriptions
+        )
+
+    def produce_block_v3(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes = b""
+    ) -> dict:
+        """Full v3 production response: {version, data: unsigned block}."""
+        return self._get(
+            f"/eth/v3/validator/blocks/{slot}"
+            f"?randao_reveal=0x{randao_reveal.hex()}"
+            f"&graffiti=0x{graffiti.hex()}"
+        )
 
     def spec(self) -> dict:
         return self._get("/eth/v1/config/spec")["data"]

@@ -102,6 +102,16 @@ class ValidatorStore:
         ).root()
         return self._sign(pubkey, root)
 
+    def sign_aggregate_and_proof(self, pubkey: bytes, msg, state, preset):
+        """SignedAggregateAndProof envelope signature (shared by the
+        in-process and remote aggregation rounds)."""
+        domain = sets.get_domain(
+            state.fork, state.genesis_validators_root,
+            S.DOMAIN_AGGREGATE_AND_PROOF,
+            int(msg.aggregate.data.slot) // preset.slots_per_epoch,
+        )
+        return self._sign(pubkey, S.compute_signing_root(msg, domain))
+
     # --- sync-committee signing (not slashable: no DB gate) ---------------
 
     def sign_sync_committee_message(
@@ -282,11 +292,9 @@ class AttestationService:
                 aggregate=merged,
                 selection_proof=proof.to_bytes(),
             )
-            domain = sets.get_domain(
-                state.fork, state.genesis_validators_root,
-                S.DOMAIN_AGGREGATE_AND_PROOF, slot // preset.slots_per_epoch,
+            sig = self.store.sign_aggregate_and_proof(
+                pubkey, msg, state, preset
             )
-            sig = self.store._sign(pubkey, S.compute_signing_root(msg, domain))
             out.append(
                 SignedAggregateAndProof(message=msg, signature=sig.to_bytes())
             )

@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Serialized TPU measurement session for round 5 (VERDICT r4 items 1-2).
+
+The single v5e chip is reached via a relay that wedges when two processes
+touch it concurrently or when a mid-compile process is killed, so ALL
+hardware measurements for the round run from this ONE process, serially,
+each stage as a bench.py/epoch-bench child with its own in-process
+watchdog (a hang becomes a JSON error line + clean exit, never an
+external kill).  Results append to TPU_SESSION_r05.jsonl; successful
+verify measurements also land in BENCH_HISTORY.jsonl via bench.py.
+
+Agenda (stop early if the relay dies):
+  1. B=512  chains=0  - baseline refresher (warm cache from r3)
+  2. B=512  chains=1  - the A/B the last two verdicts asked for
+  3. B=4096 chains=best
+  4. B=8192 chains=best
+  5. epoch attestation batch (north-star #2), device path
+  6. B=512  chains=best device_h2c=1 - system-balanced config
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "TPU_SESSION_r05.jsonl")
+
+
+def log(obj: dict) -> None:
+    obj = dict(obj)
+    obj["at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+    print(json.dumps(obj), flush=True)
+
+
+def run_bench_child(
+    batch: int, chains: bool, device_h2c: bool = False, timeout: float = 4000
+) -> dict | None:
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "tpu"
+    env["BENCH_BATCH"] = str(batch)
+    env["BENCH_ITERS"] = "3"
+    env["BENCH_INIT_TIMEOUT"] = "300"
+    env["BENCH_COMPILE_TIMEOUT"] = str(timeout - 300)
+    env["LIGHTHOUSE_TPU_CHAINS"] = "1" if chains else "0"
+    env["BENCH_DEVICE_H2C"] = "1" if device_h2c else ""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py")],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        log(
+            {
+                "stage": f"verify B={batch} chains={int(chains)} h2c={int(device_h2c)}",
+                "error": f"parent timeout {timeout}s",
+            }
+        )
+        return None
+    sys.stderr.write(proc.stderr[-3000:])
+    out = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    entry = {
+        "stage": f"verify B={batch} chains={int(chains)} h2c={int(device_h2c)}",
+        "wall_sec": round(time.time() - t0, 1),
+        "result": out,
+        "stderr_tail": proc.stderr[-400:],
+    }
+    log(entry)
+    return out
+
+
+def run_epoch_bench(timeout: float = 4500) -> dict | None:
+    env = dict(os.environ)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(ROOT, "tools", "epoch_attestation_bench.py"),
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        log({"stage": "epoch_attestation", "error": f"parent timeout {timeout}s"})
+        return None
+    sys.stderr.write(proc.stderr[-3000:])
+    out = None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    log(
+        {
+            "stage": "epoch_attestation",
+            "wall_sec": round(time.time() - t0, 1),
+            "result": out,
+            "stderr_tail": proc.stderr[-400:],
+        }
+    )
+    return out
+
+
+def ok(res: dict | None) -> bool:
+    return bool(res) and res.get("value", 0) > 0 and "TPU" in str(res.get("device", ""))
+
+
+def main() -> None:
+    log({"stage": "session start", "pid": os.getpid()})
+
+    base = run_bench_child(512, chains=False)
+    if not ok(base):
+        log({"stage": "abort", "why": "baseline B=512 failed; relay presumed dead"})
+        return
+    ab = run_bench_child(512, chains=True, timeout=5500)
+    chains_best = ok(ab) and ab["value"] > base["value"]
+    log(
+        {
+            "stage": "A/B verdict",
+            "chains_off": base.get("value"),
+            "chains_on": (ab or {}).get("value"),
+            "chains_win": chains_best,
+        }
+    )
+
+    r4096 = run_bench_child(4096, chains=chains_best, timeout=5500)
+    if ok(r4096):
+        run_bench_child(8192, chains=chains_best, timeout=5500)
+
+    run_epoch_bench()
+
+    run_bench_child(512, chains=chains_best, device_h2c=True, timeout=5500)
+    log({"stage": "session done"})
+
+
+if __name__ == "__main__":
+    main()
