@@ -126,6 +126,12 @@ class BeaconChain:
         # anything with new_payload()/build_payload() — EngineApiClient or
         # MockExecutionEngine (execution.py)
         self.execution = execution
+        # external builder (None = local-only production): a
+        # BuilderHttpClient; produce_unsigned_block then runs the
+        # builder-vs-local payload-source selection (builder.py,
+        # execution_layer/src/lib.rs determine_and_fetch_payload)
+        self.builder = None
+        self.builder_boost_factor: int | None = None
         # deneb data availability (beacon_chain.rs:486 data_availability_checker)
         from .blobs import DataAvailabilityChecker
 
@@ -669,7 +675,9 @@ class BeaconChain:
             )
         if "execution_payload" in body_cls._fields and self.execution is not None:
             payload_cls = body_cls._fields["execution_payload"].cls
-            payload = self.execution.build_payload(state, self.spec, payload_cls)
+            payload = self._select_execution_payload(
+                state, slot, proposer, fork_now, payload_cls
+            )
             body_kwargs["execution_payload"] = payload
             if "blob_kzg_commitments" in body_cls._fields:
                 bundle = self.blobs_bundle_for(bytes(payload.block_hash))
@@ -695,6 +703,105 @@ class BeaconChain:
         )
         block.state_root = state.root()
         return block, fork_now
+
+    def _select_execution_payload(
+        self, state, slot: int, proposer: int, fork_now: str, payload_cls
+    ):
+        """Payload-source selection for production (builder.py /
+        execution_layer/src/lib.rs determine_and_fetch_payload): builder
+        bid vs local EL by profit, with bid verification and local
+        fallback on every builder failure mode.  No builder wired =
+        local-only (the common path)."""
+        local_holder: dict = {}
+
+        def local_fn():
+            if hasattr(self.execution, "build_payload_with_value"):
+                out = self.execution.build_payload_with_value(
+                    state, self.spec, payload_cls
+                )
+            else:
+                out = (
+                    self.execution.build_payload(
+                        state, self.spec, payload_cls
+                    ),
+                    0,
+                )
+            local_holder["payload"] = out[0]
+            return out
+
+        if self.builder is None:
+            payload, _ = local_fn()
+            return payload
+        from . import builder as B
+
+        parent_hash = bytes(state.latest_execution_payload_header.block_hash)
+        proposer_pk = self.get_pubkey(proposer)
+        bid_holder: dict = {}
+
+        def relay_fn():
+            out = self.builder.get_header(
+                slot, parent_hash, proposer_pk.to_bytes()
+            )
+            if out is None:
+                return None
+            bid_fork, bid_json = out
+            bid_holder["fork"] = bid_fork
+            bid_holder["json"] = bid_json
+            value = int(bid_json["message"]["value"])
+
+            def reveal():
+                from ..network.api import from_json
+
+                header = from_json(
+                    self.types.ExecutionPayloadHeader_BY_FORK[bid_fork],
+                    bid_json["message"]["header"],
+                )
+                resp = self.builder.submit(
+                    slot, header.root(), b"\x00" * 96
+                )
+                return from_json(payload_cls, resp["data"])
+
+            return value, reveal
+
+        def verify_fn():
+            return B.verify_builder_bid(
+                bid_holder["json"],
+                bid_holder["fork"],
+                self.types,
+                self.spec,
+                parent_hash,
+                getattr(self.builder, "expected_pubkey", None),
+                None,
+            )
+
+        source, result, value = B.select_payload_source(
+            local_fn,
+            relay_fn,
+            chain_healthy=True,
+            boost_factor=self.builder_boost_factor,
+            verify_fn=verify_fn,
+        )
+        if source == "builder":
+            try:
+                payload = result()  # reveal: relay returns the full payload
+            except Exception as exc:  # noqa: BLE001
+                # reveal happens pre-signature here (module docstring), so
+                # falling back to the already-built local payload is sound
+                # — unlike the reference's post-signature blinded flow,
+                # where a withheld payload means a missed slot
+                if "payload" in local_holder:
+                    self.log.warning(
+                        "builder reveal failed (%s); using local payload",
+                        exc,
+                    )
+                    return local_holder["payload"]
+                raise
+            self.log.info(
+                "proposing with BUILDER payload (bid %d wei) at slot %d",
+                value, slot,
+            )
+            return payload
+        return result
 
     def produce_block(self, slot: int, keypairs, graffiti: bytes = b""):
         """produce_block.rs condensed for in-process harnesses: sign the

@@ -125,7 +125,14 @@ class EngineApiClient:
         if payload_id is None:
             raise IOError("engine returned no payloadId")
         out = self.get_payload(payload_id)
+        # blockValue feeds the builder-vs-local profit comparison
+        # (get_payload's GetPayloadResponse.block_value)
+        self.last_block_value_wei = int(out.get("blockValue", "0x0"), 16)
         return json_to_payload(payload_cls, out["executionPayload"])
+
+    def build_payload_with_value(self, state, spec, payload_cls):
+        payload = self.build_payload(state, spec, payload_cls)
+        return payload, getattr(self, "last_block_value_wei", 0)
 
     def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes,
                            payload_attributes: dict | None = None) -> dict:
@@ -246,6 +253,8 @@ class MockExecutionEngine:
         self.syncing = False
         self.calls: list[tuple[str, object]] = []
         self._head: bytes = b"\x00" * 32
+        self.fail_build = False  # fault injection: local production down
+        self.block_value_wei = 10**9  # reported local block value
         # deneb: blobs bundled with produced payloads (get_payload's
         # BlobsBundle — commitments, proofs, blobs — keyed by block hash)
         self.blobs_per_block = blobs_per_block
@@ -286,6 +295,8 @@ class MockExecutionEngine:
         execution_block_generator.rs): produce a payload that satisfies the
         consensus checks of process_execution_payload — parent linkage,
         prev_randao, timestamp — plus expected withdrawals for capella+."""
+        if self.fail_build:
+            raise IOError("mock EL: payload production disabled")
         preset = spec.preset
         parent = bytes(state.latest_execution_payload_header.block_hash)
         epoch = state.slot // preset.slots_per_epoch
@@ -323,6 +334,12 @@ class MockExecutionEngine:
             if self.blobs_per_block > 0:
                 self._bundles[block_hash] = self._make_bundle(number)
         return payload_cls(**kwargs)
+
+    def build_payload_with_value(self, state, spec, payload_cls):
+        return (
+            self.build_payload(state, spec, payload_cls),
+            self.block_value_wei,
+        )
 
     def _make_bundle(self, block_number: int):
         """Deterministic canonical blobs + commitments + proofs."""

@@ -605,6 +605,40 @@ class TypesFamily:
                 ),
             }
 
+        # ---- builder API containers (consensus/types/src/builder_bid.rs:
+        # BuilderBid/SignedBuilderBid per post-merge fork; deneb adds the
+        # blob commitments the relay promises to reveal) ------------------
+        self.ExecutionPayloadHeader_BY_FORK = {
+            "bellatrix": ExecutionPayloadHeader,
+            "capella": ExecutionPayloadHeaderCapella,
+            "deneb": ExecutionPayloadHeaderDeneb,
+        }
+        self.ExecutionPayload_BY_FORK = {
+            "bellatrix": ExecutionPayload,
+            "capella": ExecutionPayloadCapella,
+            "deneb": ExecutionPayloadDeneb,
+        }
+        self.BuilderBid_BY_FORK = {}
+        self.SignedBuilderBid_BY_FORK = {}
+        for _fork, _hdr_cls in self.ExecutionPayloadHeader_BY_FORK.items():
+            _bid_fields = {"header": F(_hdr_cls)}
+            if _fork == "deneb":
+                _bid_fields["blob_kzg_commitments"] = SSZList(
+                    KZGCommitment, P.max_blob_commitments_per_block
+                )
+            _bid_fields["value"] = U256
+            _bid_fields["pubkey"] = BLSPubkey
+            _bid = type(
+                f"BuilderBid_{_fork}", (Container,), {"fields": _bid_fields}
+            )
+            _sbid = type(
+                f"SignedBuilderBid_{_fork}",
+                (Container,),
+                {"fields": {"message": F(_bid), "signature": BLSSignature}},
+            )
+            self.BuilderBid_BY_FORK[_fork] = _bid
+            self.SignedBuilderBid_BY_FORK[_fork] = _sbid
+
         # bare names = base-fork variants + altair extras
         self.SyncCommittee = SyncCommittee
         self.SyncAggregate = SyncAggregate

@@ -149,7 +149,8 @@ class BeaconApiServer:
 
     def _count(self, path: str) -> None:
         route = "/".join(
-            "{n}" if seg.isdigit() else seg for seg in path.split("/")
+            "{n}" if seg.isdigit() or seg.startswith("0x") else seg
+            for seg in path.split("/")
         )
         with self._count_lock:
             self.request_counts[route] = self.request_counts.get(route, 0) + 1

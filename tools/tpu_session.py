@@ -38,68 +38,18 @@ def log(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def run_bench_child(
-    batch: int, chains: bool, device_h2c: bool = False, timeout: float = 4000
+def _run_child(
+    cmd: list[str], stage: str, env: dict, timeout: float
 ) -> dict | None:
-    env = dict(os.environ)
-    env["BENCH_CHILD"] = "tpu"
-    env["BENCH_BATCH"] = str(batch)
-    env["BENCH_ITERS"] = "3"
-    env["BENCH_INIT_TIMEOUT"] = "300"
-    env["BENCH_COMPILE_TIMEOUT"] = str(timeout - 300)
-    env["LIGHTHOUSE_TPU_CHAINS"] = "1" if chains else "0"
-    env["BENCH_DEVICE_H2C"] = "1" if device_h2c else ""
+    """One serialized measurement child: run, scan stdout for the last
+    JSON line, log the stage entry; a parent timeout logs and moves on."""
     t0 = time.time()
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "bench.py")],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=timeout,
+            cmd, env=env, capture_output=True, text=True, timeout=timeout
         )
     except subprocess.TimeoutExpired:
-        log(
-            {
-                "stage": f"verify B={batch} chains={int(chains)} h2c={int(device_h2c)}",
-                "error": f"parent timeout {timeout}s",
-            }
-        )
-        return None
-    sys.stderr.write(proc.stderr[-3000:])
-    out = None
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            out = json.loads(line)
-            break
-        except json.JSONDecodeError:
-            continue
-    entry = {
-        "stage": f"verify B={batch} chains={int(chains)} h2c={int(device_h2c)}",
-        "wall_sec": round(time.time() - t0, 1),
-        "result": out,
-        "stderr_tail": proc.stderr[-400:],
-    }
-    log(entry)
-    return out
-
-
-def run_epoch_bench(timeout: float = 4500) -> dict | None:
-    env = dict(os.environ)
-    t0 = time.time()
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable,
-                os.path.join(ROOT, "tools", "epoch_attestation_bench.py"),
-            ],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        log({"stage": "epoch_attestation", "error": f"parent timeout {timeout}s"})
+        log({"stage": stage, "error": f"parent timeout {timeout}s"})
         return None
     sys.stderr.write(proc.stderr[-3000:])
     out = None
@@ -111,13 +61,44 @@ def run_epoch_bench(timeout: float = 4500) -> dict | None:
             continue
     log(
         {
-            "stage": "epoch_attestation",
+            "stage": stage,
             "wall_sec": round(time.time() - t0, 1),
             "result": out,
             "stderr_tail": proc.stderr[-400:],
         }
     )
     return out
+
+
+def run_bench_child(
+    batch: int, chains: bool, device_h2c: bool = False, timeout: float = 4000
+) -> dict | None:
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "tpu"
+    env["BENCH_BATCH"] = str(batch)
+    env["BENCH_ITERS"] = "3"
+    env["BENCH_INIT_TIMEOUT"] = "300"
+    env["BENCH_COMPILE_TIMEOUT"] = str(timeout - 300)
+    env["LIGHTHOUSE_TPU_CHAINS"] = "1" if chains else "0"
+    env["BENCH_DEVICE_H2C"] = "1" if device_h2c else ""
+    return _run_child(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        f"verify B={batch} chains={int(chains)} h2c={int(device_h2c)}",
+        env,
+        timeout,
+    )
+
+
+def run_epoch_bench(timeout: float = 4500) -> dict | None:
+    return _run_child(
+        [
+            sys.executable,
+            os.path.join(ROOT, "tools", "epoch_attestation_bench.py"),
+        ],
+        "epoch_attestation",
+        dict(os.environ),
+        timeout,
+    )
 
 
 def ok(res: dict | None) -> bool:
