@@ -170,20 +170,24 @@ def verify_builder_bid(
         and int(header.block_number) != local_block_number
     ):
         return "bid block number mismatch"
-    if expected_pubkey is not None:
-        if bytes(signed.message.pubkey) != expected_pubkey:
-            return "unexpected builder pubkey"
-        try:
-            pk = bls.PublicKey.from_bytes(bytes(signed.message.pubkey))
-            root = S.compute_signing_root(
-                signed.message, builder_signing_domain(spec)
-            )
-            if not bls.verify(
-                pk, root, bls.Signature.from_bytes(bytes(signed.signature))
-            ):
-                return "bid signature invalid"
-        except Exception:  # noqa: BLE001
+    if (
+        expected_pubkey is not None
+        and bytes(signed.message.pubkey) != expected_pubkey
+    ):
+        return "unexpected builder pubkey"
+    # the signature is ALWAYS verified (the reference never skips it);
+    # without a pinned pubkey it proves possession of the claimed key
+    try:
+        pk = bls.PublicKey.from_bytes(bytes(signed.message.pubkey))
+        root = S.compute_signing_root(
+            signed.message, builder_signing_domain(spec)
+        )
+        if not bls.verify(
+            pk, root, bls.Signature.from_bytes(bytes(signed.signature))
+        ):
             return "bid signature invalid"
+    except Exception:  # noqa: BLE001
+        return "bid signature invalid"
     return None
 
 
@@ -232,8 +236,8 @@ def select_payload_source(
                 log.warning("builder bid rejected (%s); using local", reason)
                 return "local", local_payload, local_value
         boosted = (
-            (bid_value // 100) * boost_factor
-            if boost_factor is not None
+            bid_value * boost_factor // 100  # mul before div: no 100-wei
+            if boost_factor is not None      # truncation (lib.rs order)
             else bid_value
         )
         if local_value >= boosted:

@@ -132,6 +132,10 @@ class BeaconChain:
         # execution_layer/src/lib.rs determine_and_fetch_payload)
         self.builder = None
         self.builder_boost_factor: int | None = None
+        # eth1 ingestion service (None = no deposit/vote source wired):
+        # an Eth1Service, normally fed by an Eth1PollingService over the
+        # EL's eth_ namespace; production then packs its eth1-data vote
+        self.eth1 = None
         # deneb data availability (beacon_chain.rs:486 data_availability_checker)
         from .blobs import DataAvailabilityChecker
 
@@ -667,6 +671,35 @@ class BeaconChain:
             attester_slashings=asl,
             voluntary_exits=exits,
         )
+        if self.eth1 is not None:
+            vote = self.eth1.eth1_data_for_vote(state)
+            body_kwargs["eth1_data"] = vote
+            # once the voting period adopts a vote advancing deposit_count,
+            # every block MUST carry the pending deposits (per_block.py
+            # expected_deposits check) — and process_eth1_data may adopt
+            # THIS block's own vote before that check runs, so compute the
+            # post-vote eth1_data exactly as the transition will
+            period_slots = (
+                self.preset.epochs_per_eth1_voting_period
+                * self.preset.slots_per_epoch
+            )
+            n_votes = sum(
+                1 for v in state.eth1_data_votes if v == vote
+            ) + 1  # + this block's
+            effective = (
+                vote if n_votes * 2 > period_slots else state.eth1_data
+            )
+            need = min(
+                self.preset.max_deposits,
+                int(effective.deposit_count)
+                - int(state.eth1_deposit_index),
+            )
+            if need > 0:
+                body_kwargs["deposits"] = (
+                    self.eth1.deposit_cache.deposits_for_block(
+                        int(state.eth1_deposit_index), need
+                    )
+                )
         if "sync_aggregate" in body_cls._fields:
             # pack the pool's contributions for the parent root (participants
             # signed the PREVIOUS slot's head — altair/sync_committee.rs)

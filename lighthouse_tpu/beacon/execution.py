@@ -50,6 +50,28 @@ def jwt_token(secret: bytes, now: float | None = None) -> str:
     return (signing_input + b"." + sig).decode()
 
 
+def json_rpc_post(
+    url: str, method: str, params: list, req_id: int,
+    timeout: float, headers: dict | None = None,
+):
+    """One JSON-RPC 2.0 POST round trip (shared by the engine, eth1, and
+    any other RPC client in the package — one place to fix transport
+    behavior).  Raises IOError on an error response."""
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": req_id, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        url,
+        data=body,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        out = json.loads(r.read())
+    if out.get("error"):
+        raise IOError(f"{method}: {out['error']}")
+    return out["result"]
+
+
 class EngineApiClient:
     """JSON-RPC over HTTP with JWT bearer auth (engine_api/http.rs)."""
 
@@ -61,22 +83,10 @@ class EngineApiClient:
 
     def call(self, method: str, params: list) -> dict:
         self._id += 1
-        body = json.dumps(
-            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
-        ).encode()
-        req = urllib.request.Request(
-            self.url,
-            data=body,
-            headers={
-                "Content-Type": "application/json",
-                "Authorization": f"Bearer {jwt_token(self.jwt_secret)}",
-            },
+        return json_rpc_post(
+            self.url, method, params, self._id, self.timeout,
+            headers={"Authorization": f"Bearer {jwt_token(self.jwt_secret)}"},
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            out = json.loads(r.read())
-        if "error" in out:
-            raise IOError(f"engine error: {out['error']}")
-        return out["result"]
 
     def new_payload(self, payload_json: dict) -> PayloadStatus:
         res = self.call("engine_newPayloadV2", [payload_json])
@@ -381,6 +391,12 @@ class MockELServer:
         # forkchoice attributes: the mock builds the payload AT fcu time
         self._payloads: dict[str, dict] = {}
         self._next_id = [0]
+        # eth1 side (execution_block_generator.rs's eth1 chain): blocks +
+        # ABI-encoded DepositEvent logs served over the unauthenticated
+        # eth_ namespace for the Eth1PollingService
+        self.eth1_blocks: list[dict] = []
+        self.eth1_logs: list[dict] = []
+        self._eth1_deposit_count = 0
         mock = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -388,14 +404,25 @@ class MockELServer:
                 pass
 
             def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                method, params = req["method"], req["params"]
+                if method.startswith("eth_"):
+                    # the eth1 RPC surface carries no engine-API JWT
+                    result = mock._eth1_call(method, params)
+                    body = json.dumps(
+                        {"jsonrpc": "2.0", "id": req["id"], "result": result}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 auth = self.headers.get("Authorization", "")
                 if not auth.startswith("Bearer "):
                     self.send_response(401)
                     self.end_headers()
                     return
-                length = int(self.headers.get("Content-Length", 0))
-                req = json.loads(self.rfile.read(length))
-                method, params = req["method"], req["params"]
                 result = None
                 if method == "engine_newPayloadV2":
                     block_hash = bytes.fromhex(
@@ -439,6 +466,64 @@ class MockELServer:
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True, name="mock-el"
         )
+
+    # -- eth1 namespace (deposit-log ingestion test double) -----------------
+
+    def add_eth1_block(self, deposits=None, timestamp: int | None = None):
+        """Append one eth1 block carrying the given DepositData logs
+        (ABI-encoded exactly as the deposit contract emits them)."""
+        from .eth1 import DEPOSIT_EVENT_TOPIC, encode_deposit_log_data
+
+        number = len(self.eth1_blocks)
+        block_hash = hashlib.sha256(
+            b"eth1" + number.to_bytes(8, "little")
+        ).digest()
+        self.eth1_blocks.append(
+            {
+                "number": hex(number),
+                "hash": "0x" + block_hash.hex(),
+                "timestamp": hex(
+                    timestamp if timestamp is not None else number * 14
+                ),
+            }
+        )
+        for li, dd in enumerate(deposits or []):
+            self.eth1_logs.append(
+                {
+                    "blockNumber": hex(number),
+                    "logIndex": hex(li),
+                    "topics": ["0x" + DEPOSIT_EVENT_TOPIC.hex()],
+                    "data": "0x"
+                    + encode_deposit_log_data(
+                        dd, self._eth1_deposit_count
+                    ).hex(),
+                }
+            )
+            self._eth1_deposit_count += 1
+        return block_hash
+
+    def _eth1_call(self, method: str, params: list):
+        if method == "eth_chainId":
+            return "0x1"
+        if method == "eth_blockNumber":
+            return hex(len(self.eth1_blocks) - 1) if self.eth1_blocks else "0x0"
+        if method == "eth_getBlockByNumber":
+            n = int(params[0], 16)
+            if 0 <= n < len(self.eth1_blocks):
+                return self.eth1_blocks[n]
+            return None
+        if method == "eth_getLogs":
+            flt = params[0]
+            lo = int(flt.get("fromBlock", "0x0"), 16)
+            hi = int(flt.get("toBlock", hex(len(self.eth1_blocks))), 16)
+            topics = flt.get("topics") or []
+            return [
+                entry
+                for entry in self.eth1_logs
+                if lo <= int(entry["blockNumber"], 16) <= hi
+                and (not topics or entry["topics"][0] == topics[0])
+            ]
+        return None
 
     def _assemble(self, ctx: dict) -> dict:
         """Build the payload JSON from the stored forkchoice attributes

@@ -205,6 +205,28 @@ class BeaconNode:
         if self.discovery is not None:
             self.discovery.start()
         self.api.start()
+        # eth1 ingestion rides the EL's HTTP endpoint when one is wired
+        # (client/src/builder.rs starts the eth1 service the same way)
+        self.eth1_poller = None
+        el_url = getattr(self.chain.execution, "url", None)
+        if el_url:
+            from .eth1 import Eth1JsonRpcClient, Eth1PollingService, Eth1Service
+
+            svc = Eth1Service(self.spec)
+            self.chain.eth1 = svc
+            # the eth_ calls ride the engine endpoint here, so carry its
+            # JWT: real ELs authenticate the whole 8551 port
+            self.eth1_poller = Eth1PollingService(
+                svc,
+                Eth1JsonRpcClient(
+                    el_url,
+                    jwt_secret=getattr(
+                        self.chain.execution, "jwt_secret", None
+                    ),
+                ),
+                self.spec,
+            )
+            self.eth1_poller.start()
         log.info(
             "node up: tcp=%d udp=%s http=%d",
             self.host.port,
@@ -216,6 +238,8 @@ class BeaconNode:
         self._running = False
         if self.slot_timer is not None:
             self.slot_timer.stop()
+        if getattr(self, "eth1_poller", None) is not None:
+            self.eth1_poller.stop()
         self.api.stop()
         if self.discovery is not None:
             self.discovery.stop()

@@ -210,3 +210,6 @@ def test_blobs_by_root_rpc(rig):
     finally:
         serving.stop()
         asking.stop()
+
+# suite tiering: dominated by the one-time dev trusted-setup build (~25s)
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

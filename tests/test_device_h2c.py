@@ -93,3 +93,7 @@ def test_backend_device_h2c_end_to_end():
         sk_evil.sign(b"\x01" * 32), [SecretKey(501).public_key()], b"\x01" * 32
     )
     assert be.verify_signature_sets(bad) is False
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

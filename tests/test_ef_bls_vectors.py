@@ -173,3 +173,7 @@ def test_jax_backend_vectors(handler, name, data):
     from lighthouse_tpu.crypto.bls.jax_backend.backend import JaxBackend
 
     RUNNERS[handler](data, JaxBackend(min_batch=4))
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

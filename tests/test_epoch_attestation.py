@@ -71,3 +71,7 @@ def test_epoch_verify_kernel_accepts_and_rejects():
     bad[2][1] = SecretKey(31337).public_key().point
     pk_bad, mask_bad = encode_committee_pubkeys(bad, positions)
     assert not bool(fn(pk_bad, mask_bad, sig_enc, h_enc, wbits, positions))
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

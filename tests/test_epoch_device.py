@@ -148,3 +148,7 @@ def test_padded_lanes_are_inert():
     assert (balances[n:] == 0).all(), "padded balances must stay zero"
     assert (new_scores[n:] == scores[n:]).all(), "padded scores preserved"
     assert (new_eff[n:] == 0).all(), "padded effective balance unchanged"
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

@@ -222,3 +222,176 @@ class TestEngineApiOverHttp:
                 chain.process_block(blk)
         finally:
             server.stop()
+
+
+class TestEth1JsonRpcIngestion:
+    """Round-5: eth1 deposit-log ingestion over the socket
+    (beacon_node/eth1/src/service.rs) — logs ABI-parsed from the mock
+    EL's eth_ namespace, contiguity enforced, snapshots recorded, votes
+    and genesis driven end-to-end through HTTP."""
+
+    def _rig(self, spec):
+        from lighthouse_tpu.beacon.eth1 import (
+            Eth1JsonRpcClient,
+            Eth1PollingService,
+            Eth1Service,
+        )
+        from lighthouse_tpu.beacon.execution import (
+            MockELServer,
+            MockExecutionEngine,
+        )
+
+        server = MockELServer(b"\x42" * 32, MockExecutionEngine())
+        server.start()
+        svc = Eth1Service(spec)
+        poller = Eth1PollingService(
+            svc, Eth1JsonRpcClient(server.url), spec
+        )
+        return server, svc, poller
+
+    def test_abi_roundtrip(self):
+        from lighthouse_tpu.beacon.eth1 import (
+            decode_deposit_log_data,
+            encode_deposit_log_data,
+        )
+
+        spec = phase0_spec(S.MINIMAL)
+        dd = _deposit(3, spec)
+        data, index = decode_deposit_log_data(encode_deposit_log_data(dd, 7))
+        assert index == 7
+        assert bytes(data.pubkey) == bytes(dd.pubkey)
+        assert int(data.amount) == int(dd.amount)
+        assert bytes(data.signature) == bytes(dd.signature)
+
+    def test_polls_logs_and_snapshots_over_socket(self):
+        spec = phase0_spec(S.MINIMAL)
+        server, svc, poller = self._rig(spec)
+        try:
+            server.add_eth1_block()  # genesis, no deposits
+            server.add_eth1_block(deposits=[_deposit(0, spec)])
+            server.add_eth1_block(deposits=[_deposit(1, spec), _deposit(2, spec)])
+            n = poller.poll_once()
+            assert n == 3
+            assert svc.deposit_cache.count() == 3
+            # per-block snapshots carry the cumulative count
+            assert [b.deposit_count for b in svc.blocks] == [0, 1, 3]
+            assert svc.blocks[-1].deposit_root == svc.deposit_cache.deposit_root()
+            # idempotent: nothing new
+            assert poller.poll_once() == 0
+            # incremental: one more block later
+            server.add_eth1_block(deposits=[_deposit(3, spec)])
+            assert poller.poll_once() == 1
+            assert svc.deposit_cache.count() == 4
+        finally:
+            server.stop()
+
+    def test_proofs_valid_after_socket_ingestion(self):
+        spec = phase0_spec(S.MINIMAL)
+        server, svc, poller = self._rig(spec)
+        try:
+            server.add_eth1_block(deposits=[_deposit(i, spec) for i in range(4)])
+            poller.poll_once()
+            root = svc.deposit_cache.deposit_root()
+            for i, dep in enumerate(svc.deposit_cache.deposits_for_block(0, 4)):
+                assert verify_merkle_proof(
+                    dep.data.root(), [bytes(p) for p in dep.proof], 33, i, root
+                )
+        finally:
+            server.stop()
+
+    def test_vote_follows_distance_through_socket(self):
+        spec = phase0_spec(S.MINIMAL)
+        server, svc, poller = self._rig(spec)
+        try:
+            for _ in range(spec.eth1_follow_distance + 5):
+                server.add_eth1_block()
+            poller.poll_once()
+            from lighthouse_tpu.consensus.containers import types_for
+
+            state = types_for(spec.preset).BeaconState()
+            vote = svc.eth1_data_for_vote(state)
+            # follow-distance block, counted from the head
+            assert vote.block_hash == svc.blocks[
+                -(spec.eth1_follow_distance + 1)
+            ].hash
+        finally:
+            server.stop()
+
+    def test_pruning_bounds_block_cache(self):
+        import dataclasses
+
+        spec = dataclasses.replace(phase0_spec(S.MINIMAL), eth1_follow_distance=4)
+        server, svc, poller = self._rig(spec)
+        try:
+            for _ in range(30):
+                server.add_eth1_block()
+            poller.poll_once()
+            assert len(svc.blocks) == 2 * 4 + 1
+            assert svc.blocks[-1].number == 29
+        finally:
+            server.stop()
+
+    @pytest.mark.slow
+    def test_eth1_genesis_through_socket(self):
+        import dataclasses
+
+        spec = dataclasses.replace(
+            phase0_spec(S.MINIMAL), min_genesis_active_validator_count=8
+        )
+        server, svc, poller = self._rig(spec)
+        try:
+            server.add_eth1_block(deposits=[_deposit(i, spec) for i in range(8)])
+            poller.poll_once()
+            state = eth1_genesis_state(svc, spec)
+            assert state is not None and len(state.validators) == 8
+        finally:
+            server.stop()
+
+    def test_polling_thread_follows_chain(self):
+        import time as _time
+
+        spec = phase0_spec(S.MINIMAL)
+        server, svc, poller = self._rig(spec)
+        try:
+            server.add_eth1_block()
+            poller.start(interval=0.05)
+            server.add_eth1_block(deposits=[_deposit(0, spec)])
+            deadline = _time.time() + 5
+            while _time.time() < deadline and svc.deposit_cache.count() < 1:
+                _time.sleep(0.05)
+            assert svc.deposit_cache.count() == 1
+        finally:
+            poller.stop()
+            server.stop()
+
+
+def test_produce_packs_vote_and_pending_deposits():
+    """chain.eth1 wired: production packs the eth1-data vote AND the
+    deposits the adopted vote demands (op-pool deposit feed analog) —
+    and the block imports (proofs verify against eth1_data.deposit_root)."""
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.beacon.eth1 import Eth1Service
+    from lighthouse_tpu.consensus.containers import Eth1Data
+    from lighthouse_tpu.consensus.testing import interop_state
+
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(16, spec)
+    svc = Eth1Service(spec)
+    for i in range(3):
+        svc.deposit_cache.insert_log(i, _deposit(i, spec))
+    # the chain already adopted a vote demanding those 3 deposits
+    state.eth1_data = Eth1Data(
+        deposit_root=svc.deposit_cache.deposit_root(),
+        deposit_count=3,
+        block_hash=b"\x33" * 32,
+    )
+    chain = BeaconChain(spec, state, None)
+    chain.eth1 = svc
+    svc.insert_block(Eth1Block(
+        number=0, hash=b"\x44" * 32, timestamp=0,
+        deposit_count=3, deposit_root=svc.deposit_cache.deposit_root(),
+    ))
+    blk = chain.produce_block(1, keys)
+    assert len(blk.message.body.deposits) == 3
+    chain.process_block(blk)
+    assert int(chain.head_state().eth1_deposit_index) == 3

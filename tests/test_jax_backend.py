@@ -113,3 +113,7 @@ def test_aggregate_verify_on_device(jax_backend):
     assert jax_backend.aggregate_verify(pks, [msgs[1], msgs[0], msgs[2]], agg.signature) is False
     # duplicate messages -> reject (eth2 distinct-message rule)
     assert jax_backend.aggregate_verify(pks, [msgs[0], msgs[0], msgs[2]], agg.signature) is False
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

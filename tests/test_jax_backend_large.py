@@ -64,3 +64,7 @@ def test_exact_batch_no_padding(backend, sets):
                     for i in range(B - N_SETS)]
     assert len(exact) == B
     assert backend.verify_signature_sets(exact) is True
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

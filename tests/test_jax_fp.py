@@ -127,3 +127,7 @@ def test_jit_and_batch_shapes():
     assert np.array_equal(
         np.asarray(out2.limbs).reshape(jfp.N, 128), np.asarray(out.limbs)
     )
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

@@ -145,3 +145,7 @@ def test_scalar_mul_const():
     dp = P.from_affine(P.FP_OPS, P.g1_encode(pts))
     got = P.g1_decode_jac(J(P.scalar_mul_const, 0, 2)(P.FP_OPS, dp, params.X))
     assert got == [affine_mul(a, params.X, Fp) for a in pts]
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

@@ -119,3 +119,7 @@ def test_fp12_is_one():
     vals = [one, rand_fp12()]
     d = T.fp12_encode(vals)
     assert list(np.asarray(J(T.fp12_is_one)(d))) == [True, False]
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

@@ -133,3 +133,6 @@ def test_mainnet_setup_commit_verify_roundtrip():
     from lighthouse_tpu.crypto.bls.curve import G1_GENERATOR, Fp, affine_mul, g1_to_bytes
 
     assert commitment == g1_to_bytes(affine_mul(G1_GENERATOR, c_val, Fp))
+
+# suite tiering: dominated by the one-time dev trusted-setup build (~25s)
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

@@ -68,3 +68,7 @@ def test_pair_sharded_aggregate_verify_ring():
     bad = [sk.public_key().point for sk in sks]
     bad[3] = SecretKey(424242).public_key().point
     assert bool(fn(P.g1_encode(bad), h_enc, sig_enc)) is False
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

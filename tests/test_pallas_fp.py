@@ -104,3 +104,7 @@ def test_flag_routes_mont_mul():
     assert F.limbs_to_ints(np.asarray(ref.limbs)) == F.limbs_to_ints(
         np.asarray(got.limbs)
     )
+
+# suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
+# deselect with -m 'not compile' for the sub-minute consensus tier
+pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]
