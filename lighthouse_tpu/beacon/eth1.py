@@ -301,27 +301,32 @@ class Eth1PollingService:
                     data, index = decode_deposit_log_data(
                         bytes.fromhex(entry["data"].removeprefix("0x"))
                     )
+                    if index < cache.count():
+                        continue  # re-fetched after a mid-poll failure
                     cache.insert_log(index, data)
+                if n >= keep_from:
+                    blk = (
+                        head_blk
+                        if n == latest
+                        else self.client.get_block(n)
+                    )
+                    if blk is None:
+                        raise IOError(f"eth1 block {n} disappeared mid-poll")
+                    self.service.insert_block(
+                        Eth1Block(
+                            number=n,
+                            hash=bytes.fromhex(blk["hash"].removeprefix("0x")),
+                            timestamp=int(blk["timestamp"], 16),
+                            deposit_count=cache.count(),
+                            deposit_root=cache.deposit_root(),
+                        )
+                    )
+                # cursor moves only once the block fully landed: a failed
+                # header fetch re-runs this block next round (log inserts
+                # above dedupe), keeping the block cache positionally
+                # aligned with the real chain for the follow-distance vote
                 self.last_processed_block = n
                 processed += 1
-                if n < keep_from:
-                    continue  # would be pruned: skip the header fetch
-                blk = (
-                    head_blk
-                    if n == latest
-                    else self.client.get_block(n)
-                )
-                if blk is None:
-                    raise IOError(f"eth1 block {n} disappeared mid-poll")
-                self.service.insert_block(
-                    Eth1Block(
-                        number=n,
-                        hash=bytes.fromhex(blk["hash"].removeprefix("0x")),
-                        timestamp=int(blk["timestamp"], 16),
-                        deposit_count=cache.count(),
-                        deposit_root=cache.deposit_root(),
-                    )
-                )
         self._prune()
         return processed
 

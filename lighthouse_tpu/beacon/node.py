@@ -106,6 +106,12 @@ class BeaconNode:
         self.host.rpc_handlers["beacon_blocks_by_root"] = self._on_blocks_by_root
         self.host.rpc_handlers["blob_sidecars_by_range"] = self._on_blobs_by_range
         self.host.rpc_handlers["blob_sidecars_by_root"] = self._on_blobs_by_root
+        self.host.rpc_handlers["light_client_bootstrap"] = self._on_lc_bootstrap
+        # light-client server memory: latest served updates + the last
+        # finalized epoch already announced on the finality topic
+        self._latest_lc_optimistic = None
+        self._latest_lc_finality = None
+        self._lc_last_finalized_epoch = 0
         # 5. HTTP API
         self.api = BeaconApiServer(self.chain, port=http_port, node=self)
         self._dialed: set[bytes] = set()
@@ -163,6 +169,18 @@ class BeaconNode:
         ]
         for t in self.blob_topics:
             self.host.subscribe(t, self._on_gossip_blob)
+        # light-client serving topics (types/topics.rs:107): receivers
+        # validate + keep the latest update; gossipsub re-forwards accepts
+        self.lc_finality_topic = topics_mod.topic(
+            "light_client_finality_update", digest
+        )
+        self.lc_optimistic_topic = topics_mod.topic(
+            "light_client_optimistic_update", digest
+        )
+        self.host.subscribe(self.lc_finality_topic, self._on_gossip_lc_finality)
+        self.host.subscribe(
+            self.lc_optimistic_topic, self._on_gossip_lc_optimistic
+        )
 
     def maybe_rotate_fork_digest(self, epoch: int) -> bool:
         """At a scheduled fork boundary the wire identity changes: compute
@@ -836,6 +854,135 @@ class BeaconNode:
     def publish_aggregate(self, signed_aggregate) -> None:
         self.host.publish(self.attestation_topic, signed_aggregate.encode())
 
+    # -- light-client serving (topics.rs:107 + rpc/protocol.rs:149-174) ----
+
+    @staticmethod
+    def _header_of(block_msg):
+        from ..consensus.containers import BeaconBlockHeader
+
+        return BeaconBlockHeader(
+            slot=block_msg.slot,
+            proposer_index=block_msg.proposer_index,
+            parent_root=bytes(block_msg.parent_root),
+            state_root=bytes(block_msg.state_root),
+            body_root=type(block_msg)._fields["body"].hash_tree_root(
+                block_msg.body
+            ),
+        )
+
+    def publish_light_client_updates(self, signed_block) -> None:
+        """After importing a block whose sync aggregate carries votes:
+        emit an optimistic update for the ATTESTED (parent) header, and a
+        finality update whenever the finalized checkpoint advanced — the
+        server half the reference runs in its light_client server."""
+        from ..consensus import light_client as lc
+
+        body = signed_block.message.body
+        agg = getattr(body, "sync_aggregate", None)
+        if agg is None or not any(bool(b) for b in agg.sync_committee_bits):
+            return
+        parent_root = bytes(signed_block.message.parent_root)
+        parent = self.chain.store.get_block(parent_root, self.block_cls)
+        if parent is None:
+            return
+        attested_header = self._header_of(parent.message)
+        sig_slot = int(signed_block.message.slot)
+        update = lc.build_optimistic_update(
+            attested_header, agg, sig_slot, self.types
+        )
+        self._latest_lc_optimistic = update
+        self.host.publish(self.lc_optimistic_topic, update.encode())
+        fin_epoch, fin_root = self.chain.fork_choice.finalized_checkpoint
+        if fin_epoch > self._lc_last_finalized_epoch and fin_root:
+            attested_state = self.chain.state_for_block(parent_root)
+            fin_block = self.chain.store.get_block(fin_root, self.block_cls)
+            if attested_state is None or fin_block is None:
+                return
+            fin_update = lc.build_finality_update(
+                attested_state,
+                attested_header,
+                self._header_of(fin_block.message),
+                agg,
+                sig_slot,
+                self.types,
+            )
+            self._latest_lc_finality = fin_update
+            self.host.publish(self.lc_finality_topic, fin_update.encode())
+            self._lc_last_finalized_epoch = fin_epoch
+
+    def _lc_committee_pubkeys(self) -> list[bytes] | None:
+        state = self.chain.head_state()
+        committee = getattr(state, "current_sync_committee", None)
+        if committee is None:
+            return None
+        return [bytes(pk) for pk in committee.pubkeys]
+
+    def _on_gossip_lc_optimistic(self, payload: bytes, peer_id) -> str:
+        from ..consensus import light_client as lc
+
+        _, Optimistic = lc.light_client_update_types(self.types)
+        try:
+            update = Optimistic.deserialize_value(payload)
+        except Exception:  # noqa: BLE001
+            return "reject"
+        stored = self._latest_lc_optimistic
+        if stored is not None and int(
+            update.attested_header.beacon.slot
+        ) <= int(stored.attested_header.beacon.slot):
+            return "ignore"  # stale replay: don't regress or re-forward
+        pks = self._lc_committee_pubkeys()
+        if pks is None or not lc.verify_optimistic_update(
+            update, pks, self.spec, self._gvr
+        ):
+            return "ignore"
+        self._latest_lc_optimistic = update
+        return "accept"
+
+    def _on_gossip_lc_finality(self, payload: bytes, peer_id) -> str:
+        from ..consensus import light_client as lc
+
+        Finality, _ = lc.light_client_update_types(self.types)
+        try:
+            update = Finality.deserialize_value(payload)
+        except Exception:  # noqa: BLE001
+            return "reject"
+        stored = self._latest_lc_finality
+        if stored is not None and int(
+            update.finalized_header.beacon.slot
+        ) <= int(stored.finalized_header.beacon.slot):
+            return "ignore"  # stale replay: don't regress or re-forward
+        pks = self._lc_committee_pubkeys()
+        if pks is None or not lc.verify_finality_update(
+            update, pks, self.spec, self._gvr, self.types
+        ):
+            return "ignore"
+        self._latest_lc_finality = update
+        return "accept"
+
+    def _on_lc_bootstrap(self, req: bytes, peer_id):
+        """LightClientBootstrap req/resp (rpc/protocol.rs:149-174):
+        request = 32-byte block root, response = SSZ bootstrap proving
+        the current sync committee into that block's state root."""
+        from ..consensus import light_client as lc
+
+        if len(req) != 32:
+            return rpc_mod.INVALID_REQUEST, b"bad root length"
+        state = self.chain.state_for_block(req)
+        if state is None or not hasattr(state, "current_sync_committee"):
+            return rpc_mod.RESOURCE_UNAVAILABLE, b"unknown root"
+        if req == self.chain.genesis_block_root:
+            # the anchor is a header, not a stored SignedBeaconBlock
+            header = state.latest_block_header.copy()
+            if bytes(header.state_root) == bytes(32):
+                header.state_root = state.root()
+        else:
+            block = self.chain.store.get_block(req, self.block_cls)
+            if block is None:
+                return rpc_mod.RESOURCE_UNAVAILABLE, b"unknown root"
+            header = self._header_of(block.message)
+        bootstrap = lc.build_bootstrap(state, header, self.types)
+        return rpc_mod.SUCCESS, bootstrap.encode()
+
     def subscribe_committee_duties(self, duties, committees_per_slot: int) -> None:
         """`beacon_committee_subscriptions` ingress: register duty-driven
         subnet subscriptions from a remote VC (attestation_subnets.rs
@@ -853,6 +1000,10 @@ class BeaconNode:
         with self._chain_lock:
             self.chain.process_block(block)
         self.publish_block(block)
+        try:
+            self.publish_light_client_updates(block)
+        except Exception as exc:  # noqa: BLE001 — serving is best-effort
+            log.debug("light-client update publish failed: %s", exc)
         return block
 
 

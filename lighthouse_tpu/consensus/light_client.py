@@ -79,7 +79,11 @@ class LightClientHeader(Container):
 
 
 def light_client_types(T):
-    """Build the preset-shaped light-client containers over a TypesFamily."""
+    """Build the preset-shaped light-client containers over a TypesFamily
+    (cached on T — class identity must be stable across calls)."""
+    cached = getattr(T, "_lc_types", None)
+    if cached is not None:
+        return cached
 
     class LightClientBootstrap(Container):
         fields = {
@@ -114,7 +118,8 @@ def light_client_types(T):
             "signature_slot": U64,
         }
 
-    return LightClientBootstrap, LightClientUpdate
+    T._lc_types = (LightClientBootstrap, LightClientUpdate)
+    return T._lc_types
 
 
 # ---------------------------------------------------------------------------
@@ -149,3 +154,204 @@ def verify_bootstrap(bootstrap, T) -> bool:
         idx,
     )
     return root == bytes(bootstrap.header.beacon.state_root)
+
+
+# ---------------------------------------------------------------------------
+# finality / optimistic updates (types/src/light_client_{finality,
+# optimistic}_update.rs) + the follower-side store (consensus/src/
+# light_client_update.rs process flow, scaled to in-repo proofs)
+# ---------------------------------------------------------------------------
+
+
+def light_client_update_types(T):
+    """(LightClientFinalityUpdate, LightClientOptimisticUpdate) over a
+    TypesFamily — the two gossip-served update shapes.  Cached on T:
+    these sit on the per-gossip-message path, and Container equality
+    requires identical classes across calls."""
+    cached = getattr(T, "_lc_update_types", None)
+    if cached is not None:
+        return cached
+    from .containers import Root
+
+    class LightClientFinalityUpdate(Container):
+        fields = {
+            "attested_header": F(LightClientHeader),
+            "finalized_header": F(LightClientHeader),
+            "finality_branch": SSZList(Root, 16),
+            "sync_aggregate": F(T.SyncAggregate),
+            "signature_slot": U64,
+        }
+
+    class LightClientOptimisticUpdate(Container):
+        fields = {
+            "attested_header": F(LightClientHeader),
+            "sync_aggregate": F(T.SyncAggregate),
+            "signature_slot": U64,
+        }
+
+    T._lc_update_types = (LightClientFinalityUpdate, LightClientOptimisticUpdate)
+    return T._lc_update_types
+
+
+def build_optimistic_update(attested_header, sync_aggregate, signature_slot,
+                            T):
+    _, Optimistic = light_client_update_types(T)
+    return Optimistic(
+        attested_header=LightClientHeader(beacon=attested_header),
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+
+
+def build_finality_update(
+    attested_state, attested_header, finalized_header, sync_aggregate,
+    signature_slot, T,
+):
+    """Prove the attested state's finalized_checkpoint and wrap the whole
+    finality evidence (the server half feeding the
+    light_client_finality_update topic)."""
+    Finality, _ = light_client_update_types(T)
+    leaf, state_branch, depth = field_proof(
+        attested_state, "finalized_checkpoint"
+    )
+    # spec-shaped two-level branch (FINALIZED_ROOT gindex): the leaf is
+    # checkpoint.ROOT; the checkpoint's epoch leaf rides as the first
+    # sibling (root is field 1 of Checkpoint{epoch, root})
+    epoch_leaf = U64.hash_tree_root(
+        attested_state.finalized_checkpoint.epoch
+    )
+    return Finality(
+        attested_header=LightClientHeader(beacon=attested_header),
+        finalized_header=LightClientHeader(beacon=finalized_header),
+        finality_branch=[epoch_leaf] + [bytes(b) for b in state_branch],
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+
+
+def _verify_sync_aggregate(
+    attested_header, sync_aggregate, committee_pubkeys, spec,
+    genesis_validators_root,
+) -> bool:
+    """The signature check shared by both update kinds: the participating
+    committee members signed the attested block root under
+    DOMAIN_SYNC_COMMITTEE at the attested slot's epoch (mirrors
+    ValidatorStore.sign_sync_committee_message so server and follower
+    agree bit-for-bit)."""
+    from ..crypto.bls import api as bls
+    from . import spec as S
+    from .containers import SigningData
+    from .ssz import ByteVector
+
+    bits = [bool(b) for b in sync_aggregate.sync_committee_bits]
+    participants = [
+        pk for pk, bit in zip(committee_pubkeys, bits) if bit
+    ]
+    if not participants:
+        return False
+    epoch = int(attested_header.slot) // spec.preset.slots_per_epoch
+    fork_version = spec.fork_version_at_epoch(epoch)
+    domain = S.compute_domain(
+        S.DOMAIN_SYNC_COMMITTEE, fork_version, genesis_validators_root
+    )
+    block_root = attested_header.root()
+    signing_root = SigningData(
+        object_root=ByteVector(32).hash_tree_root(block_root), domain=domain
+    ).root()
+    try:
+        pks = [bls.PublicKey.from_bytes(bytes(pk)) for pk in participants]
+        sig = bls.Signature.from_bytes(
+            bytes(sync_aggregate.sync_committee_signature)
+        )
+        return bls.fast_aggregate_verify(pks, signing_root, sig)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def verify_optimistic_update(
+    update, committee_pubkeys, spec, genesis_validators_root
+) -> bool:
+    return _verify_sync_aggregate(
+        update.attested_header.beacon, update.sync_aggregate,
+        committee_pubkeys, spec, genesis_validators_root,
+    )
+
+
+def verify_finality_update(
+    update, committee_pubkeys, spec, genesis_validators_root, T,
+    min_participation_num: int = 2, min_participation_den: int = 3,
+) -> bool:
+    """Signature + supermajority + the finality branch proving the
+    finalized checkpoint into the attested header's state root."""
+    bits = [bool(b) for b in update.sync_aggregate.sync_committee_bits]
+    if sum(bits) * min_participation_den < len(bits) * min_participation_num:
+        return False
+    if not _verify_sync_aggregate(
+        update.attested_header.beacon, update.sync_aggregate,
+        committee_pubkeys, spec, genesis_validators_root,
+    ):
+        return False
+    from .ssz import ByteVector
+
+    state_cls = T.BeaconState_BY_FORK["altair"]
+    idx = field_index(state_cls, "finalized_checkpoint")
+    depth = max(len(state_cls._fields) - 1, 0).bit_length()
+    # two-level proof: checkpoint.root is field 1 of Checkpoint, so the
+    # generalized position is idx*2 + 1 at depth+1, with the epoch leaf
+    # as the first sibling in the branch (build_finality_update's shape)
+    finalized_root = update.finalized_header.beacon.root()
+    root = merkle_root_from_branch(
+        ByteVector(32).hash_tree_root(finalized_root),
+        [bytes(b) for b in update.finality_branch],
+        depth + 1,
+        idx * 2 + 1,
+    )
+    return root == bytes(update.attested_header.beacon.state_root)
+
+
+class LightClientStore:
+    """Follower state (the reference light-client's Store): bootstrap
+    pins the committee; gossip updates advance the optimistic and
+    finalized heads — no block download."""
+
+    def __init__(self, bootstrap, spec, genesis_validators_root, T):
+        if not verify_bootstrap(bootstrap, T):
+            raise ValueError("bootstrap proof invalid")
+        self.T = T
+        self.spec = spec
+        self.gvr = genesis_validators_root
+        self.committee_pubkeys = [
+            bytes(pk) for pk in bootstrap.current_sync_committee.pubkeys
+        ]
+        self.optimistic_header = bootstrap.header.beacon
+        self.finalized_header = bootstrap.header.beacon
+
+    def process_optimistic_update(self, update) -> bool:
+        if int(update.attested_header.beacon.slot) <= int(
+            self.optimistic_header.slot
+        ) and int(self.optimistic_header.slot) > 0:
+            return False
+        if not verify_optimistic_update(
+            update, self.committee_pubkeys, self.spec, self.gvr
+        ):
+            return False
+        self.optimistic_header = update.attested_header.beacon
+        return True
+
+    def process_finality_update(self, update) -> bool:
+        # monotonic: a replayed older (still validly signed) update must
+        # not regress finality
+        if int(update.finalized_header.beacon.slot) <= int(
+            self.finalized_header.slot
+        ) and int(self.finalized_header.slot) > 0:
+            return False
+        if not verify_finality_update(
+            update, self.committee_pubkeys, self.spec, self.gvr, self.T
+        ):
+            return False
+        self.finalized_header = update.finalized_header.beacon
+        if int(update.attested_header.beacon.slot) > int(
+            self.optimistic_header.slot
+        ):
+            self.optimistic_header = update.attested_header.beacon
+        return True

@@ -91,6 +91,8 @@ PROTOCOLS = {
     "beacon_blocks_by_root": ("1", None, "signed_block"),
     "blob_sidecars_by_range": ("1", BlobsByRangeRequest, "blob_sidecar"),
     "blob_sidecars_by_root": ("1", None, "blob_sidecar"),
+    # protocol.rs:149-174 light-client serving: request = block root
+    "light_client_bootstrap": ("1", None, "light_client_bootstrap"),
 }
 
 PROTOCOL_PREFIX = "/eth2/beacon_chain/req"

@@ -1,0 +1,182 @@
+"""Light-client SERVING path (VERDICT r4 Missing #5): gossip
+finality/optimistic update topics + the LightClientBootstrap req/resp
+protocol, fed from head updates — and a block-free follower that tracks
+the chain from them.
+
+Match: lighthouse_network/src/types/topics.rs:107 (update topics),
+src/rpc/protocol.rs:149-174 (LightClientBootstrap), and the light-client
+server in beacon_node.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon.node import BeaconNode
+from lighthouse_tpu.consensus import light_client as lc
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.containers import Checkpoint, types_for
+from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+from lighthouse_tpu.network import rpc as rpc_mod
+from lighthouse_tpu.validator.client import SyncCommitteeService, ValidatorStore
+from lighthouse_tpu.validator.slashing_protection import SlashingDatabase
+
+N = 16
+
+
+def _store_for(keys):
+    return ValidatorStore(
+        keys={kp[1].to_bytes(): kp[0] for kp in keys},
+        slashing_db=SlashingDatabase(":memory:"),
+        index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+    )
+
+
+def _drive_sync_duties(node, keys, slot):
+    """Node-side sync-committee participation for ``slot`` (the signal
+    the light-client updates are built from)."""
+    svc = SyncCommitteeService(node.chain, _store_for(keys), node.spec)
+    for subnet, msg in svc.produce_messages(slot):
+        with node._chain_lock:
+            node.chain.process_sync_committee_message(msg, subnet)
+    for signed in svc.produce_contributions(slot):
+        with node._chain_lock:
+            node.chain.process_sync_contribution(signed)
+
+
+@pytest.fixture()
+def pair():
+    spec = phase0_spec(S.MINIMAL)
+    genesis, keys = interop_state(N, spec, fork="altair")
+    a = BeaconNode(spec, genesis, keypairs=keys, fork="altair")
+    b = BeaconNode(spec, genesis, keypairs=keys, fork="altair")
+    a.start()
+    b.start()
+    conn = a.host.dial("127.0.0.1", b.host.port)
+    a._status_handshake(conn)
+    time.sleep(1.0)
+    yield a, b, keys, conn
+    a.stop()
+    b.stop()
+
+
+def test_bootstrap_rpc_over_socket(pair):
+    a, b, keys, conn = pair
+    blk = a.produce_and_publish(1)
+    root = blk.message.root()
+    for _ in range(40):
+        if b.chain.fork_choice.contains_block(root):
+            break
+        time.sleep(0.25)
+    # B serves its own bootstrap over req/resp; A requests it
+    conn2 = b.host.dial("127.0.0.1", a.host.port)
+    code, payload = conn2.request("light_client_bootstrap", root)
+    assert code == rpc_mod.SUCCESS, payload
+    Bootstrap, _ = lc.light_client_types(a.types)
+    bootstrap = Bootstrap.deserialize_value(payload)
+    assert lc.verify_bootstrap(bootstrap, a.types)
+    assert int(bootstrap.header.beacon.slot) == 1
+    # unknown root -> RESOURCE_UNAVAILABLE, not a crash
+    code, _ = conn2.request("light_client_bootstrap", b"\xee" * 32)
+    assert code == rpc_mod.RESOURCE_UNAVAILABLE
+
+
+def test_optimistic_updates_flow_to_follower(pair):
+    a, b, keys, conn = pair
+    b1 = a.produce_and_publish(1)
+    _drive_sync_duties(a, keys, 1)
+    a.produce_and_publish(2)  # carries the slot-1 sync aggregate
+    # B receives the optimistic update over gossip
+    for _ in range(40):
+        if b._latest_lc_optimistic is not None:
+            break
+        time.sleep(0.25)
+    update = b._latest_lc_optimistic
+    assert update is not None, "optimistic update crossed the wire"
+    assert int(update.attested_header.beacon.slot) == 1
+    # a block-free follower: bootstrap (via RPC) + the gossip update
+    conn2 = b.host.dial("127.0.0.1", a.host.port)
+    # bootstrap from GENESIS (the update's attested slot must be newer
+    # than the bootstrap header for the follower to advance)
+    code, payload = conn2.request(
+        "light_client_bootstrap", bytes(b1.message.parent_root)
+    )
+    assert code == rpc_mod.SUCCESS
+    Bootstrap, _ = lc.light_client_types(a.types)
+    store = lc.LightClientStore(
+        Bootstrap.deserialize_value(payload), a.spec,
+        bytes(a.chain.head_state().genesis_validators_root), a.types,
+    )
+    assert store.process_optimistic_update(update)
+    assert int(store.optimistic_header.slot) == 1
+    # a forged update (bits claim participation, garbage signature) drops
+    forged = lc.build_optimistic_update(
+        update.attested_header.beacon, update.sync_aggregate, 99, a.types
+    )
+    forged.attested_header.beacon.slot = 99  # changes the signed root
+    assert not store.process_optimistic_update(forged)
+
+
+def test_finality_update_roundtrip_signed():
+    """build/verify finality update against a hand-finalized state with a
+    REAL supermajority sync-committee signature."""
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(N, spec, fork="altair")
+    T = types_for(spec.preset)
+    # a finalized checkpoint the attested state carries
+    fin_header = lc.LightClientHeader(beacon=__import__(
+        "lighthouse_tpu.consensus.containers", fromlist=["BeaconBlockHeader"]
+    ).BeaconBlockHeader(slot=8)).beacon
+    state.finalized_checkpoint = Checkpoint(epoch=1, root=fin_header.root())
+    from lighthouse_tpu.consensus.containers import BeaconBlockHeader
+
+    attested = BeaconBlockHeader(slot=9, state_root=state.root())
+    # every committee member signs the attested block root
+    store = _store_for(keys)
+    committee_pks = [bytes(pk) for pk in state.current_sync_committee.pubkeys]
+    from lighthouse_tpu.crypto.bls import api as bls
+
+    sigs = []
+    for pk in committee_pks:
+        sigs.append(
+            store.sign_sync_committee_message(
+                pk, 9, attested.root(), state, spec.preset
+            )
+        )
+    agg = T.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_pks),
+        sync_committee_signature=bls.AggregateSignature.aggregate(
+            sigs
+        ).to_bytes(),
+    )
+    update = lc.build_finality_update(
+        state, attested, fin_header, agg, 10, T
+    )
+    gvr = bytes(state.genesis_validators_root)
+    assert lc.verify_finality_update(update, committee_pks, spec, gvr, T)
+    # wrong finalized header -> proof fails
+    bad = lc.build_finality_update(
+        state, attested, BeaconBlockHeader(slot=7), agg, 10, T
+    )
+    assert not lc.verify_finality_update(bad, committee_pks, spec, gvr, T)
+    # sub-supermajority participation -> rejected even with valid sig
+    third = len(committee_pks) // 3
+    weak = T.SyncAggregate(
+        sync_committee_bits=[True] * third
+        + [False] * (len(committee_pks) - third),
+        sync_committee_signature=bls.AggregateSignature.aggregate(
+            sigs[:third]
+        ).to_bytes(),
+    )
+    weak_update = lc.build_finality_update(state, attested, fin_header, weak, 10, T)
+    assert not lc.verify_finality_update(
+        weak_update, committee_pks, spec, gvr, T
+    )
+    # follower store adopts the finality
+    boot_state, _ = interop_state(N, spec, fork="altair")
+    genesis_header = BeaconBlockHeader(state_root=boot_state.root())
+    bootstrap = lc.build_bootstrap(boot_state, genesis_header, T)
+    follower = lc.LightClientStore(bootstrap, spec, gvr, T)
+    assert follower.process_finality_update(update)
+    assert int(follower.finalized_header.slot) == 8
+    assert int(follower.optimistic_header.slot) == 9
