@@ -107,11 +107,17 @@ class BeaconNode:
         self.host.rpc_handlers["blob_sidecars_by_range"] = self._on_blobs_by_range
         self.host.rpc_handlers["blob_sidecars_by_root"] = self._on_blobs_by_root
         self.host.rpc_handlers["light_client_bootstrap"] = self._on_lc_bootstrap
-        # light-client server memory: latest served updates + the last
-        # finalized epoch already announced on the finality topic
+        self.host.rpc_handlers["light_client_updates_by_range"] = (
+            self._on_lc_updates_by_range
+        )
+        # light-client server memory: latest served updates, the last
+        # finalized epoch announced on the finality topic, and the best
+        # (highest-participation) full update per sync-committee period
+        # — the rotation fuel LightClientUpdatesByRange serves
         self._latest_lc_optimistic = None
         self._latest_lc_finality = None
         self._lc_last_finalized_epoch = 0
+        self._lc_best_update_by_period: dict[int, object] = {}
         # 5. HTTP API
         self.api = BeaconApiServer(self.chain, port=http_port, node=self)
         self._dialed: set[bytes] = set()
@@ -892,11 +898,43 @@ class BeaconNode:
         )
         self._latest_lc_optimistic = update
         self.host.publish(self.lc_optimistic_topic, update.encode())
-        fin_epoch, fin_root = self.chain.fork_choice.finalized_checkpoint
-        if fin_epoch > self._lc_last_finalized_epoch and fin_root:
-            attested_state = self.chain.state_for_block(parent_root)
+        # the finality evidence must come from the ATTESTED state — the
+        # fork-choice checkpoint can run ahead of it by one block (the
+        # block that advanced finality), and an update proven against a
+        # state that doesn't hold the claimed checkpoint verifies false
+        attested_state = self.chain.state_for_block(parent_root)
+        if attested_state is None:
+            return
+        # rotation fuel: keep the highest-participation full update per
+        # period.  Spec gate: the ATTESTED header must sit in the same
+        # period as the signature — a boundary-straddling block proves
+        # the wrong next committee and would poison the feed.
+        if hasattr(attested_state, "next_sync_committee"):
+            period = lc.sync_committee_period(
+                max(sig_slot, 1) - 1, self.spec
+            )
+            att_period = lc.sync_committee_period(
+                int(attested_header.slot), self.spec
+            )
+            votes = sum(bool(b) for b in agg.sync_committee_bits)
+            prev = self._lc_best_update_by_period.get(period)
+            if att_period == period and (
+                prev is None
+                or votes > sum(
+                    bool(b) for b in prev.sync_aggregate.sync_committee_bits
+                )
+            ):
+                self._lc_best_update_by_period[period] = (
+                    lc.build_light_client_update(
+                        attested_state, attested_header, agg, sig_slot,
+                        self.types,
+                    )
+                )
+        fin_cp = attested_state.finalized_checkpoint
+        fin_epoch, fin_root = int(fin_cp.epoch), bytes(fin_cp.root)
+        if fin_epoch > self._lc_last_finalized_epoch and any(fin_root):
             fin_block = self.chain.store.get_block(fin_root, self.block_cls)
-            if attested_state is None or fin_block is None:
+            if fin_block is None:
                 return
             fin_update = lc.build_finality_update(
                 attested_state,
@@ -958,6 +996,24 @@ class BeaconNode:
             return "ignore"
         self._latest_lc_finality = update
         return "accept"
+
+    def _on_lc_updates_by_range(self, req: bytes, peer_id):
+        """LightClientUpdatesByRange (rpc/protocol.rs): request is
+        (start_period u64 LE, count u64 LE); response is one coded chunk
+        per period with a known best update — the follower's committee-
+        rotation feed."""
+        if len(req) != 16:
+            return rpc_mod.INVALID_REQUEST, b"want 16-byte (start, count)"
+        start = int.from_bytes(req[:8], "little")
+        count = min(int.from_bytes(req[8:16], "little"), 128)
+        out = b""
+        for period in range(start, start + count):
+            update = self._lc_best_update_by_period.get(period)
+            if update is not None:
+                out += rpc_mod.encode_response_chunk(
+                    rpc_mod.SUCCESS, update.encode()
+                )
+        return rpc_mod.RAW_CHUNKS, out
 
     def _on_lc_bootstrap(self, req: bytes, peer_id):
         """LightClientBootstrap req/resp (rpc/protocol.rs:149-174):

@@ -144,6 +144,8 @@ def verify_bootstrap(bootstrap, T) -> bool:
     state_cls = T.BeaconState_BY_FORK["altair"]
     idx = field_index(state_cls, "current_sync_committee")
     depth = max(len(state_cls._fields) - 1, 0).bit_length()
+    if len(bootstrap.current_sync_committee_branch) != depth:
+        return False  # attacker-length branch must not crash the caller
     leaf = T.SyncCommittee.hash_tree_root_value(
         bootstrap.current_sync_committee
     )
@@ -231,13 +233,14 @@ def build_finality_update(
 
 def _verify_sync_aggregate(
     attested_header, sync_aggregate, committee_pubkeys, spec,
-    genesis_validators_root,
+    genesis_validators_root, signature_slot: int,
 ) -> bool:
     """The signature check shared by both update kinds: the participating
     committee members signed the attested block root under
-    DOMAIN_SYNC_COMMITTEE at the attested slot's epoch (mirrors
-    ValidatorStore.sign_sync_committee_message so server and follower
-    agree bit-for-bit)."""
+    DOMAIN_SYNC_COMMITTEE at the SIGNING slot's epoch — signature_slot-1,
+    the message slot (mirrors ValidatorStore.sign_sync_committee_message
+    and the spec; the attested slot can lag across skipped slots and
+    would pick the wrong fork version at a boundary)."""
     from ..crypto.bls import api as bls
     from . import spec as S
     from .containers import SigningData
@@ -249,7 +252,8 @@ def _verify_sync_aggregate(
     ]
     if not participants:
         return False
-    epoch = int(attested_header.slot) // spec.preset.slots_per_epoch
+    epoch = max(int(signature_slot), 1) - 1
+    epoch //= spec.preset.slots_per_epoch
     fork_version = spec.fork_version_at_epoch(epoch)
     domain = S.compute_domain(
         S.DOMAIN_SYNC_COMMITTEE, fork_version, genesis_validators_root
@@ -274,6 +278,7 @@ def verify_optimistic_update(
     return _verify_sync_aggregate(
         update.attested_header.beacon, update.sync_aggregate,
         committee_pubkeys, spec, genesis_validators_root,
+        int(update.signature_slot),
     )
 
 
@@ -289,6 +294,7 @@ def verify_finality_update(
     if not _verify_sync_aggregate(
         update.attested_header.beacon, update.sync_aggregate,
         committee_pubkeys, spec, genesis_validators_root,
+        int(update.signature_slot),
     ):
         return False
     from .ssz import ByteVector
@@ -299,6 +305,8 @@ def verify_finality_update(
     # two-level proof: checkpoint.root is field 1 of Checkpoint, so the
     # generalized position is idx*2 + 1 at depth+1, with the epoch leaf
     # as the first sibling in the branch (build_finality_update's shape)
+    if len(update.finality_branch) != depth + 1:
+        return False  # wrong-length branch is a malformed update, not a crash
     finalized_root = update.finalized_header.beacon.root()
     root = merkle_root_from_branch(
         ByteVector(32).hash_tree_root(finalized_root),
@@ -312,7 +320,8 @@ def verify_finality_update(
 class LightClientStore:
     """Follower state (the reference light-client's Store): bootstrap
     pins the committee; gossip updates advance the optimistic and
-    finalized heads — no block download."""
+    finalized heads; full LightClientUpdates rotate the committee across
+    sync-committee periods — no block download, ever."""
 
     def __init__(self, bootstrap, spec, genesis_validators_root, T):
         if not verify_bootstrap(bootstrap, T):
@@ -323,18 +332,75 @@ class LightClientStore:
         self.committee_pubkeys = [
             bytes(pk) for pk in bootstrap.current_sync_committee.pubkeys
         ]
+        self.period = sync_committee_period(
+            int(bootstrap.header.beacon.slot), spec
+        )
+        self.next_committee_pubkeys: list[bytes] | None = None
         self.optimistic_header = bootstrap.header.beacon
         self.finalized_header = bootstrap.header.beacon
+
+    def _lookup_committee(self, signature_slot: int):
+        """(pubkeys, rotates) for the committee whose signature covers
+        ``signature_slot`` (signing happens at signature_slot - 1), or
+        None if the store cannot verify that period.  PURE — rotation is
+        committed by _commit_rotation only AFTER a signature verifies, so
+        garbage updates cannot consume the rotation fuel."""
+        period = sync_committee_period(
+            max(signature_slot, 1) - 1, self.spec
+        )
+        if period == self.period:
+            return self.committee_pubkeys, False
+        if period == self.period + 1 and self.next_committee_pubkeys:
+            return self.next_committee_pubkeys, True
+        return None
+
+    def _commit_rotation(self, rotates: bool) -> None:
+        if rotates:
+            self.committee_pubkeys = self.next_committee_pubkeys
+            self.next_committee_pubkeys = None
+            self.period += 1
+
+    def process_light_client_update(self, update) -> bool:
+        """Full update: learn the NEXT committee (rotation fuel).  The
+        attested header must sit in the SAME period as the signature —
+        a boundary-straddling update would teach the wrong committee."""
+        sig_slot = int(update.signature_slot)
+        looked = self._lookup_committee(sig_slot)
+        if looked is None:
+            return False
+        pks, rotates = looked
+        sig_period = sync_committee_period(
+            max(sig_slot, 1) - 1, self.spec
+        )
+        att_period = sync_committee_period(
+            int(update.attested_header.beacon.slot), self.spec
+        )
+        if att_period != sig_period:
+            return False
+        if not verify_light_client_update(
+            update, pks, self.spec, self.gvr, self.T
+        ):
+            return False
+        self._commit_rotation(rotates)
+        self.next_committee_pubkeys = [
+            bytes(pk) for pk in update.next_sync_committee.pubkeys
+        ]
+        return True
 
     def process_optimistic_update(self, update) -> bool:
         if int(update.attested_header.beacon.slot) <= int(
             self.optimistic_header.slot
         ) and int(self.optimistic_header.slot) > 0:
             return False
+        looked = self._lookup_committee(int(update.signature_slot))
+        if looked is None:
+            return False
+        pks, rotates = looked
         if not verify_optimistic_update(
-            update, self.committee_pubkeys, self.spec, self.gvr
+            update, pks, self.spec, self.gvr
         ):
             return False
+        self._commit_rotation(rotates)
         self.optimistic_header = update.attested_header.beacon
         return True
 
@@ -345,13 +411,76 @@ class LightClientStore:
             self.finalized_header.slot
         ) and int(self.finalized_header.slot) > 0:
             return False
+        looked = self._lookup_committee(int(update.signature_slot))
+        if looked is None:
+            return False
+        pks, rotates = looked
         if not verify_finality_update(
-            update, self.committee_pubkeys, self.spec, self.gvr, self.T
+            update, pks, self.spec, self.gvr, self.T
         ):
             return False
+        self._commit_rotation(rotates)
         self.finalized_header = update.finalized_header.beacon
         if int(update.attested_header.beacon.slot) > int(
             self.optimistic_header.slot
         ):
             self.optimistic_header = update.attested_header.beacon
         return True
+
+
+# ---------------------------------------------------------------------------
+# full LightClientUpdate: sync-committee ROTATION (the piece that keeps a
+# follower alive past a period boundary — light_client_update.rs +
+# LightClientUpdatesByRange in rpc/protocol.rs)
+# ---------------------------------------------------------------------------
+
+
+def sync_committee_period(slot: int, spec) -> int:
+    return int(slot) // (
+        spec.preset.slots_per_epoch
+        * spec.preset.epochs_per_sync_committee_period
+    )
+
+
+def build_light_client_update(
+    attested_state, attested_header, sync_aggregate, signature_slot, T
+):
+    """Full update proving the attested state's NEXT sync committee —
+    what a follower needs to cross the period boundary."""
+    _, Update = light_client_types(T)
+    leaf, branch, depth = field_proof(attested_state, "next_sync_committee")
+    return Update(
+        attested_header=LightClientHeader(beacon=attested_header),
+        next_sync_committee=attested_state.next_sync_committee,
+        next_sync_committee_branch=[bytes(b) for b in branch],
+        finalized_header=LightClientHeader(),
+        finality_branch=[],
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+
+
+def verify_light_client_update(
+    update, committee_pubkeys, spec, genesis_validators_root, T
+) -> bool:
+    """Signature by the CURRENT committee + the next-committee branch
+    proving into the attested header's state root."""
+    if not _verify_sync_aggregate(
+        update.attested_header.beacon, update.sync_aggregate,
+        committee_pubkeys, spec, genesis_validators_root,
+        int(update.signature_slot),
+    ):
+        return False
+    state_cls = T.BeaconState_BY_FORK["altair"]
+    idx = field_index(state_cls, "next_sync_committee")
+    depth = max(len(state_cls._fields) - 1, 0).bit_length()
+    if len(update.next_sync_committee_branch) != depth:
+        return False  # wrong-length branch is a malformed update, not a crash
+    leaf = T.SyncCommittee.hash_tree_root_value(update.next_sync_committee)
+    root = merkle_root_from_branch(
+        leaf,
+        [bytes(b) for b in update.next_sync_committee_branch],
+        depth,
+        idx,
+    )
+    return root == bytes(update.attested_header.beacon.state_root)

@@ -127,3 +127,75 @@ def pubkey_getter(state):
         return pk
 
     return get
+
+
+def apply_epoch_handler(state, handler: str, spec) -> None:
+    """Run ONE epoch-processing sub-step on ``state`` in place — the
+    dispatch the EF `epoch_processing` runner families use
+    (testing/ef_tests/src/cases/epoch_processing.rs runs exactly one
+    sub-transition per case)."""
+    from .state_processing import per_epoch as E
+    from .state_processing.arrays import ValidatorArrays
+
+    preset = spec.preset
+    va = ValidatorArrays.extract(state)
+    n = len(state.validators)
+    current = E.get_current_epoch(state, preset)
+    previous = max(current, 1) - 1
+    prev_flags = E._flags(state, "previous", n)
+    curr_flags = E._flags(state, "current", n)
+    if handler == "justification_and_finalization":
+        E.process_justification_and_finalization(
+            state, va, prev_flags, curr_flags, current, previous, spec
+        )
+    elif handler == "inactivity_updates":
+        E.process_inactivity_updates(
+            state, va, prev_flags, current, previous, spec
+        )
+    elif handler == "rewards_and_penalties":
+        E.process_rewards_and_penalties(
+            state, va, prev_flags, current, previous, spec
+        )
+    elif handler == "registry_updates":
+        E.process_registry_updates(state, va, current, spec)
+    elif handler == "slashings":
+        from .state_processing.forks import (
+            proportional_slashing_multiplier,
+            state_fork_name,
+        )
+
+        E.process_slashings(
+            state, va, current, spec,
+            multiplier=proportional_slashing_multiplier(
+                state_fork_name(state), preset
+            ),
+        )
+    elif handler == "effective_balance_updates":
+        E.process_effective_balance_updates(va, spec)
+    else:
+        raise KeyError(f"unknown epoch handler {handler}")
+    va.writeback(state)
+
+
+def apply_operation(state, handler: str, op, spec, verify: bool = False):
+    """Apply ONE block operation in place (the EF `operations` runner
+    dispatch — testing/ef_tests/src/cases/operations.rs); raises on an
+    invalid operation."""
+    from .state_processing import per_block as PB
+
+    get_pk = pubkey_getter(state)
+    if handler == "attestation":
+        from . import committees as cm
+
+        cc = cm.CommitteeCache(state, int(op.data.target.epoch), spec.preset)
+        PB.process_attestation(state, op, spec, cc, verify, get_pk)
+    elif handler == "proposer_slashing":
+        PB.process_proposer_slashing(state, op, spec, verify, get_pk)
+    elif handler == "attester_slashing":
+        PB.process_attester_slashing(state, op, spec, verify, get_pk)
+    elif handler == "voluntary_exit":
+        PB.process_voluntary_exit(state, op, spec, verify, get_pk)
+    elif handler == "deposit":
+        PB.process_deposit(state, op, spec)
+    else:
+        raise KeyError(f"unknown operation handler {handler}")

@@ -64,12 +64,23 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
         ok_pair = PR.final_exp_is_one(total)
         return jnp.reshape(ok_pair & ok_all, ())
 
-    # check_vma=False: the field core's lax.scan carries initialize from
-    # replicated constants (e.g. the Montgomery accumulator in fp.mont_mul);
-    # under the varying-manual-axes type system every such carry would need a
-    # pcast at its init.  The kernel is used both inside and outside
-    # shard_map, so opt out of vma tracking here instead of threading mesh
-    # metadata through the whole limb library.
+    # check_vma=False — re-verified against this jax version (r5): with
+    # check_vma=True the first field-core scan fails typing with
+    #   "input carry acc has type uint32[52,18] but the corresponding
+    #    output carry component has type uint32[52,18]{V:batch} ...
+    #    might be fixed by applying jax.lax.pcast(..., ('batch',),
+    #    to='varying') to the initial carry value"
+    # because every Horner/Montgomery scan in fp.py initializes its carry
+    # from a replicated zero/constant while the loop body mixes in
+    # batch-varying limbs.  Fixing it "properly" means pcast at every
+    # carry init — but those inits live in the limb library, which is
+    # used both inside and outside shard_map, and pcast with an axis
+    # name is an error outside a mesh context.  Threading an
+    # inside-a-mesh flag through fp.py buys type checking and costs a
+    # second code path in the hottest code; correctness is instead
+    # pinned by the shard-vs-single bit-equality tests
+    # (test_multichip.py) and the poisoned-batch rejection in the
+    # driver's dryrun.
     sharded = shard_map(
         local_part,
         mesh=mesh,

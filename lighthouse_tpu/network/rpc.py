@@ -93,6 +93,8 @@ PROTOCOLS = {
     "blob_sidecars_by_root": ("1", None, "blob_sidecar"),
     # protocol.rs:149-174 light-client serving: request = block root
     "light_client_bootstrap": ("1", None, "light_client_bootstrap"),
+    # request = (start_period u64, count u64); chunked best updates
+    "light_client_updates_by_range": ("1", None, "light_client_update"),
 }
 
 PROTOCOL_PREFIX = "/eth2/beacon_chain/req"

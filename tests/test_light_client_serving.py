@@ -180,3 +180,103 @@ def test_finality_update_roundtrip_signed():
     assert follower.process_finality_update(update)
     assert int(follower.finalized_header.slot) == 8
     assert int(follower.optimistic_header.slot) == 9
+
+
+def test_committee_rotation_via_full_update():
+    """A follower crosses the sync-committee period boundary: the full
+    LightClientUpdate teaches it the next committee; updates signed by
+    the ROTATED committee then verify, and without the rotation fuel the
+    store honestly wedges (light_client_update.rs process flow)."""
+    from lighthouse_tpu.consensus.containers import BeaconBlockHeader
+    from lighthouse_tpu.consensus.state_processing.per_slot import (
+        process_slots,
+    )
+    from lighthouse_tpu.crypto.bls import api as bls
+
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(N, spec, fork="altair")
+    T = types_for(spec.preset)
+    period_slots = (
+        spec.preset.slots_per_epoch
+        * spec.preset.epochs_per_sync_committee_period
+    )
+
+    store_v = _store_for(keys)
+    gvr = bytes(state.genesis_validators_root)
+
+    def signed_aggregate(attested, committee_pks, sign_state, slot):
+        sigs = [
+            store_v.sign_sync_committee_message(
+                bytes(pk), slot, attested.root(), sign_state, spec.preset
+            )
+            for pk in committee_pks
+        ]
+        return T.SyncAggregate(
+            sync_committee_bits=[True] * len(committee_pks),
+            sync_committee_signature=bls.AggregateSignature.aggregate(
+                sigs
+            ).to_bytes(),
+        )
+
+    # follower bootstrapped in period 0
+    boot_header = BeaconBlockHeader(state_root=state.root())
+    follower = lc.LightClientStore(
+        lc.build_bootstrap(state, boot_header, T), spec, gvr, T
+    )
+    committee0 = [bytes(pk) for pk in state.current_sync_committee.pubkeys]
+    next_committee = [bytes(pk) for pk in state.next_sync_committee.pubkeys]
+
+    # full update in period 0 (signed by committee0) carries the NEXT
+    # committee + branch
+    attested0 = BeaconBlockHeader(slot=5, state_root=state.root())
+    agg0 = signed_aggregate(attested0, committee0, state, 5)
+    full = lc.build_light_client_update(state, attested0, agg0, 6, T)
+    assert follower.process_light_client_update(full)
+    assert follower.next_committee_pubkeys == next_committee
+
+    # cross the boundary: the state rotates current <- next
+    state2 = process_slots(state.copy(), period_slots, spec)
+    committee1 = [bytes(pk) for pk in state2.current_sync_committee.pubkeys]
+    assert committee1 == next_committee, "state rotated as scheduled"
+
+    # an optimistic update signed by the PERIOD-1 committee
+    attested1 = BeaconBlockHeader(
+        slot=period_slots, state_root=state2.root()
+    )
+    agg1 = signed_aggregate(
+        attested1, committee1, state2, period_slots
+    )
+    upd1 = lc.build_optimistic_update(attested1, agg1, period_slots + 1, T)
+    assert follower.process_optimistic_update(upd1)
+    assert follower.period == 1, "store rotated on first next-period update"
+    assert follower.committee_pubkeys == committee1
+
+    # a SECOND follower without rotation fuel wedges honestly
+    wedged = lc.LightClientStore(
+        lc.build_bootstrap(state, boot_header, T), spec, gvr, T
+    )
+    assert not wedged.process_optimistic_update(upd1)
+
+
+def test_updates_by_range_rpc(pair):
+    """The rotation feed over the wire: the serving node records a best
+    full update per period and serves it via LightClientUpdatesByRange."""
+    a, b, keys, conn = pair
+    a.produce_and_publish(1)
+    _drive_sync_duties(a, keys, 1)
+    a.produce_and_publish(2)
+    assert 0 in a._lc_best_update_by_period
+    conn2 = b.host.dial("127.0.0.1", a.host.port)
+    req = (0).to_bytes(8, "little") + (4).to_bytes(8, "little")
+    chunks = conn2.request_multi("light_client_updates_by_range", req)
+    assert len(chunks) == 1 and chunks[0][0] == rpc_mod.SUCCESS
+    _, Update = lc.light_client_types(a.types)
+    update = Update.deserialize_value(chunks[0][1])
+    assert lc.verify_light_client_update(
+        update,
+        [bytes(pk) for pk in
+         a.chain.head_state().current_sync_committee.pubkeys],
+        a.spec,
+        bytes(a.chain.head_state().genesis_validators_root),
+        a.types,
+    )

@@ -1,0 +1,550 @@
+#!/usr/bin/env python3
+"""Generate EF-format consensus vector families (VERDICT r4 Missing #9).
+
+Twin of the reference's consensus-spec-tests layout walked by
+testing/ef_tests (src/handler.rs:10-77, src/cases/): each case is a
+directory of ssz-snappy state/operation files + meta.json, and a
+handler-specific runner replays it.  Zero-egress environment: the cases
+are SELF-GENERATED from hand-built edge states (slashed proposer, leak
+boundary, equivocating attestations, churn-capped registry, bad proofs)
+— they pin today's behavior against regression in the exact directory
+format the reference consumes, anchored by the external KATs elsewhere
+in the suite (mainnet genesis root, EIP-2333, RFC9380, live ENRs).
+
+Families (runner/handler):
+  operations/{attestation,proposer_slashing,attester_slashing,
+              voluntary_exit,deposit}
+  sanity/{slots,blocks}
+  epoch_processing/{justification_and_finalization,registry_updates,
+                    slashings,effective_balance_updates}
+  shuffling/core
+
+Layout: tests/vectors/consensus/minimal/altair/<runner>/<handler>/
+        <case>/{pre.ssz_snappy, post.ssz_snappy?, <op>.ssz_snappy,
+        meta.json}   (no post = the case must FAIL)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.consensus import spec as S
+from lighthouse_tpu.consensus.containers import (
+    Attestation,
+    AttestationData,
+    AttesterSlashing,
+    Checkpoint,
+    Deposit,
+    DepositData,
+    DepositMessage,
+    IndexedAttestation,
+    ProposerSlashing,
+    SignedBeaconBlockHeader,
+    BeaconBlockHeader,
+    SignedVoluntaryExit,
+    VoluntaryExit,
+    types_for,
+)
+from lighthouse_tpu.consensus.testing import (
+    apply_epoch_handler,
+    interop_keypairs,
+    interop_state,
+    phase0_spec,
+    pubkey_getter,
+)
+from lighthouse_tpu.consensus.state_processing import per_block as PB
+from lighthouse_tpu.consensus.state_processing.per_slot import process_slots
+from lighthouse_tpu.network.snappy import compress_framed
+
+ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "vectors", "consensus", "minimal", "altair",
+)
+
+N = 16
+SPEC = phase0_spec(S.MINIMAL)
+T = types_for(SPEC.preset)
+
+
+def fresh(slot: int = 8):
+    state, keys = interop_state(N, SPEC, fork="altair")
+    if slot:
+        state = process_slots(state, slot, SPEC)
+    return state, keys
+
+
+def write_case(runner, handler, name, pre, op=None, op_name=None,
+               post=None, meta=None):
+    d = os.path.join(ROOT, runner, handler, name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "pre.ssz_snappy"), "wb") as f:
+        f.write(compress_framed(pre.encode()))
+    if op is not None:
+        with open(os.path.join(d, f"{op_name}.ssz_snappy"), "wb") as f:
+            f.write(compress_framed(op.encode()))
+    if post is not None:
+        with open(os.path.join(d, "post.ssz_snappy"), "wb") as f:
+            f.write(compress_framed(post.encode()))
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump(meta or {}, f, indent=1)
+
+
+def run_op(state, handler, op, verify=False):
+    """Apply one operation; return post state (copy) or None on failure
+    (shares the runner's exact dispatch: testing.apply_operation)."""
+    from lighthouse_tpu.consensus.testing import apply_operation
+
+    st = state.copy()
+    try:
+        apply_operation(st, handler, op, SPEC, verify)
+        return st
+    except Exception:  # noqa: BLE001 — invalid case
+        return None
+
+
+def emit(runner, handler, name, pre, op, op_name, verify=False, extra=None):
+    post = run_op(pre, handler, op, verify)
+    meta = {"verify_signatures": verify}
+    meta.update(extra or {})
+    write_case(runner, handler, name, pre, op, op_name, post, meta)
+    return post is not None
+
+
+# --------------------------------------------------------------- builders
+
+
+def make_attestation(state, slot, index=0, bad_target=False, bits=None):
+    import lighthouse_tpu.consensus.committees as cm
+
+    preset = SPEC.preset
+    epoch = slot // preset.slots_per_epoch
+    cache = cm.CommitteeCache(state, epoch, preset)
+    committee = cache.committee(slot, index)
+    target_slot = epoch * preset.slots_per_epoch
+    if int(state.slot) > target_slot:
+        target_root = bytes(
+            state.block_roots[target_slot % preset.slots_per_historical_root]
+        )
+    else:
+        target_root = bytes(
+            state.block_roots[(int(state.slot) - 1)
+                              % preset.slots_per_historical_root]
+        )
+    head_root = bytes(
+        state.block_roots[(int(state.slot) - 1)
+                          % preset.slots_per_historical_root]
+    )
+    data = AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=head_root,
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(
+            epoch=epoch,
+            root=b"\xbb" * 32 if bad_target else target_root,
+        ),
+    )
+    if bits is None:
+        bits = [True] * len(committee)
+    return Attestation(
+        aggregation_bits=bits, data=data, signature=b"\x00" * 96
+    )
+
+
+def gen_operations():
+    n_ok = 0
+    # -- attestation ------------------------------------------------------
+    st, keys = fresh(8)
+    att = make_attestation(st, 7)
+    assert emit("operations", "attestation", "valid_prev_slot", st, att,
+                "attestation")
+    # wrong target ROOT is VALID per spec (no target flag earned; the
+    # attester simply gets no reward) — the post state pins that subtlety
+    att = make_attestation(st, 7, bad_target=True)
+    assert emit("operations", "attestation", "wrong_target_root_no_flag",
+                st, att, "attestation")
+    # wrong SOURCE is an assertion failure
+    att = make_attestation(st, 7)
+    att.data.source = Checkpoint(epoch=0, root=b"\xdd" * 32)
+    assert not emit("operations", "attestation", "wrong_source", st, att,
+                    "attestation")
+    att = make_attestation(st, 7)
+    att.data.slot = 8  # inclusion delay violated (slot == state.slot)
+    assert not emit("operations", "attestation", "too_recent", st, att,
+                    "attestation")
+    att = make_attestation(st, 7, bits=[False] * 4)
+    assert not emit("operations", "attestation", "empty_bits_mismatch", st,
+                    att, "attestation")
+    st2 = process_slots(st.copy(), 24, SPEC)  # > 1 epoch later
+    att = make_attestation(st, 7)
+    assert not emit("operations", "attestation", "expired_epoch", st2, att,
+                    "attestation")
+    att = make_attestation(st, 6)
+    assert emit("operations", "attestation", "two_slot_delay", st, att,
+                "attestation")
+    # committee index out of range (16 validators -> 1 committee/slot)
+    att = make_attestation(st, 7)
+    att.data.index = 1
+    assert not emit("operations", "attestation", "committee_index_oob",
+                    st, att, "attestation")
+
+    # -- proposer slashing -----------------------------------------------
+    st, keys = fresh(8)
+
+    def header(slot, proposer, root):
+        return SignedBeaconBlockHeader(
+            message=BeaconBlockHeader(
+                slot=slot, proposer_index=proposer, parent_root=root,
+                state_root=b"\x00" * 32, body_root=b"\x00" * 32,
+            ),
+            signature=b"\x00" * 96,
+        )
+
+    ps = ProposerSlashing(
+        signed_header_1=header(6, 3, b"\x01" * 32),
+        signed_header_2=header(6, 3, b"\x02" * 32),
+    )
+    assert emit("operations", "proposer_slashing", "valid_equivocation",
+                st, ps, "proposer_slashing")
+    ps2 = ProposerSlashing(
+        signed_header_1=header(6, 3, b"\x01" * 32),
+        signed_header_2=header(6, 3, b"\x01" * 32),
+    )
+    assert not emit("operations", "proposer_slashing", "identical_headers",
+                    st, ps2, "proposer_slashing")
+    ps3 = ProposerSlashing(
+        signed_header_1=header(6, 3, b"\x01" * 32),
+        signed_header_2=header(6, 4, b"\x02" * 32),
+    )
+    assert not emit("operations", "proposer_slashing", "different_proposers",
+                    st, ps3, "proposer_slashing")
+    st_slashed = st.copy()
+    st_slashed.validators[3].slashed = True
+    assert not emit("operations", "proposer_slashing", "already_slashed",
+                    st_slashed, ps, "proposer_slashing")
+
+    # -- attester slashing ------------------------------------------------
+    st, keys = fresh(8)
+    d1 = AttestationData(
+        slot=6, index=0, beacon_block_root=b"\x01" * 32,
+        source=Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=Checkpoint(epoch=0, root=b"\x0a" * 32),
+    )
+    d2 = AttestationData(
+        slot=6, index=0, beacon_block_root=b"\x02" * 32,
+        source=Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=Checkpoint(epoch=0, root=b"\x0b" * 32),
+    )
+    asl = AttesterSlashing(
+        attestation_1=IndexedAttestation(
+            attesting_indices=[1, 2], data=d1, signature=b"\x00" * 96
+        ),
+        attestation_2=IndexedAttestation(
+            attesting_indices=[2, 5], data=d2, signature=b"\x00" * 96
+        ),
+    )
+    assert emit("operations", "attester_slashing", "double_vote", st, asl,
+                "attester_slashing")
+    d_sur_1 = AttestationData(
+        slot=6, index=0, beacon_block_root=b"\x01" * 32,
+        source=Checkpoint(epoch=0, root=b"\x00" * 32),
+        target=Checkpoint(epoch=3, root=b"\x0a" * 32),
+    )
+    d_sur_2 = AttestationData(
+        slot=6, index=0, beacon_block_root=b"\x02" * 32,
+        source=Checkpoint(epoch=1, root=b"\x01" * 32),
+        target=Checkpoint(epoch=2, root=b"\x0b" * 32),
+    )
+    asl_s = AttesterSlashing(
+        attestation_1=IndexedAttestation(
+            attesting_indices=[4], data=d_sur_1, signature=b"\x00" * 96
+        ),
+        attestation_2=IndexedAttestation(
+            attesting_indices=[4], data=d_sur_2, signature=b"\x00" * 96
+        ),
+    )
+    assert emit("operations", "attester_slashing", "surround_vote", st,
+                asl_s, "attester_slashing")
+    asl_bad = AttesterSlashing(
+        attestation_1=IndexedAttestation(
+            attesting_indices=[2, 1], data=d1, signature=b"\x00" * 96
+        ),
+        attestation_2=IndexedAttestation(
+            attesting_indices=[2, 5], data=d2, signature=b"\x00" * 96
+        ),
+    )
+    assert not emit("operations", "attester_slashing", "unsorted_indices",
+                    st, asl_bad, "attester_slashing")
+    asl_ns = AttesterSlashing(
+        attestation_1=IndexedAttestation(
+            attesting_indices=[1], data=d1, signature=b"\x00" * 96
+        ),
+        attestation_2=IndexedAttestation(
+            attesting_indices=[1], data=d1, signature=b"\x00" * 96
+        ),
+    )
+    assert not emit("operations", "attester_slashing", "not_slashable_same",
+                    st, asl_ns, "attester_slashing")
+
+    # -- voluntary exit ---------------------------------------------------
+    # validators must be past shard_committee_period: jump far ahead
+    st, keys = fresh(8)
+    far = SPEC.shard_committee_period * SPEC.preset.slots_per_epoch + 16
+    st_old = process_slots(st.copy(), far, SPEC)
+    epoch_now = far // SPEC.preset.slots_per_epoch
+    exit_ok = SignedVoluntaryExit(
+        message=VoluntaryExit(epoch=epoch_now, validator_index=2),
+        signature=b"\x00" * 96,
+    )
+    assert emit("operations", "voluntary_exit", "valid", st_old, exit_ok,
+                "voluntary_exit")
+    exit_young = SignedVoluntaryExit(
+        message=VoluntaryExit(epoch=0, validator_index=2),
+        signature=b"\x00" * 96,
+    )
+    assert not emit("operations", "voluntary_exit", "too_young", st,
+                    exit_young, "voluntary_exit")
+    st_exited = st_old.copy()
+    st_exited.validators[2].exit_epoch = epoch_now  # already exiting
+    assert not emit("operations", "voluntary_exit", "already_exited",
+                    st_exited, exit_ok, "voluntary_exit")
+    exit_future = SignedVoluntaryExit(
+        message=VoluntaryExit(epoch=epoch_now + 10, validator_index=2),
+        signature=b"\x00" * 96,
+    )
+    assert not emit("operations", "voluntary_exit", "future_epoch", st_old,
+                    exit_future, "voluntary_exit")
+
+    # -- deposit ----------------------------------------------------------
+    from lighthouse_tpu.beacon.eth1 import DepositCache
+
+    st, keys = fresh(8)
+
+    def deposit_data(i, amount=None, bad_sig=False):
+        sk = interop_keypairs(N + i + 1)[N + i][0]
+        dd = DepositData(
+            pubkey=sk.public_key().to_bytes(),
+            withdrawal_credentials=b"\x00" * 32,
+            amount=amount or SPEC.max_effective_balance,
+        )
+        msg = DepositMessage(
+            pubkey=dd.pubkey,
+            withdrawal_credentials=dd.withdrawal_credentials,
+            amount=dd.amount,
+        )
+        dom = S.compute_domain(
+            S.DOMAIN_DEPOSIT, SPEC.genesis_fork_version, bytes(32)
+        )
+        sig = sk.sign(S.compute_signing_root(msg, dom)).to_bytes()
+        if bad_sig:
+            sig = interop_keypairs(1)[0][0].sign(b"\x00" * 32).to_bytes()
+        dd.signature = sig
+        return dd
+
+    cache = DepositCache()
+    cache.insert_log(0, deposit_data(0))
+    st_dep = st.copy()
+    st_dep.eth1_data.deposit_root = cache.deposit_root()
+    st_dep.eth1_data.deposit_count = 1
+    st_dep.eth1_deposit_index = 0
+    dep = cache.deposits_for_block(0, 1)[0]
+    assert emit("operations", "deposit", "new_validator", st_dep, dep,
+                "deposit", verify=True)
+    # bad proof: flip a byte
+    dep_bad = Deposit(
+        proof=[bytes(p) for p in dep.proof][:-1] + [b"\xff" * 32],
+        data=dep.data,
+    )
+    assert not emit("operations", "deposit", "bad_proof", st_dep, dep_bad,
+                    "deposit", verify=True)
+    # bad signature on a NEW validator: deposit is a no-op but VALID
+    cache2 = DepositCache()
+    cache2.insert_log(0, deposit_data(1, bad_sig=True))
+    st_dep2 = st.copy()
+    st_dep2.eth1_data.deposit_root = cache2.deposit_root()
+    st_dep2.eth1_data.deposit_count = 1
+    st_dep2.eth1_deposit_index = 0
+    dep2 = cache2.deposits_for_block(0, 1)[0]
+    post = run_op(st_dep2, "deposit", dep2, verify=True)
+    assert post is not None and len(post.validators) == N  # not added
+    write_case("operations", "deposit", "bad_sig_ignored", st_dep2, dep2,
+               "deposit", post, {"verify_signatures": True})
+    # top-up of an existing validator (index 3)
+    sk3, pk3 = interop_keypairs(N)[3]
+    topup = DepositData(
+        pubkey=pk3.to_bytes(),
+        withdrawal_credentials=b"\x00" * 32,
+        amount=10**9,
+        signature=b"\x00" * 96,  # top-ups skip signature checks
+    )
+    cache3 = DepositCache()
+    cache3.insert_log(0, topup)
+    st_dep3 = st.copy()
+    st_dep3.eth1_data.deposit_root = cache3.deposit_root()
+    st_dep3.eth1_data.deposit_count = 1
+    st_dep3.eth1_deposit_index = 0
+    dep3 = cache3.deposits_for_block(0, 1)[0]
+    assert emit("operations", "deposit", "top_up", st_dep3, dep3,
+                "deposit", verify=True)
+
+
+def gen_sanity():
+    # slots
+    st, _ = fresh(0)
+    for name, target in (
+        ("one_slot", 1),
+        ("epoch_boundary", SPEC.preset.slots_per_epoch),
+        ("two_epochs", 2 * SPEC.preset.slots_per_epoch),
+        ("mid_epoch_hop", SPEC.preset.slots_per_epoch + 3),
+    ):
+        post = process_slots(st.copy(), target, SPEC)
+        d = os.path.join(ROOT, "sanity", "slots", name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "pre.ssz_snappy"), "wb") as f:
+            f.write(compress_framed(st.encode()))
+        with open(os.path.join(d, "post.ssz_snappy"), "wb") as f:
+            f.write(compress_framed(post.encode()))
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump({"slots": target - int(st.slot)}, f)
+
+    # blocks: drive a real chain for deterministic signed blocks
+    from lighthouse_tpu.beacon.chain import BeaconChain
+
+    st, keys = fresh(0)
+    chain = BeaconChain(SPEC, st.copy(), None, fork="altair")
+    blocks = []
+    for slot in (1, 2, 3):
+        blk = chain.produce_block(slot, keys)
+        chain.process_block(blk)
+        blocks.append(blk)
+
+    def blocks_case(name, pre, blks, valid=True, verify=True):
+        d = os.path.join(ROOT, "sanity", "blocks", name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "pre.ssz_snappy"), "wb") as f:
+            f.write(compress_framed(pre.encode()))
+        for i, b in enumerate(blks):
+            with open(os.path.join(d, f"blocks_{i}.ssz_snappy"), "wb") as f:
+                f.write(compress_framed(b.encode()))
+        post = None
+        if valid:
+            s = pre.copy()
+            for b in blks:
+                s = process_slots(s, int(b.message.slot), SPEC)
+                PB.process_block(
+                    s, b, SPEC, verify_signatures=verify,
+                    get_pubkey=pubkey_getter(s),
+                )
+            post = s
+            with open(os.path.join(d, "post.ssz_snappy"), "wb") as f:
+                f.write(compress_framed(post.encode()))
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(
+                {"blocks_count": len(blks), "verify_signatures": verify}, f
+            )
+
+    blocks_case("single_block", st, blocks[:1])
+    blocks_case("three_block_chain", st, blocks)
+    # tampered proposer index: the header check must reject it
+    # (the OUTER proposer signature is a block-verification concern —
+    # chain.signature_verify_block — not process_block's; EF models the
+    # same split)
+    from lighthouse_tpu.network.api import from_json, to_json
+
+    bad_msg_json = to_json(type(blocks[0].message), blocks[0].message)
+    bad_msg = from_json(type(blocks[0].message), bad_msg_json)
+    bad_msg.proposer_index = (int(bad_msg.proposer_index) + 1) % N
+    bad = type(blocks[0])(message=bad_msg, signature=bytes(96))
+    blocks_case("wrong_proposer_index", st, [bad], valid=False,
+                verify=False)
+    # replayed block (same slot twice) must fail header checks
+    blocks_case("replayed_block", st, [blocks[0], blocks[0]], valid=False)
+
+
+def gen_epoch_processing():
+    cases = []
+    # leak boundary: finality stalled >4 epochs
+    st, _ = fresh(8 * 8)
+    st.finalized_checkpoint = Checkpoint(epoch=0, root=b"\x00" * 32)
+    cases.append(("leak_boundary", st))
+    # full participation at an epoch boundary
+    st2, _ = fresh(8)
+    st2.previous_epoch_participation = [7] * N
+    st2.current_epoch_participation = [7] * N
+    cases.append(("full_participation", st2))
+    # slashed quarter of the registry
+    st3, _ = fresh(16)
+    for i in range(4):
+        st3.validators[i].slashed = True
+        st3.validators[i].withdrawable_epoch = 9
+        st3.slashings[0] = 4 * SPEC.max_effective_balance
+    cases.append(("quarter_slashed", st3))
+    # churn cap: everyone eligible for activation at once
+    st4, _ = fresh(8)
+    for v in st4.validators:
+        v.activation_epoch = SPEC.far_future_epoch if hasattr(
+            SPEC, "far_future_epoch"
+        ) else (2**64 - 1)
+        v.activation_eligibility_epoch = 0
+    cases.append(("activation_churn_cap", st4))
+    # balances around the hysteresis threshold
+    st5, _ = fresh(8)
+    for i, b in enumerate(st5.balances):
+        st5.balances[i] = SPEC.max_effective_balance - (i % 3) * 10**9
+    cases.append(("hysteresis_band", st5))
+
+    for handler in (
+        "justification_and_finalization", "registry_updates", "slashings",
+        "effective_balance_updates",
+    ):
+        for name, pre in cases:
+            post = pre.copy()
+            apply_epoch_handler(post, handler, SPEC)
+            write_case("epoch_processing", handler, name, pre, post=post,
+                       meta={"handler": handler})
+
+
+def gen_shuffling():
+    from lighthouse_tpu.consensus.shuffle import shuffle_list
+    import numpy as np
+
+    d_base = os.path.join(ROOT, "shuffling", "core")
+    for i, (seed_byte, count) in enumerate(
+        [(0, 1), (1, 2), (2, 8), (3, 16), (4, 17), (5, 31), (6, 64),
+         (7, 100), (8, 128), (9, 333)]
+    ):
+        seed = bytes([seed_byte]) * 32
+        perm = shuffle_list(
+            np.arange(count), seed, SPEC.preset.shuffle_round_count
+        )
+        d = os.path.join(d_base, f"shuffle_{i:04d}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(
+                {
+                    "seed": "0x" + seed.hex(),
+                    "count": count,
+                    "mapping": [int(x) for x in perm],
+                },
+                f,
+            )
+
+
+def main():
+    if os.path.isdir(ROOT):
+        shutil.rmtree(ROOT)
+    gen_operations()
+    gen_sanity()
+    gen_epoch_processing()
+    gen_shuffling()
+    n = sum(len(files) for _, _, files in os.walk(ROOT))
+    print(f"generated consensus vector tree under {ROOT} ({n} files)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+#!/usr/bin/env python3
+"""Virtual-mesh scaling curve for the sharded verify kernel (VERDICT r4
+weak #4): 1/2/4/8 devices at a fixed global batch, one JSON line per
+point with wall time, the matching single-device shard-size time, and
+the implied combine overhead.
+
+Honesty note (printed into the output): on the virtual CPU mesh the
+"devices" share the host's cores, so absolute sets/s does NOT scale —
+what this curve validates is (a) the sharded program compiles + runs at
+every mesh size, (b) results stay bit-identical to single-device, and
+(c) the cross-device combine (all_gather of one fp12 + one G2 per
+device, then the replicated epilogue) stays flat as the mesh grows.  On
+real chips each shard owns its silicon, so per-point sets/s multiplies
+by the device count minus this measured combine term (the
+block_signature_verifier.rs:396-405 chunk-AND-reduce analog).
+
+Usage: python tools/multichip_scaling.py [--batch 256] [--iters 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# virtual 8-device CPU mesh BEFORE jax init (tool runs host-side)
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    import numpy as np
+
+    import __graft_entry__ as graft
+
+    graft._enable_compile_cache(jax)
+    from jax.sharding import Mesh
+
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import _verify_kernel
+    from lighthouse_tpu.crypto.bls.jax_backend.multichip import (
+        make_verify_sharded,
+    )
+
+    B = args.batch
+    print(f"building + marshaling B={B} ...", file=sys.stderr)
+    batch = graft._example_batch(B)
+
+    single = jax.jit(_verify_kernel)
+
+    def timed(fn, fargs):
+        t0 = time.time()
+        ok = fn(*fargs)
+        jax.block_until_ready(ok)
+        compile_s = time.time() - t0
+        best = float("inf")
+        for _ in range(args.iters):
+            t0 = time.time()
+            jax.block_until_ready(fn(*fargs))
+            best = min(best, time.time() - t0)
+        return bool(ok), compile_s, best
+
+    # single-device reference at the full batch AND at each shard size
+    shard_times = {}
+    for n in (1, 2, 4, 8):
+        shard_b = B // n
+        sub = graft._example_batch(shard_b)
+        ok, comp, best = timed(single, sub)
+        assert ok is True
+        shard_times[n] = best
+        print(f"single-device B={shard_b}: {best:.3f}s", file=sys.stderr)
+
+    results = []
+    for n in (1, 2, 4, 8):
+        mesh = Mesh(np.array(jax.devices()[:n]), ("batch",))
+        fn = make_verify_sharded(mesh)
+        ok, comp, best = timed(fn, batch)
+        assert ok is True
+        # bit-equality vs single-device at the full batch
+        same = bool(single(*batch)) == ok
+        point = {
+            "devices": n,
+            "global_batch": B,
+            "shard_batch": B // n,
+            "wall_best_s": round(best, 3),
+            "sets_per_s_virtual": round(B / best, 1),
+            "single_dev_at_shard_size_s": round(shard_times[n], 3),
+            "implied_combine_s": round(max(0.0, best - shard_times[n]), 3),
+            "equal_to_single_device": same,
+            "compile_s": round(comp, 1),
+        }
+        results.append(point)
+        print(json.dumps(point), flush=True)
+    print(
+        json.dumps(
+            {
+                "note": (
+                    "virtual CPU mesh: devices share host cores, so wall "
+                    "time does not drop with n; the load-bearing columns "
+                    "are equal_to_single_device and implied_combine_s "
+                    "(flat combine = linear scaling on real chips)"
+                ),
+                "points": len(results),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
