@@ -1,0 +1,115 @@
+"""Attestation simulator service.
+
+Twin of the reference's attestation simulator (client/src/builder.rs:
+950-953 spawns it; beacon_chain/src/attestation_simulator.rs): every
+slot the node builds the attestation a PERFECT validator attesting right
+now would sign — same head/target/source derivation as the production
+`attestation_data` endpoint — and parks it.  When blocks arrive, each
+included attestation is compared against the parked prediction for its
+slot: hits/misses per vote component (head, target, source) become
+Prometheus counters, so an operator sees "would attestations produced
+from this node's view have been correct and included?" without running
+a single validator.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..utils import Counter, get_logger
+
+log = get_logger("attestation_simulator")
+
+SIM_HEAD_HIT = Counter(
+    "validator_monitor_attestation_simulator_head_attester_hit_total",
+    "Simulated attestations whose head vote matched an included attestation",
+)
+SIM_HEAD_MISS = Counter(
+    "validator_monitor_attestation_simulator_head_attester_miss_total",
+    "Simulated attestations whose head vote matched no included attestation",
+)
+SIM_TARGET_HIT = Counter(
+    "validator_monitor_attestation_simulator_target_attester_hit_total",
+    "Simulated attestations whose target vote matched",
+)
+SIM_TARGET_MISS = Counter(
+    "validator_monitor_attestation_simulator_target_attester_miss_total",
+    "Simulated attestations whose target vote matched nothing included",
+)
+SIM_SOURCE_HIT = Counter(
+    "validator_monitor_attestation_simulator_source_attester_hit_total",
+    "Simulated attestations whose source vote matched",
+)
+SIM_SOURCE_MISS = Counter(
+    "validator_monitor_attestation_simulator_source_attester_miss_total",
+    "Simulated attestations whose source vote matched nothing included",
+)
+
+
+class AttestationSimulator:
+    """Parks one simulated AttestationData per slot; scores it against
+    the attestations later included in blocks."""
+
+    def __init__(self, chain, capacity: int = 64):
+        self.chain = chain
+        self.capacity = capacity
+        # slot -> (data, scored_components set)
+        self._parked: OrderedDict[int, tuple[object, set]] = OrderedDict()
+        self.hits = {"head": 0, "target": 0, "source": 0}
+        self.misses = {"head": 0, "target": 0, "source": 0}
+
+    def on_slot(self, slot: int) -> None:
+        """Produce the ideal attestation for ``slot`` from the chain's
+        CURRENT view.  Must run AFTER the slot's block import (the
+        reference runs a third into the slot) — a prediction made before
+        the block arrives votes the parent head and reads as a false
+        miss.  Predictions older than the inclusion window finalize as
+        misses HERE, so the counters are timely (one epoch), not
+        capacity-lagged."""
+        data = self.chain.attestation_data_for(slot, 0)
+        self._parked[slot] = (data, set())
+        window = self.chain.preset.slots_per_epoch
+        for old_slot in [
+            s for s in self._parked if s < slot - window
+        ]:
+            _, scored = self._parked.pop(old_slot)
+            self._finalize(scored)
+        while len(self._parked) > self.capacity:
+            _, (_, scored) = self._parked.popitem(last=False)
+            self._finalize(scored)
+
+    def _finalize(self, scored: set) -> None:
+        """Anything unmatched when a prediction expires is a miss."""
+        for component, ctr in (
+            ("head", SIM_HEAD_MISS),
+            ("target", SIM_TARGET_MISS),
+            ("source", SIM_SOURCE_MISS),
+        ):
+            if component not in scored:
+                ctr.inc()
+                self.misses[component] += 1
+
+    def on_block(self, block) -> None:
+        """Score parked predictions against the block's attestations."""
+        for att in block.body.attestations:
+            parked = self._parked.get(int(att.data.slot))
+            if parked is None:
+                continue
+            sim, scored = parked
+            checks = (
+                ("head", bytes(att.data.beacon_block_root)
+                 == bytes(sim.beacon_block_root), SIM_HEAD_HIT),
+                ("target", bytes(att.data.target.root)
+                 == bytes(sim.target.root)
+                 and int(att.data.target.epoch) == int(sim.target.epoch),
+                 SIM_TARGET_HIT),
+                ("source", att.data.source == sim.source, SIM_SOURCE_HIT),
+            )
+            for component, matched, ctr in checks:
+                if matched and component not in scored:
+                    scored.add(component)
+                    ctr.inc()
+                    self.hits[component] += 1
+
+    def summary(self) -> dict:
+        return {"hits": dict(self.hits), "misses": dict(self.misses)}

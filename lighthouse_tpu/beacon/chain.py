@@ -136,6 +136,9 @@ class BeaconChain:
         # an Eth1Service, normally fed by an Eth1PollingService over the
         # EL's eth_ namespace; production then packs its eth1-data vote
         self.eth1 = None
+        # attestation simulator (attestation_simulator.rs; wired by the
+        # node's slot timer — None = off)
+        self.attestation_simulator = None
         # deneb data availability (beacon_chain.rs:486 data_availability_checker)
         from .blobs import DataAvailabilityChecker
 
@@ -205,6 +208,34 @@ class BeaconChain:
 
     def state_for_block(self, block_root: bytes):
         return self._states.get(block_root)
+
+    def attestation_data_for(self, slot: int, committee_index: int):
+        """The canonical head/target/source attestation template for
+        ``slot`` from this chain's current view — THE one derivation
+        shared by the `/eth/v1/validator/attestation_data` endpoint and
+        the attestation simulator (a drifted copy would turn the
+        simulator's hit/miss metrics into false signals)."""
+        from ..consensus.containers import AttestationData, Checkpoint
+
+        state = self.head_state()
+        preset = self.preset
+        epoch = slot // preset.slots_per_epoch
+        target_slot = epoch * preset.slots_per_epoch
+        if int(state.slot) > target_slot:
+            target_root = bytes(
+                state.block_roots[
+                    target_slot % preset.slots_per_historical_root
+                ]
+            )
+        else:
+            target_root = self.head_root
+        return AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=self.head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
 
     def committee_cache(self, state, epoch: int) -> cm.CommitteeCache:
         key = (bytes(state.genesis_validators_root), epoch)
@@ -425,6 +456,8 @@ class BeaconChain:
                 self.validator_monitor.process_sync_aggregate(
                     block.body.sync_aggregate, sync_committee_indices(state)
                 )
+        if self.attestation_simulator is not None:
+            self.attestation_simulator.on_block(block)
         self.events.emit(
             "block",
             {
@@ -493,6 +526,10 @@ class BeaconChain:
             )
         self._observed_attestations.add(att_key)
         self.op_pool.insert_attestation(attestation)
+        if self.validator_monitor.validators or self.validator_monitor.auto_register:
+            self.validator_monitor.register_gossip_attestation(
+                indexed, int(data.target.epoch)
+            )
         ATTS_PROCESSED.inc()
         self.events.emit(
             "attestation",
@@ -569,6 +606,10 @@ class BeaconChain:
                 int(vi), target_root, int(data.target.epoch), cur
             )
         self.naive_pool.insert(attestation)
+        if self.validator_monitor.validators or self.validator_monitor.auto_register:
+            self.validator_monitor.register_gossip_attestation(
+                indexed, int(data.target.epoch)
+            )
         ATTS_PROCESSED.inc()
 
     # ----------------------------------------------------- sync committee

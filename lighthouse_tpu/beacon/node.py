@@ -622,6 +622,12 @@ class BeaconNode:
                     self.chain.process_block(block)
             with self._chain_lock:
                 self.chain.recompute_head()
+                if self.chain.attestation_simulator is not None:
+                    # AFTER the slot's block import (the reference runs a
+                    # third into the slot): the prediction must see the
+                    # head real attesters vote on, or every head-hit
+                    # reads as a false miss
+                    self.chain.attestation_simulator.on_slot(slot)
             if block is not None:
                 self.publish_block(block)
             self.poll_slasher()

@@ -93,11 +93,14 @@ class BlockTimesCache:
 class MonitoredValidator:
     index: int
     blocks_proposed: int = 0
+    blocks_missed: int = 0
     attestations_included: int = 0
+    attestations_seen_gossip: int = 0
     inclusion_delay_sum: int = 0
     last_attested_epoch: int = -1
     sync_signatures_included: int = 0
     epochs_attested: set = field(default_factory=set)
+    epochs_seen_gossip: set = field(default_factory=set)
 
 
 class ValidatorMonitor:
@@ -151,6 +154,29 @@ class ValidatorMonitor:
                 mv.epochs_attested.add(epoch)
                 MONITORED_ATTESTATIONS.inc()
 
+    def register_gossip_attestation(self, indexed_or_indices, epoch: int) -> None:
+        """Attestation seen ON GOSSIP by monitored validators — the
+        wire-vs-included distinction validator_monitor.rs draws with
+        register_gossip_unaggregated_attestation: a validator whose votes
+        are seen but never included points at packing/propagation, one
+        never even seen points at the validator itself."""
+        indices = getattr(
+            indexed_or_indices, "attesting_indices", indexed_or_indices
+        )
+        for vi in indices:
+            mv = self._get(int(vi))
+            if mv is None:
+                continue
+            mv.attestations_seen_gossip += 1
+            mv.epochs_seen_gossip.add(int(epoch))
+
+    def register_missed_block(self, proposer_index: int) -> None:
+        """A monitored proposer's slot passed without a block
+        (validator_monitor.rs register_missed_block)."""
+        mv = self._get(int(proposer_index))
+        if mv is not None:
+            mv.blocks_missed += 1
+
     def process_sync_aggregate(self, aggregate, committee_indices) -> None:
         for bit, vi in zip(aggregate.sync_committee_bits, committee_indices):
             if not bit:
@@ -172,11 +198,20 @@ class ValidatorMonitor:
             if epoch not in v.epochs_attested
         ]
         total_incl = sum(v.attestations_included for v in self.validators.values())
+        seen_not_included = [
+            v.index
+            for v in self.validators.values()
+            if epoch in v.epochs_seen_gossip
+            and epoch not in v.epochs_attested
+        ]
         return {
             "epoch": epoch,
             "monitored": len(self.validators),
             "attested": hit,
             "missed": missed,
+            # the diagnostic split: votes on the wire that never landed
+            # in a block (packing/propagation) vs never seen at all
+            "seen_gossip_not_included": seen_not_included,
             "avg_inclusion_delay": (
                 sum(v.inclusion_delay_sum for v in self.validators.values())
                 / total_incl
@@ -185,5 +220,11 @@ class ValidatorMonitor:
             ),
             "blocks_proposed": sum(
                 v.blocks_proposed for v in self.validators.values()
+            ),
+            "blocks_missed": sum(
+                v.blocks_missed for v in self.validators.values()
+            ),
+            "sync_signatures": sum(
+                v.sync_signatures_included for v in self.validators.values()
             ),
         }

@@ -461,10 +461,18 @@ def build_light_client_update(
 
 
 def verify_light_client_update(
-    update, committee_pubkeys, spec, genesis_validators_root, T
+    update, committee_pubkeys, spec, genesis_validators_root, T,
+    min_participation_num: int = 2, min_participation_den: int = 3,
 ) -> bool:
     """Signature by the CURRENT committee + the next-committee branch
-    proving into the attested header's state root."""
+    proving into the attested header's state root.  Rotation fuel is the
+    highest-trust artifact a follower consumes — a SUPERMAJORITY of the
+    current committee must back it, or a single compromised signer could
+    hand the follower an attacker-chosen next committee (the spec gates
+    next-committee application the same way)."""
+    bits = [bool(b) for b in update.sync_aggregate.sync_committee_bits]
+    if sum(bits) * min_participation_den < len(bits) * min_participation_num:
+        return False
     if not _verify_sync_aggregate(
         update.attested_header.beacon, update.sync_aggregate,
         committee_pubkeys, spec, genesis_validators_root,

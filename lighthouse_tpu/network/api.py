@@ -392,38 +392,18 @@ class BeaconApiServer:
             )
             return
         if path == "/eth/v1/validator/attestation_data":
-            # the BN-side attestation template (the VC no longer needs the
-            # state: validator/attestation_data in http_api/src/lib.rs) —
-            # same head/target/source derivation as the in-process
-            # AttestationService.
+            # the BN-side attestation template (the VC no longer needs
+            # the state: validator/attestation_data in http_api/src/
+            # lib.rs) — the chain owns the single shared derivation
             from urllib.parse import parse_qs, urlparse
 
-            from ..consensus.containers import AttestationData, Checkpoint
+            from ..consensus.containers import AttestationData
 
             q = parse_qs(urlparse(h.path).query)
             if "slot" not in q or "committee_index" not in q:
                 raise ValueError("slot and committee_index are required")
-            slot = int(q["slot"][0])
-            index = int(q["committee_index"][0])
-            state = chain.head_state()
-            head_root = chain.head_root
-            preset = chain.preset
-            epoch = slot // preset.slots_per_epoch
-            target_slot = epoch * preset.slots_per_epoch
-            if int(state.slot) > target_slot:
-                target_root = bytes(
-                    state.block_roots[
-                        target_slot % preset.slots_per_historical_root
-                    ]
-                )
-            else:
-                target_root = head_root
-            data = AttestationData(
-                slot=slot,
-                index=index,
-                beacon_block_root=head_root,
-                source=state.current_justified_checkpoint,
-                target=Checkpoint(epoch=epoch, root=target_root),
+            data = chain.attestation_data_for(
+                int(q["slot"][0]), int(q["committee_index"][0])
             )
             h._send(200, {"data": to_json(AttestationData, data)})
             return

@@ -334,6 +334,15 @@ def test_full_node_vc_loop_reaches_justification():
     from lighthouse_tpu.validator.remote import run_validator_client
 
     node, _keys = interop_node(n_validators=8)
+    # per-validator inclusion metrics asserted against the soak
+    # (validator_monitor.rs:704 depth + the attestation simulator,
+    # client/src/builder.rs:950)
+    from lighthouse_tpu.beacon.attestation_simulator import (
+        AttestationSimulator,
+    )
+
+    node.chain.validator_monitor.register(*range(8))
+    node.chain.attestation_simulator = AttestationSimulator(node.chain)
     node.start()
     clock = ManualSlotClock(genesis_time=0.0, seconds_per_slot=12)
     per_epoch = node.spec.preset.slots_per_epoch
@@ -367,6 +376,21 @@ def test_full_node_vc_loop_reaches_justification():
         head = node.chain.head_state()
         assert int(head.slot) == target_slot
         assert result.get("published", 0) > 0, f"VC attested over HTTP: {result}"
+        # the monitor saw the VC's votes on gossip AND included in blocks
+        summary = node.chain.validator_monitor.summary(1)
+        assert summary["attested"] >= 6, summary
+        assert summary["blocks_proposed"] >= target_slot - 1, summary
+        per_v = node.chain.validator_monitor.validators
+        assert all(per_v[i].attestations_included > 0 for i in range(8)), {
+            i: per_v[i].attestations_included for i in range(8)
+        }
+        assert all(
+            per_v[i].attestations_seen_gossip > 0 for i in range(8)
+        )
+        # the simulator's ideal votes match what the chain included
+        sim = node.chain.attestation_simulator.summary()
+        assert sim["hits"]["target"] > 0, sim
+        assert sim["hits"]["head"] > 0, sim  # post-import timing holds
         assert int(head.current_justified_checkpoint.epoch) >= 1, (
             "attested chain must justify"
         )

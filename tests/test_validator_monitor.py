@@ -151,3 +151,94 @@ def test_liveness_endpoint_and_doppelganger_poll(rig):
         assert dg.signing_enabled(not_live, epoch=2)  # window passed
     finally:
         node.stop()
+
+
+def test_gossip_seen_vs_included_split():
+    """The diagnostic the reference monitor draws: a vote seen on the
+    wire but never packed points at the chain; one never seen points at
+    the validator (validator_monitor.rs register_gossip_* vs
+    register_attestation_in_block)."""
+    from lighthouse_tpu.beacon.validator_monitor import ValidatorMonitor
+
+    mon = ValidatorMonitor()
+    mon.register(1, 2, 3)
+    mon.register_gossip_attestation([1, 2], epoch=0)
+    # only validator 1's vote gets included
+    mv = mon.validators[1]
+    mv.attestations_included += 1
+    mv.epochs_attested.add(0)
+    s = mon.summary(0)
+    assert s["seen_gossip_not_included"] == [2]
+    assert 3 in s["missed"] and 2 in s["missed"]
+    assert mon.validators[2].attestations_seen_gossip == 1
+
+
+def test_missed_block_tracking():
+    from lighthouse_tpu.beacon.validator_monitor import ValidatorMonitor
+
+    mon = ValidatorMonitor()
+    mon.register(5)
+    mon.register_missed_block(5)
+    mon.register_missed_block(9)  # unmonitored: ignored
+    assert mon.validators[5].blocks_missed == 1
+    assert mon.summary(0)["blocks_missed"] == 1
+
+
+def test_attestation_simulator_scores_chain():
+    """Simulator twin of attestation_simulator.rs: per-slot ideal
+    attestations scored against what blocks actually include."""
+    from lighthouse_tpu.beacon.attestation_simulator import (
+        AttestationSimulator,
+    )
+    from lighthouse_tpu.beacon.chain import BeaconChain
+    from lighthouse_tpu.consensus import spec as S
+    from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+    from lighthouse_tpu.validator.client import (
+        AttestationService,
+        DutiesService,
+        ValidatorStore,
+    )
+    from lighthouse_tpu.validator.slashing_protection import SlashingDatabase
+
+    spec = phase0_spec(S.MINIMAL)
+    state, keys = interop_state(16, spec, fork="altair")
+    chain = BeaconChain(spec, state, None, fork="altair")
+    sim = AttestationSimulator(chain)
+    chain.attestation_simulator = sim
+    store = ValidatorStore(
+        keys={kp[1].to_bytes(): kp[0] for kp in keys},
+        slashing_db=SlashingDatabase(":memory:"),
+        index_by_pubkey={kp[1].to_bytes(): i for i, kp in enumerate(keys)},
+    )
+    duties = DutiesService(chain, store)
+    attester = AttestationService(chain, store, duties)
+    for slot in (1, 2, 3):
+        blk = chain.produce_block(slot, keys)
+        chain.process_block(blk)
+        sim.on_slot(slot)  # predict AT the slot, with the head imported
+        for att in attester.attest(slot):
+            chain.process_unaggregated_attestation(att)
+    # the real votes land in the NEXT block; score them
+    blk = chain.produce_block(4, keys)
+    chain.process_block(blk)
+    s = sim.summary()
+    assert s["hits"]["head"] >= 2, s
+    assert s["hits"]["target"] >= 2, s
+    assert s["hits"]["source"] >= 2, s
+    assert s["misses"]["head"] == 0, s
+    # timely misses: a prediction nothing ever matches finalizes as a
+    # miss once the inclusion window passes — not at capacity eviction
+    from lighthouse_tpu.consensus.containers import (
+        AttestationData,
+        Checkpoint,
+    )
+
+    wrong = AttestationData(
+        slot=4, index=0, beacon_block_root=b"\x77" * 32,
+        source=Checkpoint(), target=Checkpoint(root=b"\x77" * 32),
+    )
+    sim._parked[4] = (wrong, set())
+    sim.on_slot(4 + spec.preset.slots_per_epoch + 1)
+    s2 = sim.summary()
+    assert s2["misses"]["head"] >= 1, s2
+    assert s2["misses"]["target"] >= 1, s2
