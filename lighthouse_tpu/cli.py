@@ -95,6 +95,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--run-secs", type=float, default=None, help="exit after N seconds (tests)"
     )
 
+    watch = sub.add_parser(
+        "watch", help="run the standalone watch analytics service"
+    )
+    watch.add_argument("--beacon-url", required=True)
+    watch.add_argument("--db", default=":memory:")
+    watch.add_argument("--port", type=int, default=0)
+    watch.add_argument("--interval", type=float, default=1.0)
+    watch.add_argument(
+        "--run-secs", type=float, default=None, help="exit after N seconds (tests)"
+    )
+
     sub.add_parser("version")
     return p
 
@@ -342,6 +353,31 @@ def run_boot_node(args) -> int:
     return 0
 
 
+def run_watch(args) -> int:
+    """`lighthouse_tpu watch`: the standalone analytics service following
+    a BN over the Beacon API (the reference's `watch/` process)."""
+    import time
+
+    from .watch import WatchDaemon
+
+    daemon = WatchDaemon(args.beacon_url, db_path=args.db,
+                         http_port=args.port)
+    daemon.start(interval=args.interval)
+    print(f"watch up: http=127.0.0.1:{daemon.port} -> {args.beacon_url}",
+          flush=True)
+    try:
+        if args.run_secs is not None:
+            time.sleep(args.run_secs)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        daemon.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "version":
@@ -357,6 +393,7 @@ def main(argv=None) -> int:
         "lcli": run_lcli,
         "db": run_db,
         "boot-node": run_boot_node,
+        "watch": run_watch,
     }[args.command](args)
 
 

@@ -258,6 +258,37 @@ class BeaconApiServer:
             return
         if path.startswith("/eth/v1/beacon/headers"):
             root = self._resolve_block_root(path.split("/")[-1])
+            if root == chain.genesis_block_root:
+                # the anchor is a header, not a stored SignedBeaconBlock
+                state = chain.state_for_block(root)
+                hdr = state.latest_block_header.copy()
+                if bytes(hdr.state_root) == bytes(32):
+                    hdr.state_root = state.root()
+                h._send(
+                    200,
+                    {
+                        "data": {
+                            "root": "0x" + root.hex(),
+                            "canonical": True,
+                            "header": {
+                                "message": {
+                                    "slot": str(int(hdr.slot)),
+                                    "proposer_index": str(
+                                        int(hdr.proposer_index)
+                                    ),
+                                    "parent_root": "0x"
+                                    + bytes(hdr.parent_root).hex(),
+                                    "state_root": "0x"
+                                    + bytes(hdr.state_root).hex(),
+                                    "body_root": "0x"
+                                    + bytes(hdr.body_root).hex(),
+                                },
+                                "signature": "0x" + "00" * 96,
+                            },
+                        }
+                    },
+                )
+                return
             blk = chain.store.get_block(
                 root, chain.types.SignedBeaconBlock_BY_FORK[chain.fork_name]
             )
@@ -983,6 +1014,20 @@ class BeaconApiServer:
             return self.chain.genesis_block_root
         if block_id.startswith("0x"):
             return bytes.fromhex(block_id[2:])
+        if block_id.isdigit():
+            # slot id: resolved through the head state's block_roots ring
+            # (a skipped slot yields the last block at or before it, the
+            # ring's semantics; consumers dedupe by root)
+            slot = int(block_id)
+            chain = self.chain
+            state = chain.head_state()
+            head_slot = int(state.slot)
+            sphr = chain.preset.slots_per_historical_root
+            if slot == head_slot:
+                return chain.head_root
+            if 0 <= slot < head_slot and head_slot - slot <= sphr:
+                return bytes(state.block_roots[slot % sphr])
+            raise KeyError(f"slot {block_id} outside the historical window")
         raise KeyError(f"unsupported block id {block_id}")
 
     # ----------------------------------------------------------- lifecycle

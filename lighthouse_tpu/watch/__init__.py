@@ -1,0 +1,7 @@
+"""beacon.watch as a standalone, operable service (reference `watch/`,
+6,449 LoC: a separate process polling a BN over the Beacon API into a
+database, serving its own HTTP analytics surface)."""
+
+from .service import WatchDaemon, WatchDatabase
+
+__all__ = ["WatchDaemon", "WatchDatabase"]
