@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     vm_sub = vm.add_subparsers(dest="vm_cmd", required=True)
     create = vm_sub.add_parser("create", help="derive N validator keystores")
+    create.add_argument(
+        "--output-dir", default=None,
+        help="install keystores into <dir>/validators/ with a manifest "
+             "(validator_dir discipline; omit to print JSON)",
+    )
     create.add_argument("--count", type=int, required=True)
     create.add_argument("--wallet-password", required=True)
     create.add_argument("--keystore-password", required=True)
@@ -257,11 +262,18 @@ def run_validator_manager(args) -> int:
 
     seed = bytes.fromhex(args.seed_hex) if args.seed_hex else None
     w = wlt.create_wallet("vm", args.wallet_password, seed=seed)
+    mgr = None
+    if getattr(args, "output_dir", None):
+        from .validator.validator_dir import ValidatorDirManager
+
+        mgr = ValidatorDirManager(args.output_dir)
     out = []
     for _ in range(args.count):
         signing, withdrawal = wlt.next_validator(
             w, args.wallet_password, args.keystore_password
         )
+        if mgr is not None:
+            mgr.create(signing)
         out.append(
             {
                 "voting_pubkey": "0x" + signing["pubkey"],

@@ -364,6 +364,35 @@ def chains_active() -> bool:
     )
 
 
+_MILLER_MODE: bool | None = None
+
+
+def miller_enabled() -> bool:
+    """LIGHTHOUSE_TPU_MILLER=1 routes the Miller loop through the fused
+    per-step Pallas kernels (pallas_miller.py; interpret-proven — flips
+    to default-on once measured on hardware)."""
+    global _MILLER_MODE
+    if _MILLER_MODE is None:
+        import os
+
+        _MILLER_MODE = os.environ.get("LIGHTHOUSE_TPU_MILLER", "") == "1"
+    return _MILLER_MODE
+
+
+def set_miller(enabled: bool) -> None:
+    """In-process A/B toggle (mirrors set_chains)."""
+    global _MILLER_MODE
+    _MILLER_MODE = enabled
+
+
+def miller_fused_active() -> bool:
+    """Gate for the fused Miller-step kernels: pallas on + opted in + a
+    real TPU backend (interpret mode is reached explicitly by tests)."""
+    return (
+        pallas_enabled() and miller_enabled() and jax.default_backend() == "tpu"
+    )
+
+
 def mont_mul(a: LFp, b: LFp) -> LFp:
     """Montgomery product a*b*R^-1 mod P (strict limbs out)."""
     prod = a.bound * b.bound

@@ -100,6 +100,10 @@ def miller_loop(p_aff, q_aff):
     points ((x2c0,x2c1),(y2c0,y2c1)); trailing axes are the batch.  Neither
     input may be infinity (callers enforce this host-side, as the reference
     rejects infinity pubkeys/signatures before pairing)."""
+    if F.miller_fused_active():
+        from . import pallas_miller
+
+        return pallas_miller.miller_loop_fused(p_aff, q_aff)
     def pin(c):
         return F.relabel(F.guard_le(c, 2.0), 2.0)
 

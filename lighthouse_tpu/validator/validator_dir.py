@@ -1,0 +1,200 @@
+"""On-disk validator directory discipline + lockfiles.
+
+Twin of the reference's `common/validator_dir` + `common/lockfile`
+crates and the VC's `validator_definitions.yml` loading
+(validator_client/src/initialized_validators.rs): each validator owns
+`<base>/validators/0x<pubkey>/` holding its EIP-2335 keystore, a
+`definitions.yml`-equivalent manifest enumerates what the VC should
+run, and a LOCKFILE per validator dir stops two processes signing with
+the same key — the classic local double-sign accident the reference
+guards with `.lock` files (stale locks from dead PIDs are reclaimed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..utils.logging import get_logger
+
+log = get_logger("validator_dir")
+
+LOCK_NAME = "voting-keystore.json.lock"
+KEYSTORE_NAME = "voting-keystore.json"
+MANIFEST_NAME = "validator_definitions.json"
+
+
+class LockfileError(RuntimeError):
+    """Another live process holds this validator's lock."""
+
+
+class Lockfile:
+    """flock-held pidfile (common/lockfile): acquisition is ATOMIC in
+    the kernel — no unlink/recreate race window two O_EXCL reclaimers
+    would have — and a crashed holder's lock releases automatically
+    (flock dies with the process), so stale locks never brick keys.
+    The pid inside is diagnostic only.  flock conflicts across open
+    file descriptions, so a second store in the SAME process is also
+    excluded (still a double-sign)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fd: int | None = None
+
+    def acquire(self) -> None:
+        import fcntl
+
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            owner = b"?"
+            try:
+                owner = os.pread(fd, 32, 0).strip() or b"?"
+            except OSError:
+                pass
+            os.close(fd)
+            raise LockfileError(
+                f"{self.path} held by live pid {owner.decode(errors='replace')}"
+            ) from None
+        os.ftruncate(fd, 0)
+        os.pwrite(fd, str(os.getpid()).encode(), 0)
+        self._fd = fd
+
+    def release(self) -> None:
+        if self._fd is not None:
+            import fcntl
+
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class ValidatorDir:
+    """One validator's on-disk home (validator_dir::ValidatorDir)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.lock = Lockfile(os.path.join(path, LOCK_NAME))
+
+    @property
+    def keystore_path(self) -> str:
+        return os.path.join(self.path, KEYSTORE_NAME)
+
+    def read_keystore(self) -> dict:
+        with open(self.keystore_path) as f:
+            return json.load(f)
+
+
+class ValidatorDirManager:
+    """`<base>/validators/` + the definitions manifest
+    (initialized_validators.rs): create dirs from keystores, enumerate
+    enabled definitions, and open (= LOCK) each enabled validator before
+    its keys may sign."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.validators_dir = os.path.join(base, "validators")
+        os.makedirs(self.validators_dir, exist_ok=True)
+        self.manifest_path = os.path.join(
+            self.validators_dir, MANIFEST_NAME
+        )
+
+    # -- creation ----------------------------------------------------------
+
+    def create(self, keystore: dict, enabled: bool = True) -> ValidatorDir:
+        """Install a keystore under 0x<pubkey>/ and register it in the
+        manifest (validator_dir::Builder)."""
+        pubkey = keystore["pubkey"]
+        name = "0x" + pubkey.removeprefix("0x")
+        d = os.path.join(self.validators_dir, name)
+        os.makedirs(d, exist_ok=True)
+        vdir = ValidatorDir(d)
+        with open(vdir.keystore_path, "w") as f:
+            json.dump(keystore, f, indent=2)
+        defs = self._read_manifest()
+        defs = [x for x in defs if x["voting_public_key"] != name]
+        defs.append({
+            "voting_public_key": name,
+            "enabled": enabled,
+            "type": "local_keystore",
+            "voting_keystore_path": vdir.keystore_path,
+        })
+        self._write_manifest(defs)
+        return vdir
+
+    # -- manifest ----------------------------------------------------------
+
+    def _read_manifest(self) -> list[dict]:
+        if not os.path.exists(self.manifest_path):
+            return []
+        with open(self.manifest_path) as f:
+            return json.load(f)
+
+    def _write_manifest(self, defs: list[dict]) -> None:
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(defs, f, indent=2)
+        os.replace(tmp, self.manifest_path)
+
+    def definitions(self) -> list[dict]:
+        return self._read_manifest()
+
+    def set_enabled(self, pubkey: str, enabled: bool) -> None:
+        name = "0x" + pubkey.removeprefix("0x")
+        defs = self._read_manifest()
+        for d in defs:
+            if d["voting_public_key"] == name:
+                d["enabled"] = enabled
+        self._write_manifest(defs)
+
+    # -- opening (locking) -------------------------------------------------
+
+    def open_validator(self, pubkey: str) -> ValidatorDir:
+        """Lock + return one validator dir; raises LockfileError if a
+        live process already holds it."""
+        name = "0x" + pubkey.removeprefix("0x")
+        d = os.path.join(self.validators_dir, name)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(f"no validator dir {d}")
+        vdir = ValidatorDir(d)
+        vdir.lock.acquire()
+        return vdir
+
+    def open_enabled(self) -> list[ValidatorDir]:
+        """Lock every ENABLED definition (the VC boot path); on any
+        conflict, release everything already taken — a half-locked
+        registry must not sign."""
+        out: list[ValidatorDir] = []
+        try:
+            for d in self.definitions():
+                if not d.get("enabled", True):
+                    continue
+                out.append(self.open_validator(d["voting_public_key"]))
+        except LockfileError:
+            for v in out:
+                v.lock.release()
+            raise
+        return out
+
+    def decrypt_enabled(self, password: str):
+        """(pubkey_bytes, SecretKey, ValidatorDir) per enabled validator —
+        locked, decrypted, ready for a ValidatorStore."""
+        from ..crypto import keystore as ks
+        from ..crypto.bls.api import SecretKey
+
+        out = []
+        for vdir in self.open_enabled():
+            store = vdir.read_keystore()
+            sk = SecretKey.from_bytes(ks.decrypt(store, password))
+            out.append(
+                (sk.public_key().to_bytes(), sk, vdir)
+            )
+        return out

@@ -127,6 +127,7 @@ class WatchDaemon:
         self.client = BeaconApiClient(beacon_url)
         self.db = WatchDatabase(db_path)
         self.slots_per_epoch: int | None = None
+        self._sphr: int | None = None
         self._stop = None
         self._thread = None
         outer = self
@@ -163,6 +164,13 @@ class WatchDaemon:
             )
         return self.slots_per_epoch
 
+    def _spec_slots_per_historical_root(self) -> int:
+        if self._sphr is None:
+            self._sphr = int(
+                self.client.spec()["SLOTS_PER_HISTORICAL_ROOT"]
+            )
+        return self._sphr
+
     def poll_once(self) -> int:
         """Record every canonical slot up to the BN's head; returns how
         many new slots landed (updater/src's head-tracking round)."""
@@ -177,6 +185,17 @@ class WatchDaemon:
                 None, None,
             )
         start = self.db.highest_slot() + 1
+        # the BN can only serve slot ids inside its block_roots ring —
+        # pre-window history is unknowable over this API; clamp or a
+        # fresh daemon against an old chain retries slot `start` forever
+        window = self._spec_slots_per_historical_root()
+        floor = max(1, head_slot - window + 1)
+        if start < floor:
+            log.warning(
+                "watch window: slots %d..%d predate the BN's historical "
+                "ring; starting at %d", start, floor - 1, floor,
+            )
+            start = floor
         recorded = 0
         for slot in range(start, head_slot + 1):
             try:
@@ -191,13 +210,19 @@ class WatchDaemon:
             skipped = slot_of_block != slot
             proposer = reward = None
             if not skipped:
+                import urllib.error
+
                 proposer = int(sh["header"]["message"]["proposer_index"])
                 try:
                     reward = int(
                         self.client.block_rewards("0x" + root.hex())["total"]
                     )
-                except Exception:  # noqa: BLE001 — pruned parent state
-                    reward = None
+                except urllib.error.HTTPError as e:
+                    if e.code != 404:
+                        break  # transient: retry the whole slot next round
+                    reward = None  # 404 = pruned state: unknowable forever
+                except Exception:  # noqa: BLE001 — socket-level flap
+                    break
             self.db.record_slot(slot, root, skipped, proposer, reward)
             recorded += 1
         # roll up any epoch that fully landed
