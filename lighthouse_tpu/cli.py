@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="use a built-in network config (boot ENRs + spec)")
     bn.add_argument("--testnet-dir", default=None,
                     help="load config.yaml/boot_enr.yaml from a directory")
+    bn.add_argument("--upnp", action="store_true",
+                    help="attempt UPnP port mapping for p2p/discovery "
+                         "(best-effort; nat.rs analog)")
 
     vc = sub.add_parser("vc", help="run a validator client against a BN")
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
@@ -177,6 +180,29 @@ def run_bn(args) -> int:
             enr=discovery.enr.to_text()[:40] + "...",
             udp_port=discovery.port, table=len(discovery.table),
         )
+    upnp = None
+    if args.upnp:
+        # best-effort (nat.rs posture): a missing/refusing gateway logs
+        # and the node continues unreachable-from-outside.  Maps the
+        # DISCOVERY UDP port (the only p2p socket this mode owns) to the
+        # host's real LAN address — never the unauthenticated HTTP API.
+        from .network.nat import PortMappingService, lan_address
+
+        if discovery is None:
+            log_with(log, logging.WARNING,
+                     "--upnp needs --discovery-port; nothing to map")
+        else:
+            try:
+                upnp = PortMappingService(
+                    lan_address(), tcp_port=None, udp_port=discovery.port
+                )
+                upnp.start()
+                log_with(log, logging.INFO, "UPnP discovery mapping installed",
+                         udp=discovery.port)
+            except Exception as exc:  # noqa: BLE001
+                upnp = None
+                log_with(log, logging.WARNING, "UPnP unavailable",
+                         error=str(exc))
     log_with(
         log, logging.INFO, "Beacon node started",
         spec=args.spec, validators=args.validators,
@@ -201,6 +227,8 @@ def run_bn(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if upnp is not None:
+            upnp.stop()  # delete the WAN mapping; stop the renewals
         if discovery is not None:
             discovery.stop()
         server.stop()

@@ -19,6 +19,7 @@ path is device batches, not packet shuffling.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 import socket
 import threading
 from typing import Callable
@@ -47,6 +48,9 @@ YAMUX_PROTO = "/yamux/1.0.0"
 GOSSIP_PROTO = "/meshsub/1.1.0"
 # eth2 GOSSIP_MAX_SIZE is 10 MiB; one RPC may carry a few messages
 MAX_GOSSIP_RPC_SIZE = 11 * 1024 * 1024
+# v1.2 IDONTWANT: only messages at least this large are worth the
+# control-message round trip (blocks/blobs; never tiny attestations)
+IDONTWANT_THRESHOLD = 16 * 1024
 
 
 class Libp2pError(Exception):
@@ -141,16 +145,21 @@ def encode_gossip_rpc(
 
 
 class GossipControl:
-    """gossipsub v1.1 ControlMessage: ihave/iwant/graft/prune."""
+    """gossipsub ControlMessage: v1.1 ihave/iwant/graft/prune + the v1.2
+    idontwant extension (field 5 — the episub/IDONTWANT work the
+    reference vendors its gossipsub fork for)."""
 
-    def __init__(self, ihave=None, iwant=None, graft=None, prune=None):
+    def __init__(self, ihave=None, iwant=None, graft=None, prune=None,
+                 idontwant=None):
         self.ihave: list[tuple[str, list[bytes]]] = ihave or []
         self.iwant: list[bytes] = iwant or []
         self.graft: list[str] = graft or []
         self.prune: list[str] = prune or []
+        self.idontwant: list[bytes] = idontwant or []
 
     def empty(self) -> bool:
-        return not (self.ihave or self.iwant or self.graft or self.prune)
+        return not (self.ihave or self.iwant or self.graft or self.prune
+                    or self.idontwant)
 
     def encode(self) -> bytes:
         out = b""
@@ -168,6 +177,11 @@ class GossipControl:
             out += _pb_field_bytes(3, _pb_field_bytes(1, topic.encode()))
         for topic in self.prune:
             out += _pb_field_bytes(4, _pb_field_bytes(1, topic.encode()))
+        if self.idontwant:
+            body = b""
+            for mid in self.idontwant:
+                body += _pb_field_bytes(1, mid)
+            out += _pb_field_bytes(5, body)
         return out
 
     @classmethod
@@ -188,6 +202,9 @@ class GossipControl:
         for pr in f.get(4, []):
             g = _pb_parse(pr)
             ctl.prune.append(g.get(1, [b""])[0].decode())
+        for dw in f.get(5, []):
+            g = _pb_parse(dw)
+            ctl.idontwant.extend(g.get(1, []))
         return ctl
 
 
@@ -261,6 +278,9 @@ class Connection:
         self.muxer = muxer
         self.peer_id = noise.remote_peer_id
         self.topics: set[str] = set()  # peer's subscriptions
+        # mids this peer told us NOT to forward to it (v1.2 IDONTWANT);
+        # bounded FIFO — stale entries age out with the seen-cache window
+        self.dont_want: "OrderedDict[bytes, bool]" = OrderedDict()
         self._gossip_out: Stream | None = None
         self._lock = threading.Lock()
         self._gossip_write_lock = threading.Lock()
@@ -671,6 +691,22 @@ class Libp2pHost:
         handler = self.subscriptions.get(topic)
         if handler is None:
             return
+        if len(data) >= IDONTWANT_THRESHOLD:
+            # v1.2: tell mesh peers we have this LARGE message before we
+            # even validate it — duplicates of blocks/blobs are the
+            # bandwidth the extension exists to save.  Pre-mesh
+            # (bootstrap flood mode) every subscriber is a forwarder, so
+            # they are the audience.
+            with self._mesh_lock:
+                mesh = set(self.mesh.get(topic) or ())
+            targets = mesh or {
+                pid for pid, c in self.connections.items()
+                if c.alive and topic in c.topics
+            }
+            for pid in targets:
+                if pid == conn.peer_id:
+                    continue
+                self._send_control(pid, GossipControl(idontwant=[mid]))
         try:
             payload = snappy.decompress_block(data)
         except snappy.SnappyError:
@@ -681,7 +717,7 @@ class Libp2pHost:
             self.received.append((topic, payload))
             self.mcache.put(mid, topic, data)
             self.peer_manager.on_first_delivery(conn.peer_id.hex(), topic)
-            self._forward(topic, data, skip=conn.peer_id)
+            self._forward(topic, data, skip=conn.peer_id, mid=mid)
         elif outcome == "reject":
             # per-topic invalid delivery: the squared penalty is what makes
             # repeat offenders fall past the ban threshold
@@ -706,6 +742,10 @@ class Libp2pHost:
         for topic in ctl.prune:
             with self._mesh_lock:
                 self.mesh.get(topic, set()).discard(conn.peer_id)
+        for mid in ctl.idontwant[:256]:
+            conn.dont_want[mid] = True
+            while len(conn.dont_want) > 1024:
+                conn.dont_want.popitem(last=False)
         wanted = []
         for topic, mids in ctl.ihave:
             if topic not in self.subscriptions:
@@ -762,13 +802,17 @@ class Libp2pHost:
         mid = message_id(topic, compressed)
         self.seen.observe(mid)
         self.mcache.put(mid, topic, compressed)
-        self._forward(topic, compressed, skip=None)
+        self._forward(topic, compressed, skip=None, mid=mid)
         return mid
 
-    def _forward(self, topic: str, compressed: bytes, skip: bytes | None) -> None:
+    def _forward(self, topic: str, compressed: bytes, skip: bytes | None,
+                 mid: bytes) -> None:
         """Route to the topic mesh (gossipsub); peers outside the mesh
         learn of the message via heartbeat IHAVE + IWANT.  With no mesh
-        formed yet (pre-heartbeat bootstrap), flood all subscribers."""
+        formed yet (pre-heartbeat bootstrap), flood all subscribers.
+        Peers that sent IDONTWANT for ``mid`` are skipped (v1.2; callers
+        always hold the id — rehashing MBs here would double the relay
+        path's hashing cost)."""
         rpc = encode_gossip_rpc(publish=[(topic, compressed)])
         live = {
             pid for pid, c in self.connections.items() if c.alive
@@ -783,4 +827,6 @@ class Libp2pHost:
                 continue
             if mesh and conn.peer_id not in mesh:
                 continue
+            if mid in conn.dont_want:
+                continue  # the peer already has it: save the bandwidth
             conn.send_gossip_rpc(rpc)

@@ -1,0 +1,248 @@
+"""NAT traversal: UPnP IGD port mapping.
+
+Twin of beacon_node/network/src/nat.rs (igd-based UPnP hole punching:
+discover the gateway, read its external IP, install TCP+UDP mappings
+with a renewal half-life).  Implemented from the wire up — SSDP
+M-SEARCH over UDP multicast, device-description XML fetch, and the
+WANIPConnection SOAP actions — so it runs against any spec IGD,
+including the in-repo MockIgdGateway the tests use.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import threading
+import time
+from urllib import request as urlrequest
+
+from ..utils.logging import get_logger
+
+log = get_logger("nat")
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+MAPPING_DURATION = 3600  # seconds a mapping lives on the gateway
+MAPPING_TIMEOUT = MAPPING_DURATION // 2  # renewal half-life (nat.rs)
+
+_ST_IGD = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+_WANIP = "urn:schemas-upnp-org:service:WANIPConnection:1"
+
+
+class NatError(IOError):
+    pass
+
+
+def discover_gateway(timeout: float = 2.0, ssdp_addr=None) -> str:
+    """SSDP M-SEARCH -> the gateway's device-description URL.
+
+    ``ssdp_addr`` overrides the multicast destination (the mock gateway
+    listens on a unicast loopback port; real IGDs on 239.255.255.250)."""
+    dst = ssdp_addr or SSDP_ADDR
+    msg = (
+        "M-SEARCH * HTTP/1.1\r\n"
+        f"HOST: {dst[0]}:{dst[1]}\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        "MX: 2\r\n"
+        f"ST: {_ST_IGD}\r\n\r\n"
+    ).encode()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    try:
+        sock.sendto(msg, dst)
+        data, _ = sock.recvfrom(4096)
+    except socket.timeout:
+        raise NatError("no UPnP gateway answered the M-SEARCH") from None
+    finally:
+        sock.close()
+    m = re.search(rb"(?im)^LOCATION:\s*(\S+)", data)
+    if not m:
+        raise NatError("SSDP response carried no LOCATION header")
+    return m.group(1).decode()
+
+
+def _soap(control_url: str, action: str, args: dict) -> str:
+    body_args = "".join(f"<{k}>{v}</{k}>" for k, v in args.items())
+    envelope = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f'<s:Body><u:{action} xmlns:u="{_WANIP}">{body_args}</u:{action}>'
+        "</s:Body></s:Envelope>"
+    ).encode()
+    req = urlrequest.Request(
+        control_url,
+        data=envelope,
+        headers={
+            "Content-Type": 'text/xml; charset="utf-8"',
+            "SOAPAction": f'"{_WANIP}#{action}"',
+        },
+        method="POST",
+    )
+    with urlrequest.urlopen(req, timeout=5) as resp:
+        return resp.read().decode()
+
+
+class Gateway:
+    """A discovered IGD: external-IP query + port-mapping actions."""
+
+    def __init__(self, description_url: str):
+        self.description_url = description_url
+        with urlrequest.urlopen(description_url, timeout=5) as resp:
+            desc = resp.read().decode()
+        m = re.search(
+            rf"<serviceType>{re.escape(_WANIP)}</serviceType>.*?"
+            r"<controlURL>([^<]+)</controlURL>",
+            desc,
+            re.S,
+        )
+        if not m:
+            raise NatError("gateway exposes no WANIPConnection service")
+        control = m.group(1)
+        if control.startswith("/"):
+            base = re.match(r"(https?://[^/]+)", description_url).group(1)
+            control = base + control
+        self.control_url = control
+
+    def external_ip(self) -> str:
+        out = _soap(self.control_url, "GetExternalIPAddress", {})
+        m = re.search(r"<NewExternalIPAddress>([^<]+)<", out)
+        if not m:
+            raise NatError("gateway returned no external IP")
+        return m.group(1)
+
+    def add_port_mapping(
+        self, protocol: str, external_port: int, internal_port: int,
+        internal_client: str, description: str,
+        duration: int = MAPPING_DURATION,
+    ) -> None:
+        _soap(
+            self.control_url, "AddPortMapping",
+            {
+                "NewRemoteHost": "",
+                "NewExternalPort": external_port,
+                "NewProtocol": protocol,
+                "NewInternalPort": internal_port,
+                "NewInternalClient": internal_client,
+                "NewEnabled": 1,
+                "NewPortMappingDescription": description,
+                "NewLeaseDuration": duration,
+            },
+        )
+
+    def delete_port_mapping(self, protocol: str, external_port: int) -> None:
+        _soap(
+            self.control_url, "DeletePortMapping",
+            {
+                "NewRemoteHost": "",
+                "NewExternalPort": external_port,
+                "NewProtocol": protocol,
+            },
+        )
+
+
+def lan_address() -> str:
+    """The host's own LAN-facing address — what NewInternalClient must
+    carry (a 0.0.0.0 placeholder maps to nowhere on a real IGD).  A UDP
+    connect() selects the route's source address without sending a
+    single packet."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.254.254.254", 1))  # unroutable is fine: no traffic
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def construct_upnp_mappings(
+    addr: str, tcp_port: int | None = None, udp_port: int | None = None,
+    ssdp_addr=None,
+) -> Gateway:
+    """nat.rs construct_upnp_mappings: discover, sanity-check the
+    external address (a private one means a double NAT — mapping is
+    useless), then install the requested TCP (libp2p) and/or UDP
+    (discovery) mappings."""
+    gw = Gateway(discover_gateway(ssdp_addr=ssdp_addr))
+    external = gw.external_ip()
+    first_octet = int(external.split(".")[0])
+    second = int(external.split(".")[1])
+    if (
+        first_octet == 10
+        or (first_octet == 172 and 16 <= second <= 31)
+        or (first_octet == 192 and second == 168)
+    ):
+        raise NatError(
+            f"gateway's external address {external} is itself private "
+            "(double NAT): UPnP mapping would not make this node reachable"
+        )
+    if tcp_port is not None:
+        gw.add_port_mapping(
+            "TCP", tcp_port, tcp_port, addr, "lighthouse-tpu-p2p"
+        )
+        log.info("UPnP: mapped TCP %d via %s (external %s)",
+                 tcp_port, gw.control_url, external)
+    if udp_port is not None:
+        gw.add_port_mapping(
+            "UDP", udp_port, udp_port, addr, "lighthouse-tpu-discovery"
+        )
+        log.info("UPnP: mapped UDP %d", udp_port)
+    return gw
+
+
+class PortMappingService:
+    """Keep mappings alive: renew every MAPPING_TIMEOUT (half the lease,
+    the nat.rs cadence); drop them on stop."""
+
+    def __init__(self, addr: str, tcp_port: int | None = None,
+                 udp_port: int | None = None, ssdp_addr=None):
+        self.addr = addr
+        self.tcp_port = tcp_port
+        self.udp_port = udp_port
+        self.ssdp_addr = ssdp_addr
+        self.gateway: Gateway | None = None
+        self.renewals = 0
+        self._stop = None
+        self._thread = None
+
+    def start(self, renew_interval: float | None = None) -> None:
+        self.gateway = construct_upnp_mappings(
+            self.addr, self.tcp_port, self.udp_port, ssdp_addr=self.ssdp_addr
+        )
+        self._stop = threading.Event()
+        interval = renew_interval or MAPPING_TIMEOUT
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    if self.tcp_port is not None:
+                        self.gateway.add_port_mapping(
+                            "TCP", self.tcp_port, self.tcp_port, self.addr,
+                            "lighthouse-tpu-p2p",
+                        )
+                    if self.udp_port is not None:
+                        self.gateway.add_port_mapping(
+                            "UDP", self.udp_port, self.udp_port, self.addr,
+                            "lighthouse-tpu-discovery",
+                        )
+                    self.renewals += 1
+                except Exception as exc:  # noqa: BLE001 — gateway flap
+                    log.warning("UPnP renewal failed: %s", exc)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="upnp-renew")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.gateway is not None:
+            try:
+                if self.tcp_port is not None:
+                    self.gateway.delete_port_mapping("TCP", self.tcp_port)
+                if self.udp_port is not None:
+                    self.gateway.delete_port_mapping("UDP", self.udp_port)
+            except Exception as exc:  # noqa: BLE001
+                log.debug("UPnP unmap failed: %s", exc)

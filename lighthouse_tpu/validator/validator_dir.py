@@ -178,7 +178,11 @@ class ValidatorDirManager:
                 if not d.get("enabled", True):
                     continue
                 out.append(self.open_validator(d["voting_public_key"]))
-        except LockfileError:
+        except Exception:
+            # ANY failure (lock conflict, missing dir, corrupt keystore
+            # path) rolls back every lock already taken — a half-locked
+            # registry must not sign, and leaked flocks would brick the
+            # process's own retry
             for v in out:
                 v.lock.release()
             raise
@@ -191,10 +195,16 @@ class ValidatorDirManager:
         from ..crypto.bls.api import SecretKey
 
         out = []
-        for vdir in self.open_enabled():
-            store = vdir.read_keystore()
-            sk = SecretKey.from_bytes(ks.decrypt(store, password))
-            out.append(
-                (sk.public_key().to_bytes(), sk, vdir)
-            )
+        opened = self.open_enabled()
+        try:
+            for vdir in opened:
+                store = vdir.read_keystore()
+                sk = SecretKey.from_bytes(ks.decrypt(store, password))
+                out.append((sk.public_key().to_bytes(), sk, vdir))
+        except Exception:
+            # e.g. a wrong password: release every flock so the SAME
+            # process can retry with the right one
+            for vdir in opened:
+                vdir.lock.release()
+            raise
         return out

@@ -128,6 +128,7 @@ class WatchDaemon:
         self.db = WatchDatabase(db_path)
         self.slots_per_epoch: int | None = None
         self._sphr: int | None = None
+        self._reward_attempts: dict[int, int] = {}
         self._stop = None
         self._thread = None
         outer = self
@@ -218,11 +219,13 @@ class WatchDaemon:
                         self.client.block_rewards("0x" + root.hex())["total"]
                     )
                 except urllib.error.HTTPError as e:
-                    if e.code != 404:
+                    if e.code != 404 and self._reward_retry(slot):
                         break  # transient: retry the whole slot next round
-                    reward = None  # 404 = pruned state: unknowable forever
+                    reward = None  # 404/pruned or retries exhausted
                 except Exception:  # noqa: BLE001 — socket-level flap
-                    break
+                    if self._reward_retry(slot):
+                        break
+                    reward = None
             self.db.record_slot(slot, root, skipped, proposer, reward)
             recorded += 1
         # roll up any epoch that fully landed
@@ -232,6 +235,23 @@ class WatchDaemon:
         for epoch in range(max(0, start // spe), head_slot // spe + 1):
             self._summarize_epoch(epoch, spe)
         return recorded
+
+    REWARD_RETRIES = 3
+
+    def _reward_retry(self, slot: int) -> bool:
+        """True while the slot's reward fetch deserves another round; a
+        deterministic server-side failure must not wedge the walk
+        forever, so after REWARD_RETRIES the slot records reward=None."""
+        n = self._reward_attempts.get(slot, 0) + 1
+        self._reward_attempts[slot] = n
+        if n >= self.REWARD_RETRIES:
+            self._reward_attempts.pop(slot, None)
+            log.warning(
+                "slot %d rewards failed %d times; recording as unknown",
+                slot, n,
+            )
+            return False
+        return True
 
     def _summarize_epoch(self, epoch: int, spe: int) -> None:
         blocks = skipped = rewards = 0

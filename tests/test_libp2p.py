@@ -347,3 +347,83 @@ class TestHost:
         code2, _ = conn.request("goodbye", b"\x00" * 8)
         assert code1 == rpc_mod.SUCCESS
         assert code2 == rpc_mod.RESOURCE_UNAVAILABLE
+
+
+class TestIDontWant:
+    """gossipsub v1.2 IDONTWANT (the extension the reference vendors its
+    gossipsub fork for): honored on forward, emitted on large receive."""
+
+    def test_idontwant_suppresses_forward(self, hosts):
+        from lighthouse_tpu.network.libp2p import (
+            GossipControl,
+            message_id,
+            snappy,
+        )
+
+        a, b, _c = hosts
+        got_b = []
+        a.subscribe(TOPIC, lambda p, pid: "accept")
+        b.subscribe(TOPIC, lambda p, pid: (got_b.append(p), "accept")[1])
+        a.dial("127.0.0.1", b.port)
+        time.sleep(0.3)
+        payload = b"\x42" * 100
+        compressed = snappy.compress_block(payload)
+        mid = message_id(TOPIC, compressed)
+        # B declares it already has the message
+        b_conn_to_a = next(iter(b.connections.values()))
+        b_conn_to_a.send_gossip_rpc(
+            __import__(
+                "lighthouse_tpu.network.libp2p", fromlist=["encode_gossip_rpc"]
+            ).encode_gossip_rpc(control=GossipControl(idontwant=[mid]))
+        )
+        deadline = time.time() + 5
+        a_conn = next(iter(a.connections.values()))
+        while time.time() < deadline and mid not in a_conn.dont_want:
+            time.sleep(0.05)
+        assert mid in a_conn.dont_want
+        a.publish(TOPIC, payload)
+        time.sleep(1.0)
+        assert got_b == [], "suppressed: B never received the publish"
+        # a DIFFERENT message still flows
+        a.publish(TOPIC, b"\x43" * 100)
+        deadline = time.time() + 5
+        while time.time() < deadline and not got_b:
+            time.sleep(0.05)
+        assert got_b == [b"\x43" * 100]
+
+    def test_large_message_emits_idontwant(self, hosts):
+        from lighthouse_tpu.network.libp2p import (
+            IDONTWANT_THRESHOLD,
+            message_id,
+            snappy,
+        )
+
+        a, b, c = hosts
+        for h in hosts:
+            h.subscribe(TOPIC, lambda p, pid: "accept")
+        a.dial("127.0.0.1", b.port)
+        conn_bc = b.dial("127.0.0.1", c.port)
+        time.sleep(0.5)  # let subscription RPCs propagate first
+        for h in hosts:
+            h.heartbeat()  # then form meshes deterministically
+        time.sleep(0.3)
+        import os as _os
+
+        payload = _os.urandom(IDONTWANT_THRESHOLD + 512)  # incompressible:
+        # the threshold applies to the WIRE (compressed) size
+        compressed = snappy.compress_block(payload)
+        mid = message_id(TOPIC, compressed)
+        a.publish(TOPIC, payload)
+        # B (the relayer) receives the big message from A and announces
+        # IDONTWANT to its OTHER mesh peers — C records it on its
+        # connection (the sender itself is never told: it obviously has
+        # the message, which is also why C, whose only mesh peer IS the
+        # sender, emits nothing)
+        deadline = time.time() + 8
+        seen = False
+        while time.time() < deadline and not seen:
+            seen = any(
+                mid in conn.dont_want for conn in c.connections.values()
+            )
+            time.sleep(0.05)
+        assert seen, "C recorded B's IDONTWANT for the large message"

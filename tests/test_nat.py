@@ -1,0 +1,173 @@
+"""UPnP NAT traversal (VERDICT r4 Missing #8) against an in-repo mock
+IGD: SSDP discovery, device description, WANIPConnection SOAP actions,
+double-NAT refusal, renewal cadence — beacon_node/network/src/nat.rs."""
+
+import re
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from lighthouse_tpu.network.nat import (
+    Gateway,
+    NatError,
+    PortMappingService,
+    construct_upnp_mappings,
+    discover_gateway,
+)
+
+
+class MockIgdGateway:
+    """Spec-shaped IGD double: a UDP SSDP responder + an HTTP server
+    serving the device description and the WANIPConnection control URL."""
+
+    def __init__(self, external_ip="203.0.113.7"):
+        self.external_ip = external_ip
+        self.mappings = {}  # (proto, ext_port) -> (int_port, client, desc)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                desc = f"""<?xml version="1.0"?>
+<root><device><serviceList><service>
+<serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+<controlURL>/ctl</controlURL>
+</service></serviceList></device></root>"""
+                body = desc.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/xml")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode()
+                action = self.headers.get("SOAPAction", "")
+                if "GetExternalIPAddress" in action:
+                    resp = (
+                        "<NewExternalIPAddress>"
+                        f"{outer.external_ip}</NewExternalIPAddress>"
+                    )
+                elif "AddPortMapping" in action:
+                    proto = re.search(r"<NewProtocol>(\w+)<", body).group(1)
+                    ext = int(re.search(r"<NewExternalPort>(\d+)<", body).group(1))
+                    internal = int(
+                        re.search(r"<NewInternalPort>(\d+)<", body).group(1)
+                    )
+                    client = re.search(
+                        r"<NewInternalClient>([^<]+)<", body
+                    ).group(1)
+                    outer.mappings[(proto, ext)] = (internal, client)
+                    resp = ""
+                elif "DeletePortMapping" in action:
+                    proto = re.search(r"<NewProtocol>(\w+)<", body).group(1)
+                    ext = int(re.search(r"<NewExternalPort>(\d+)<", body).group(1))
+                    outer.mappings.pop((proto, ext), None)
+                    resp = ""
+                else:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                envelope = (
+                    '<?xml version="1.0"?><s:Envelope><s:Body>'
+                    f"{resp}</s:Body></s:Envelope>"
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/xml")
+                self.end_headers()
+                self.wfile.write(envelope)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.httpd.server_address[1]
+        # SSDP responder on a unicast loopback UDP port
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.ssdp_port = self.udp.getsockname()[1]
+        self._threads = []
+
+    @property
+    def ssdp_addr(self):
+        return ("127.0.0.1", self.ssdp_port)
+
+    def start(self):
+        t1 = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t1.start()
+
+        def ssdp_loop():
+            while True:
+                try:
+                    data, src = self.udp.recvfrom(2048)
+                except OSError:
+                    return
+                if b"M-SEARCH" in data:
+                    resp = (
+                        "HTTP/1.1 200 OK\r\n"
+                        "ST: urn:schemas-upnp-org:device:"
+                        "InternetGatewayDevice:1\r\n"
+                        f"LOCATION: http://127.0.0.1:{self.http_port}/desc\r\n"
+                        "\r\n"
+                    ).encode()
+                    self.udp.sendto(resp, src)
+
+        t2 = threading.Thread(target=ssdp_loop, daemon=True)
+        t2.start()
+        self._threads = [t1, t2]
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.udp.close()
+
+
+@pytest.fixture()
+def igd():
+    gw = MockIgdGateway()
+    gw.start()
+    yield gw
+    gw.stop()
+
+
+def test_discovery_and_mapping_roundtrip(igd):
+    gw = construct_upnp_mappings(
+        "192.168.1.5", 9000, udp_port=9001, ssdp_addr=igd.ssdp_addr
+    )
+    assert gw.external_ip() == "203.0.113.7"
+    assert igd.mappings[("TCP", 9000)] == (9000, "192.168.1.5")
+    assert igd.mappings[("UDP", 9001)] == (9001, "192.168.1.5")
+    gw.delete_port_mapping("TCP", 9000)
+    assert ("TCP", 9000) not in igd.mappings
+
+
+def test_double_nat_refused(igd):
+    igd.external_ip = "192.168.50.1"  # gateway is itself behind NAT
+    with pytest.raises(NatError, match="double NAT"):
+        construct_upnp_mappings("192.168.1.5", 9000, ssdp_addr=igd.ssdp_addr)
+    assert not igd.mappings, "no mapping installed on refusal"
+
+
+def test_no_gateway_times_out():
+    with pytest.raises(NatError, match="no UPnP gateway"):
+        # a bound-but-silent UDP port: discovery must time out cleanly
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        try:
+            discover_gateway(timeout=0.5, ssdp_addr=s.getsockname())
+        finally:
+            s.close()
+
+
+def test_renewal_service_keeps_mappings_alive(igd):
+    svc = PortMappingService(
+        "192.168.1.9", 9100, udp_port=9101, ssdp_addr=igd.ssdp_addr
+    )
+    svc.start(renew_interval=0.2)
+    time.sleep(0.7)
+    assert svc.renewals >= 2, "renewal cadence ran"
+    svc.stop()
+    assert ("TCP", 9100) not in igd.mappings, "unmapped on shutdown"
+    assert ("UDP", 9101) not in igd.mappings
