@@ -147,6 +147,7 @@ def run_measurement(force_cpu: bool) -> None:
         "device_h2c": device_h2c,
         "kernel": "pallas" if _fp.pallas_enabled() else "scan",
         "chains": _fp.chains_active(),
+        "miller_fused": _fp.miller_fused_active(),
     }
     if "TPU" in str(dev):
         _record_tpu_history(result)

@@ -23,6 +23,16 @@ interpret mode.
 Gated behind LIGHTHOUSE_TPU_MILLER=1 (fp.miller_fused_active) until the
 on-chip A/B lands, mirroring the chain kernels.
 
+Cost model (measured r5): each kernel holds ~160 unrolled Montgomery
+multiplies, so host-side TRACING of one kernel is minutes-scale on a
+single CPU core (the jaxpr is ~10^5 primitives), and the interpret-mode
+equality proof runs it eagerly (tests/test_pallas_miller.py; the
+ONE-jit-around-everything variant takes >45 min to XLA-compile and is
+slow-marked).  On real hardware the trace happens once per batch shape
+at node startup — alongside the existing 120-400 s Mosaic compiles —
+and is amortized by the persistent compile cache across restarts; the
+per-step dispatch saving is what the serving path keeps.
+
 Capability twin: the Miller loop of blst's
 verify_multiple_aggregate_signatures (crypto/bls/src/impls/blst.rs:
 107-117); the fusion itself is TPU-original.

@@ -71,7 +71,8 @@ def _run_child(
 
 
 def run_bench_child(
-    batch: int, chains: bool, device_h2c: bool = False, timeout: float = 4000
+    batch: int, chains: bool, device_h2c: bool = False,
+    miller: bool = False, timeout: float = 4000,
 ) -> dict | None:
     env = dict(os.environ)
     env["BENCH_CHILD"] = "tpu"
@@ -80,10 +81,12 @@ def run_bench_child(
     env["BENCH_INIT_TIMEOUT"] = "300"
     env["BENCH_COMPILE_TIMEOUT"] = str(timeout - 300)
     env["LIGHTHOUSE_TPU_CHAINS"] = "1" if chains else "0"
+    env["LIGHTHOUSE_TPU_MILLER"] = "1" if miller else "0"
     env["BENCH_DEVICE_H2C"] = "1" if device_h2c else ""
     return _run_child(
         [sys.executable, os.path.join(ROOT, "bench.py")],
-        f"verify B={batch} chains={int(chains)} h2c={int(device_h2c)}",
+        f"verify B={batch} chains={int(chains)} miller={int(miller)} "
+        f"h2c={int(device_h2c)}",
         env,
         timeout,
     )
@@ -123,9 +126,28 @@ def main() -> None:
         }
     )
 
-    r4096 = run_bench_child(4096, chains=chains_best, timeout=5500)
+    # the fused Miller-step kernels: the biggest single-chip lever
+    # (dispatch-bound at B>=4096) — one generous-timeout shot; Mosaic
+    # compiles of the two ~160-mul kernels are the unknown
+    mil = run_bench_child(512, chains=chains_best, miller=True, timeout=7000)
+    miller_best = ok(mil) and mil["value"] > max(
+        base.get("value", 0), (ab or {}).get("value", 0)
+    )
+    log(
+        {
+            "stage": "miller verdict",
+            "miller_on": (mil or {}).get("value"),
+            "miller_win": miller_best,
+        }
+    )
+
+    r4096 = run_bench_child(
+        4096, chains=chains_best, miller=miller_best, timeout=7000
+    )
     if ok(r4096):
-        run_bench_child(8192, chains=chains_best, timeout=5500)
+        run_bench_child(
+            8192, chains=chains_best, miller=miller_best, timeout=7000
+        )
 
     run_epoch_bench()
 
