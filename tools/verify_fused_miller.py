@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Standalone fused-Miller equality proof (invoked by
+tests/test_pallas_miller.py in a SUBPROCESS: the eager proof is stable
+in a fresh interpreter but segfaults inside a long pytest process that
+already ran ~80 JAX compiles — an XLA:CPU process-state crash, not a
+kernel bug; isolation sidesteps it and matches how the kernels run in
+production anyway: one process, one trace).
+
+Checks dbl half + add half (both bit arms, chained on live outputs)
+against the XLA formulas, canonical equality on every lane."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import __graft_entry__ as graft  # noqa: E402
+
+graft._enable_compile_cache(jax)
+
+from lighthouse_tpu.crypto.bls import params  # noqa: E402
+from lighthouse_tpu.crypto.bls.curve import (  # noqa: E402
+    Fp,
+    Fp2,
+    G1_GENERATOR,
+    G2_GENERATOR,
+    affine_mul,
+)
+from lighthouse_tpu.crypto.bls.jax_backend import fp as F  # noqa: E402
+from lighthouse_tpu.crypto.bls.jax_backend import pairing as JP  # noqa: E402
+from lighthouse_tpu.crypto.bls.jax_backend import (  # noqa: E402
+    pallas_miller as PM,
+)
+from lighthouse_tpu.crypto.bls.jax_backend import points as P  # noqa: E402
+from lighthouse_tpu.crypto.bls.jax_backend import tower as T  # noqa: E402
+
+
+def build_fixture():
+    """Shared inputs + reference values for the equality proofs (used by
+    main() below AND tests/test_pallas_miller.py — ONE copy of the lane
+    layout, so a kernel-signature change cannot desynchronize them)."""
+    pairs = [
+        (affine_mul(G1_GENERATOR, 20250730, Fp),
+         affine_mul(G2_GENERATOR, 424242, Fp2)),
+        (affine_mul(G1_GENERATOR, 31337, Fp),
+         affine_mul(G2_GENERATOR, 987654321, Fp2)),
+    ]
+    p_aff = P.g1_encode([p for p, _ in pairs])
+    q_aff = P.g2_encode([q for _, q in pairs])
+
+    def pin(c):
+        return F.relabel(F.guard_le(c, 2.0), 2.0)
+
+    xp, yp = pin(p_aff[0]), pin(p_aff[1])
+    q0 = (pin(q_aff[0][0]), pin(q_aff[0][1]))
+    q1 = (pin(q_aff[1][0]), pin(q_aff[1][1]))
+    one2 = tuple(F.relabel(c, 2.0) for c in T.fp2_one_like(q0))
+    zero = F.zero_like(xp)
+    f = (
+        (one2, (zero, zero), (zero, zero)),
+        ((zero, zero), (zero, zero), (zero, zero)),
+    )
+    Tpt = (q0, q1, one2)
+
+    # XLA halves (eager)
+    line, T2 = JP._line_dbl(Tpt, xp, yp)
+    ref_f_mid = T.fp12_mul_by_023(T.fp12_sqr(f), *line)
+    ref_T_mid = T2
+
+    def xla_add(fv, Tv, take):
+        line_a, T_add = JP._line_add(Tv, (q0, q1), xp, yp)
+        f_a = T.fp12_mul_by_023(fv, *line_a)
+        return (f_a if take else fv), (T_add if take else Tv)
+
+    ref_f1, ref_T1 = xla_add(ref_f_mid, ref_T_mid, True)
+    ref_f0, ref_T0 = xla_add(ref_f_mid, ref_T_mid, False)
+
+    def flat(x):
+        return x.limbs.reshape(F.N, -1)
+
+    n = flat(xp).shape[-1]
+    tile = max(128, -(-n // 128) * 128)
+    all_in, n0, n_padded = PM._pad_flat(
+        [flat(v) for v in PM._f12_lanes(f)]
+        + [flat(c) for pt in Tpt for c in pt]
+        + [flat(q0[0]), flat(q0[1]), flat(q1[0]), flat(q1[1])]
+        + [flat(xp), flat(yp)],
+        tile,
+    )
+    f_arr = all_in[:12]
+    T_arr = all_in[12:18]
+    q_arr = all_in[18:22]
+    xp_a, yp_a = all_in[22], all_in[23]
+    consts = PM._const_arrays(tile)
+    return {
+        "f_arr": f_arr, "T_arr": T_arr, "q_arr": q_arr,
+        "xp_a": xp_a, "yp_a": yp_a, "consts": consts,
+        "n0": n0, "n_padded": n_padded, "tile": tile,
+        "batch": xp.limbs.shape[1:],
+        "ref_f_mid": ref_f_mid, "ref_T_mid": ref_T_mid,
+        "ref_f1": ref_f1, "ref_T1": ref_T1,
+        "ref_f0": ref_f0, "ref_T0": ref_T0,
+    }
+
+
+def canon(lfp):
+    return np.asarray(F.fp_canon(lfp))
+
+
+def unflat(a, n0, batch):
+    import jax.numpy as jnp
+
+    return F.LFp(jnp.asarray(a)[:, :n0].reshape((F.N,) + batch), 2.0)
+
+
+def check_lanes(tag, ref_f, ref_T, outs, n0, batch):
+    for i, (r, g) in enumerate(
+        zip([canon(v) for v in PM._f12_lanes(ref_f)],
+            [canon(unflat(a, n0, batch)) for a in outs[:12]])
+    ):
+        assert np.array_equal(r, g), f"{tag}: f lane {i} diverges"
+    ref_T_lanes = [canon(c) for pt in ref_T for c in pt]
+    for i, (r, g) in enumerate(
+        zip(ref_T_lanes, [canon(unflat(a, n0, batch)) for a in outs[12:]])
+    ):
+        assert np.array_equal(r, g), f"{tag}: T lane {i} diverges"
+
+
+def main() -> None:
+    fx = build_fixture()
+    f_arr, T_arr, q_arr = fx["f_arr"], fx["T_arr"], fx["q_arr"]
+    xp_a, yp_a, consts = fx["xp_a"], fx["yp_a"], fx["consts"]
+    n_padded, tile = fx["n_padded"], fx["tile"]
+    dbl = PM._dbl_call(n_padded, tile, True)
+    add = PM._add_call(n_padded, tile, True)
+
+    mid = dbl(*f_arr, *T_arr, xp_a, yp_a, *consts)
+
+    def run_add(bit):
+        import jax.numpy as jnp
+
+        bit_row = jnp.full((1, n_padded), bit, dtype=jnp.uint32)
+        return add(*list(mid[:12]), *list(mid[12:]), *q_arr, xp_a, yp_a,
+                   bit_row, *consts)
+
+    out1 = run_add(1)
+    out0 = run_add(0)
+
+    n0, batch = fx["n0"], fx["batch"]
+    check_lanes("dbl", fx["ref_f_mid"], fx["ref_T_mid"], mid, n0, batch)
+    check_lanes("add/bit=1", fx["ref_f1"], fx["ref_T1"], out1, n0, batch)
+    check_lanes("add/bit=0", fx["ref_f0"], fx["ref_T0"], out0, n0, batch)
+    print("fused-miller halves OK")
+
+
+if __name__ == "__main__":
+    main()
