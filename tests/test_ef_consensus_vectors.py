@@ -181,3 +181,44 @@ def test_tree_has_expected_breadth():
     assert len(_cases("epoch_processing")) >= 20
     assert len(_cases("sanity")) >= 8
     assert len(_cases("shuffling")) >= 10
+
+
+from test_ssz_fuzz import CASES as _SSZ_CASES  # noqa: E402 — pytest
+# prepend mode puts tests/ on sys.path (no tests/__init__.py)
+
+
+def _ssz_static_cases():
+    base = os.path.join(os.path.dirname(__file__), "vectors", "consensus",
+                        "ssz_static")
+    if not os.path.isdir(base):
+        return []
+    return sorted(os.listdir(base))
+
+
+def test_ssz_static_family_present():
+    """The pinned-format guarantee must not silently vanish: a missing
+    or partial vectors dir collects zero parametrized cases and the
+    suite would stay green without this gate."""
+    assert len(_ssz_static_cases()) >= 80, (
+        "ssz_static vectors missing — run tools/gen_ssz_static_vectors.py"
+    )
+
+
+@pytest.mark.parametrize("name", _ssz_static_cases())
+def test_ssz_static(name):
+    """ssz_static family (testing/ef_tests src/cases/ssz_static.rs): the
+    pinned bytes + hash_tree_root for one container variant.  The fuzz
+    suite proves symmetry; this pins the absolute format."""
+    assert name in _SSZ_CASES, (
+        f"stale vector dir {name}: container renamed/removed — regenerate"
+    )
+    cls = _SSZ_CASES[name]
+    d = os.path.join(os.path.dirname(__file__), "vectors", "consensus",
+                     "ssz_static", name, "case_0")
+    with open(os.path.join(d, "serialized.ssz_snappy"), "rb") as f:
+        blob = decompress_framed(f.read())
+    with open(os.path.join(d, "roots.json")) as f:
+        want_root = bytes.fromhex(json.load(f)["root"].removeprefix("0x"))
+    inst = cls.deserialize_value(blob)
+    assert inst.encode() == blob, f"{name}: re-encode diverges from pinned bytes"
+    assert cls.hash_tree_root_value(inst) == want_root, f"{name}: root diverges"

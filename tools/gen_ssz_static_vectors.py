@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""Generate the `ssz_static` EF vector family: one pinned
+(serialized, hash_tree_root) fixture per container variant in both
+presets (testing/ef_tests' largest family, src/cases/ssz_static.rs).
+
+The fuzz suite proves encode/decode SYMMETRY; these pin the absolute
+bytes and roots, so a symmetric-but-wrong change to SSZ or
+merkleization fails loudly.  Instances come from the fuzz generator
+with a name-keyed deterministic rng (regenerate + review the diff after
+intentional format changes)."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tests"),
+)
+
+from test_ssz_fuzz import CASES, random_instance  # noqa: E402
+
+from lighthouse_tpu.network.snappy import compress_framed  # noqa: E402
+
+ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "vectors", "consensus", "ssz_static",
+)
+
+
+def main() -> None:
+    if os.path.isdir(ROOT):
+        shutil.rmtree(ROOT)
+    total = 0
+    for name in sorted(CASES):
+        cls = CASES[name]
+        rng = random.Random(zlib.crc32(("static." + name).encode()))
+        inst = random_instance(cls, rng, size_cap=2)
+        blob = inst.encode()
+        if len(blob) > 512 * 1024:
+            # BeaconState on the mainnet preset carries multi-MB fixed
+            # vectors even when empty; shrinking further is impossible,
+            # so those variants are pinned by the MINIMAL-preset cases
+            # (same field layout/merkleization code path).  Named so the
+            # omission is never silent:
+            print(f"skipped (too large to pin): {name} ({len(blob)} bytes)")
+            continue
+        d = os.path.join(ROOT, name.replace("/", "_"), "case_0")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "serialized.ssz_snappy"), "wb") as f:
+            f.write(compress_framed(blob))
+        with open(os.path.join(d, "roots.json"), "w") as f:
+            json.dump(
+                {"root": "0x" + cls.hash_tree_root_value(inst).hex()}, f
+            )
+        total += 1
+    print(f"generated {total} ssz_static cases under {ROOT}")
+
+
+if __name__ == "__main__":
+    main()
