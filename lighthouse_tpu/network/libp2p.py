@@ -38,6 +38,7 @@ from .noise import (
     peer_id_from_pubkey,
     responder_handshake,
 )
+from .quic import QuicEndpoint, QuicError
 from .yamux import Session, Stream, YamuxError
 
 log = get_logger("libp2p")
@@ -45,6 +46,10 @@ log = get_logger("libp2p")
 MULTISTREAM = "/multistream/1.0.0"
 NOISE_PROTO = "/noise"
 YAMUX_PROTO = "/yamux/1.0.0"
+
+# errors any transport's streams can surface (yamux-over-noise-over-TCP
+# or native QUIC streams — the two stacks share the Stream contract)
+TRANSPORT_ERRORS = (YamuxError, QuicError, OSError)
 GOSSIP_PROTO = "/meshsub/1.1.0"
 # eth2 GOSSIP_MAX_SIZE is 10 MiB; one RPC may carry a few messages
 MAX_GOSSIP_RPC_SIZE = 11 * 1024 * 1024
@@ -267,8 +272,17 @@ class MessageCache:
 # ---------------------------------------------------------------------------
 
 
+class _QuicIdentity:
+    """Stand-in for a NoiseSession on QUIC connections: the TLS
+    handshake already authenticated the libp2p identity."""
+
+    def __init__(self, remote_peer_id: bytes):
+        self.remote_peer_id = remote_peer_id
+
+
 class Connection:
-    """One peer connection: noise channel + yamux session + gossip state."""
+    """One peer connection: secure channel + stream muxer + gossip state
+    (noise+yamux over TCP, or a native QUIC connection)."""
 
     def __init__(self, host: "Libp2pHost", sock: socket.socket,
                  noise: NoiseSession, muxer: Session):
@@ -305,7 +319,7 @@ class Connection:
             # writers would corrupt the shared stream's varint framing
             with self._gossip_write_lock:
                 st.write(_pb_varint(len(rpc)) + rpc)
-        except (YamuxError, OSError, Libp2pError) as exc:
+        except (*TRANSPORT_ERRORS, Libp2pError) as exc:
             log.debug("gossip send to %s failed: %s", self.peer_id.hex()[:8], exc)
             self.alive = False
 
@@ -342,10 +356,11 @@ class Connection:
     def close(self) -> None:
         self.alive = False
         self.muxer.stop()
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
 
 
 class Libp2pHost:
@@ -364,7 +379,7 @@ class Libp2pHost:
     HEARTBEAT_SECS = 1.0
 
     def __init__(self, key=None, ip: str = "127.0.0.1", port: int = 0,
-                 heartbeat: bool = True):
+                 heartbeat: bool = True, quic_port: int | None = None):
         from cryptography.hazmat.primitives.asymmetric import ec
 
         self.key = key or ec.generate_private_key(ec.SECP256K1())
@@ -392,6 +407,11 @@ class Libp2pHost:
         self._heartbeat_enabled = heartbeat
         self._running = False
         self._threads: list[threading.Thread] = []
+        # optional QUIC listener (the reference runs TCP+QUIC side by
+        # side, `service/utils.rs:39-48`); None disables it
+        self.quic: QuicEndpoint | None = None
+        self.quic_port: int | None = None
+        self._quic_port_arg = quic_port
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -401,6 +421,14 @@ class Libp2pHost:
                              name=f"libp2p-{self.port}", daemon=True)
         t.start()
         self._threads.append(t)
+        if self._quic_port_arg is not None:
+            self.quic = QuicEndpoint(self.key, self.ip, self._quic_port_arg)
+            self.quic_port = self.quic.port
+            qt = threading.Thread(target=self._quic_accept_loop,
+                                  name=f"libp2p-quic-{self.quic_port}",
+                                  daemon=True)
+            qt.start()
+            self._threads.append(qt)
         if self._heartbeat_enabled:
             hb = threading.Thread(target=self._heartbeat_loop,
                                   name=f"gossip-hb-{self.port}", daemon=True)
@@ -496,6 +524,8 @@ class Libp2pHost:
             self.listener.close()
         except OSError:
             pass
+        if self.quic is not None:
+            self.quic.stop()
 
     # -- socket plumbing ---------------------------------------------------
 
@@ -555,29 +585,32 @@ class Libp2pHost:
         muxer = Session(n_write, mux_recv, is_dialer=dialer,
                         on_stream=None)
         conn = Connection(self, sock, noise, muxer)
+        conn = self._adopt_connection(conn, expected_peer_id)
+        sock.settimeout(None)
+        return conn
+
+    def _adopt_connection(self, conn: Connection,
+                          expected_peer_id: bytes | None) -> Connection:
+        """Transport-agnostic admission: identity pinning, ban check,
+        stream dispatch, duplicate replacement, subscription announce —
+        shared by the TCP (noise+yamux) and QUIC upgrade paths."""
         # identity pinning (ADVICE r3): a dialer that knows who it meant to
         # reach (from the ENR) must reject an endpoint proving a different
         # identity — rust-libp2p rejects mismatched /p2p/<peer-id> the same
         # way.
         if expected_peer_id is not None and conn.peer_id != expected_peer_id:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            conn.close()
             raise Libp2pError(
                 f"remote proved identity {conn.peer_id.hex()[:8]}, "
                 f"expected {expected_peer_id.hex()[:8]}"
             )
         if self.peer_manager.is_banned(conn.peer_id.hex()):
-            try:
-                sock.close()
-            except OSError:
-                pass
+            conn.close()
             raise Libp2pError(f"peer {conn.peer_id.hex()[:8]} is banned")
+        muxer = conn.muxer
         muxer._on_stream = lambda st: self._spawn_stream_handler(conn, st)
         muxer._on_close = lambda: self._drop_connection(conn)
         muxer.start()
-        sock.settimeout(None)
         old = self.connections.get(conn.peer_id)
         if old is not None and old is not conn:
             # replacing a live duplicate would leak its socket + pump
@@ -622,6 +655,33 @@ class Libp2pHost:
         return self._upgrade(sock, dialer=True,
                              expected_peer_id=expected_peer_id)
 
+    # -- QUIC transport ----------------------------------------------------
+
+    def _quic_accept_loop(self) -> None:
+        while self._running:
+            try:
+                qconn = self.quic.accept(timeout=1.0)
+            except QuicError:
+                continue
+            try:
+                self._adopt_quic(qconn, None)
+            except Libp2pError as exc:
+                log.debug("inbound QUIC rejected: %s", exc)
+
+    def _adopt_quic(self, qconn, expected_peer_id) -> Connection:
+        """A handshake-complete QUIC connection IS secure channel + muxer:
+        TLS proved the libp2p identity, QUIC streams replace yamux."""
+        conn = Connection(self, None, _QuicIdentity(qconn.remote_peer_id),
+                          qconn)
+        return self._adopt_connection(conn, expected_peer_id)
+
+    def dial_quic(self, ip: str, port: int,
+                  expected_peer_id: bytes | None = None) -> Connection:
+        if self.quic is None:
+            raise Libp2pError("QUIC transport not enabled on this host")
+        qconn = self.quic.dial(ip, port, expected_peer_id=expected_peer_id)
+        return self._adopt_quic(qconn, expected_peer_id)
+
     def _drop_connection(self, conn: Connection) -> None:
         """Muxer died (peer hung up or send failed): forget the connection
         and record the disconnect, keeping `connections` bounded."""
@@ -634,10 +694,18 @@ class Libp2pHost:
         info = self.peer_manager.peers.get(conn.peer_id.hex())
         if info is not None:
             info.connected = False
+        # stop the muxer itself, not just the raw socket: a QUIC
+        # connection has no conn.sock and would otherwise live on as a
+        # zombie (threads, endpoint registry, inbound stream dispatch)
         try:
-            conn.sock.close()
-        except OSError:
+            conn.muxer.stop()
+        except Exception:  # noqa: BLE001 — teardown must not throw
             pass
+        if conn.sock is not None:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
 
     # -- per-stream server side -------------------------------------------
 
@@ -658,7 +726,7 @@ class Libp2pHost:
             else:
                 name = proto.split("/")[-3]
                 self._serve_rpc(conn, st, name)
-        except (YamuxError, Libp2pError, NoiseError, OSError, ValueError) as exc:
+        except (*TRANSPORT_ERRORS, Libp2pError, NoiseError, ValueError) as exc:
             log.debug("stream from %s: %s", conn.peer_id.hex()[:8], exc)
 
     def _serve_gossip(self, conn: Connection, st: Stream,

@@ -1,0 +1,633 @@
+"""Minimal TLS 1.3 (RFC 8446) handshake engine for QUIC, with libp2p certs.
+
+QUIC replaces the TLS record layer with CRYPTO frames under its own
+packet protection (RFC 9001 §4), so this engine never encrypts a byte:
+it consumes plaintext handshake messages per encryption level, emits
+plaintext handshake messages per level, and surfaces traffic SECRETS —
+`quic.py` turns those into packet-protection keys.  That one design
+fact is why a complete, mutually-authenticated TLS 1.3 fits in this
+file: no records, no compat ChangeCipherSpec, no resumption/0-RTT, one
+suite (TLS_AES_128_GCM_SHA256), one group (x25519), one signature
+algorithm (ecdsa_secp256r1_sha256 for the certificate key).
+
+libp2p identity (libp2p TLS spec, as rust-libp2p's `libp2p-tls` does for
+the reference's QUIC transport): each side presents a self-signed X.509
+certificate over a throwaway P-256 key carrying the critical extension
+1.3.6.1.4.1.53594.1.1 = SignedKey{ identity-pubkey-protobuf, secp256k1
+signature over "libp2p-tls-handshake:" || SPKI(cert key) }.  Mutual
+authentication is mandatory: the server sends CertificateRequest and the
+client responds with its own certificate chain.  Peer identity comes out
+of the handshake as a libp2p peer id — the same id `noise.py` proves on
+the TCP path, derived from the same secp256k1 node key.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac as hmac_mod
+import os
+import struct
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.x509.oid import NameOID
+
+from .noise import (
+    marshal_identity_pubkey,
+    peer_id_from_pubkey,
+    unmarshal_identity_pubkey,
+)
+from .quic import hkdf_expand_label, hkdf_extract
+
+LEVEL_INITIAL = 0
+LEVEL_HANDSHAKE = 1
+LEVEL_APP = 2
+
+TLS_AES_128_GCM_SHA256 = 0x1301
+GROUP_X25519 = 0x001D
+SIG_ECDSA_P256_SHA256 = 0x0403
+ALPN_LIBP2P = b"libp2p"
+
+LIBP2P_CERT_OID = x509.ObjectIdentifier("1.3.6.1.4.1.53594.1.1")
+LIBP2P_CERT_PREFIX = b"libp2p-tls-handshake:"
+
+HT_CLIENT_HELLO = 1
+HT_SERVER_HELLO = 2
+HT_NEW_SESSION_TICKET = 4
+HT_ENCRYPTED_EXTENSIONS = 8
+HT_CERTIFICATE = 11
+HT_CERTIFICATE_REQUEST = 13
+HT_CERTIFICATE_VERIFY = 15
+HT_FINISHED = 20
+HT_KEY_UPDATE = 24
+
+EXT_SERVER_NAME = 0x0000
+EXT_SUPPORTED_GROUPS = 0x000A
+EXT_SIGNATURE_ALGORITHMS = 0x000D
+EXT_ALPN = 0x0010
+EXT_SUPPORTED_VERSIONS = 0x002B
+EXT_KEY_SHARE = 0x0033
+EXT_QUIC_TRANSPORT_PARAMS = 0x0039
+
+TLS13 = 0x0304
+
+
+class TlsError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# vector helpers
+# ---------------------------------------------------------------------------
+
+def _v8(data: bytes) -> bytes:
+    return bytes([len(data)]) + data
+
+
+def _v16(data: bytes) -> bytes:
+    return struct.pack(">H", len(data)) + data
+
+
+def _v24(data: bytes) -> bytes:
+    return len(data).to_bytes(3, "big") + data
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def u8(self) -> int:
+        return self.bytes(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack(">H", self.bytes(2))[0]
+
+    def u24(self) -> int:
+        return int.from_bytes(self.bytes(3), "big")
+
+    def bytes(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise TlsError("truncated")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def vec8(self) -> bytes:
+        return self.bytes(self.u8())
+
+    def vec16(self) -> bytes:
+        return self.bytes(self.u16())
+
+    def vec24(self) -> bytes:
+        return self.bytes(self.u24())
+
+    def done(self) -> bool:
+        return self.pos >= len(self.data)
+
+
+def _ext(etype: int, data: bytes) -> bytes:
+    return struct.pack(">H", etype) + _v16(data)
+
+
+def _parse_extensions(data: bytes) -> dict[int, bytes]:
+    r = _Reader(data)
+    out: dict[int, bytes] = {}
+    while not r.done():
+        etype = r.u16()
+        out[etype] = r.vec16()
+    return out
+
+
+def _msg(htype: int, body: bytes) -> bytes:
+    return bytes([htype]) + _v24(body)
+
+
+# ---------------------------------------------------------------------------
+# libp2p certificates
+# ---------------------------------------------------------------------------
+
+def _der_octet_string(data: bytes) -> bytes:
+    return b"\x04" + _der_len(len(data)) + data
+
+
+def _der_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _der_seq(inner: bytes) -> bytes:
+    return b"\x30" + _der_len(len(inner)) + inner
+
+
+def _der_read_tlv(data: bytes, pos: int) -> tuple[int, bytes, int]:
+    tag = data[pos]
+    ln = data[pos + 1]
+    pos += 2
+    if ln & 0x80:
+        nb = ln & 0x7F
+        ln = int.from_bytes(data[pos:pos + nb], "big")
+        pos += nb
+    return tag, data[pos:pos + ln], pos + ln
+
+
+def make_libp2p_cert(
+    identity_key: ec.EllipticCurvePrivateKey,
+) -> tuple[bytes, ec.EllipticCurvePrivateKey]:
+    """Self-signed P-256 certificate binding the secp256k1 libp2p identity.
+
+    Returns (certificate DER, certificate private key).
+    """
+    cert_key = ec.generate_private_key(ec.SECP256R1())
+    spki = cert_key.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    identity_sig = identity_key.sign(
+        LIBP2P_CERT_PREFIX + spki, ec.ECDSA(hashes.SHA256())
+    )
+    identity_pub = identity_key.public_key().public_bytes(
+        serialization.Encoding.X962,
+        serialization.PublicFormat.CompressedPoint,
+    )
+    signed_key = _der_seq(
+        _der_octet_string(marshal_identity_pubkey(identity_pub))
+        + _der_octet_string(identity_sig)
+    )
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "lighthouse-tpu")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(cert_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(hours=1))
+        .not_valid_after(now + datetime.timedelta(days=3650))
+        .add_extension(
+            x509.UnrecognizedExtension(LIBP2P_CERT_OID, signed_key),
+            critical=True,
+        )
+        .sign(cert_key, hashes.SHA256())
+    )
+    return cert.public_bytes(serialization.Encoding.DER), cert_key
+
+
+def verify_libp2p_cert(cert_der: bytes) -> tuple[bytes, ec.EllipticCurvePublicKey]:
+    """Validate the libp2p extension; returns (peer_id, cert public key).
+
+    The cert public key is what CertificateVerify must be checked
+    against; the peer id is the authenticated libp2p identity.
+    """
+    cert = x509.load_der_x509_certificate(cert_der)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if not (cert.not_valid_before_utc <= now <= cert.not_valid_after_utc):
+        raise TlsError("certificate outside validity window")
+    try:
+        ext = cert.extensions.get_extension_for_oid(LIBP2P_CERT_OID)
+    except x509.ExtensionNotFound:
+        raise TlsError("missing libp2p certificate extension") from None
+    raw = ext.value.public_bytes() if hasattr(ext.value, "public_bytes") else ext.value.value
+    tag, seq, _ = _der_read_tlv(raw, 0)
+    if tag != 0x30:
+        raise TlsError("libp2p extension: not a SEQUENCE")
+    tag, pub_pb, nxt = _der_read_tlv(seq, 0)
+    if tag != 0x04:
+        raise TlsError("libp2p extension: bad publicKey")
+    tag, identity_sig, _ = _der_read_tlv(seq, nxt)
+    if tag != 0x04:
+        raise TlsError("libp2p extension: bad signature")
+    identity_pub_compressed = unmarshal_identity_pubkey(pub_pb)
+    identity_pub = ec.EllipticCurvePublicKey.from_encoded_point(
+        ec.SECP256K1(), identity_pub_compressed
+    )
+    spki = cert.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    try:
+        identity_pub.verify(
+            identity_sig,
+            LIBP2P_CERT_PREFIX + spki,
+            ec.ECDSA(hashes.SHA256()),
+        )
+    except Exception:
+        raise TlsError("libp2p identity signature invalid") from None
+    return peer_id_from_pubkey(identity_pub_compressed), cert.public_key()
+
+
+# ---------------------------------------------------------------------------
+# key schedule (RFC 8446 §7.1)
+# ---------------------------------------------------------------------------
+
+_ZEROS = b"\x00" * 32
+_EMPTY_HASH = hashlib.sha256(b"").digest()
+
+
+def _derive_secret(secret: bytes, label: str, transcript_hash: bytes) -> bytes:
+    return hkdf_expand_label(secret, label, transcript_hash, 32)
+
+
+def _finished_mac(traffic_secret: bytes, transcript_hash: bytes) -> bytes:
+    fk = hkdf_expand_label(traffic_secret, "finished", b"", 32)
+    return hmac_mod.new(fk, transcript_hash, hashlib.sha256).digest()
+
+
+_CV_SERVER = b" " * 64 + b"TLS 1.3, server CertificateVerify" + b"\x00"
+_CV_CLIENT = b" " * 64 + b"TLS 1.3, client CertificateVerify" + b"\x00"
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class TlsEngine:
+    """One QUIC-TLS handshake, client or server.
+
+    Drive with ``start()`` (client only) and ``on_data(level, bytes)``
+    (reassembled CRYPTO stream data); collect plaintext output with
+    ``take_output() -> [(level, bytes)...]``.  ``secrets`` fills in as
+    epochs become available: ``{LEVEL_HANDSHAKE: (client, server),
+    LEVEL_APP: (client, server)}``.  ``complete`` flips after Finished
+    verifies in both directions; then ``peer_id``/``alpn``/
+    ``peer_transport_params`` are authenticated facts.
+    """
+
+    def __init__(self, role: str, identity_key: ec.EllipticCurvePrivateKey,
+                 transport_params: bytes, alpn: bytes = ALPN_LIBP2P,
+                 cert: tuple[bytes, ec.EllipticCurvePrivateKey] | None = None):
+        assert role in ("client", "server")
+        self.role = role
+        self.identity_key = identity_key
+        self.transport_params = transport_params
+        self.alpn = alpn
+
+        # the certificate binds only the static identity key, so an
+        # endpoint generates it once and reuses it for every handshake
+        # (per-dial keygen+signing would also amplify Initial-flood DoS)
+        if cert is not None:
+            self.cert_der, self.cert_key = cert
+        else:
+            self.cert_der, self.cert_key = make_libp2p_cert(identity_key)
+        self._eph = X25519PrivateKey.generate()
+        self._transcript = hashlib.sha256()
+        self._out: list[tuple[int, bytes]] = []
+        self._buf: dict[int, bytearray] = {
+            LEVEL_INITIAL: bytearray(),
+            LEVEL_HANDSHAKE: bytearray(),
+            LEVEL_APP: bytearray(),
+        }
+
+        self.secrets: dict[int, tuple[bytes, bytes]] = {}
+        self.complete = False
+        self.peer_id: bytes | None = None
+        self.peer_transport_params: bytes | None = None
+        self.negotiated_alpn: bytes | None = None
+
+        self._hs_secret: bytes | None = None
+        self._master: bytes | None = None
+        self._client_hs: bytes | None = None
+        self._server_hs: bytes | None = None
+        self._peer_cert_pub: ec.EllipticCurvePublicKey | None = None
+        self._server_fin_transcript: bytes | None = None
+        self._client_random = os.urandom(32)
+        # message sequencing: what we expect next from the peer
+        if role == "client":
+            self._expect = [HT_SERVER_HELLO, HT_ENCRYPTED_EXTENSIONS,
+                            HT_CERTIFICATE_REQUEST, HT_CERTIFICATE,
+                            HT_CERTIFICATE_VERIFY, HT_FINISHED]
+        else:
+            self._expect = [HT_CLIENT_HELLO, HT_CERTIFICATE,
+                            HT_CERTIFICATE_VERIFY, HT_FINISHED]
+
+    # -- helpers ----------------------------------------------------------
+
+    def _send(self, level: int, htype: int, body: bytes) -> None:
+        raw = _msg(htype, body)
+        self._transcript.update(raw)
+        self._out.append((level, raw))
+
+    def _th(self) -> bytes:
+        return self._transcript.copy().digest()
+
+    def take_output(self) -> list[tuple[int, bytes]]:
+        out, self._out = self._out, []
+        return out
+
+    # -- client start -----------------------------------------------------
+
+    def start(self) -> None:
+        if self.role != "client":
+            return
+        pub = self._eph.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        exts = b"".join([
+            _ext(EXT_SUPPORTED_VERSIONS, _v8(struct.pack(">H", TLS13))),
+            _ext(EXT_SUPPORTED_GROUPS,
+                 _v16(struct.pack(">H", GROUP_X25519))),
+            _ext(EXT_SIGNATURE_ALGORITHMS,
+                 _v16(struct.pack(">H", SIG_ECDSA_P256_SHA256))),
+            _ext(EXT_KEY_SHARE,
+                 _v16(struct.pack(">H", GROUP_X25519) + _v16(pub))),
+            _ext(EXT_ALPN, _v16(_v8(self.alpn))),
+            _ext(EXT_QUIC_TRANSPORT_PARAMS, self.transport_params),
+        ])
+        body = (struct.pack(">H", 0x0303) + self._client_random
+                + _v8(b"")  # legacy_session_id: empty under QUIC
+                + _v16(struct.pack(">H", TLS_AES_128_GCM_SHA256))
+                + _v8(b"\x00")  # null compression
+                + _v16(exts))
+        self._send(LEVEL_INITIAL, HT_CLIENT_HELLO, body)
+
+    # -- inbound data -----------------------------------------------------
+
+    def on_data(self, level: int, data: bytes) -> None:
+        buf = self._buf[level]
+        buf += data
+        while len(buf) >= 4:
+            htype = buf[0]
+            blen = int.from_bytes(bytes(buf[1:4]), "big")
+            if len(buf) < 4 + blen:
+                return
+            raw = bytes(buf[:4 + blen])
+            del buf[:4 + blen]
+            self._handle(level, htype, raw)
+
+    def _handle(self, level: int, htype: int, raw: bytes) -> None:
+        body = raw[4:]
+        if htype in (HT_NEW_SESSION_TICKET,):
+            return  # tolerated, ignored (no resumption)
+        if htype == HT_KEY_UPDATE:
+            raise TlsError("key_update not supported")
+        if not self._expect or htype != self._expect[0]:
+            raise TlsError(
+                f"unexpected handshake message {htype} "
+                f"(wanted {self._expect[:1]})")
+        self._expect.pop(0)
+        handler = {
+            HT_CLIENT_HELLO: self._on_client_hello,
+            HT_SERVER_HELLO: self._on_server_hello,
+            HT_ENCRYPTED_EXTENSIONS: self._on_encrypted_extensions,
+            HT_CERTIFICATE_REQUEST: self._on_certificate_request,
+            HT_CERTIFICATE: self._on_certificate,
+            HT_CERTIFICATE_VERIFY: self._on_certificate_verify,
+            HT_FINISHED: self._on_finished,
+        }[htype]
+        handler(body, raw)
+
+    # -- key schedule -----------------------------------------------------
+
+    def _install_handshake(self, shared: bytes) -> None:
+        early = hkdf_extract(_ZEROS, _ZEROS)
+        derived = _derive_secret(early, "derived", _EMPTY_HASH)
+        self._hs_secret = hkdf_extract(derived, shared)
+        th = self._th()  # CH..SH
+        self._client_hs = _derive_secret(self._hs_secret, "c hs traffic", th)
+        self._server_hs = _derive_secret(self._hs_secret, "s hs traffic", th)
+        self.secrets[LEVEL_HANDSHAKE] = (self._client_hs, self._server_hs)
+        derived2 = _derive_secret(self._hs_secret, "derived", _EMPTY_HASH)
+        self._master = hkdf_extract(derived2, _ZEROS)
+
+    def _install_app(self, th_server_fin: bytes) -> None:
+        c_ap = _derive_secret(self._master, "c ap traffic", th_server_fin)
+        s_ap = _derive_secret(self._master, "s ap traffic", th_server_fin)
+        self.secrets[LEVEL_APP] = (c_ap, s_ap)
+
+    # -- server side ------------------------------------------------------
+
+    def _on_client_hello(self, body: bytes, raw: bytes) -> None:
+        self._transcript.update(raw)
+        r = _Reader(body)
+        if r.u16() != 0x0303:
+            raise TlsError("bad legacy_version")
+        r.bytes(32)  # client random
+        session_id = r.vec8()
+        suites = r.vec16()
+        if struct.pack(">H", TLS_AES_128_GCM_SHA256) not in [
+            suites[i:i + 2] for i in range(0, len(suites), 2)
+        ]:
+            raise TlsError("no common cipher suite")
+        r.vec8()  # compression
+        exts = _parse_extensions(r.vec16())
+        sv = exts.get(EXT_SUPPORTED_VERSIONS, b"")
+        versions = [sv[i:i + 2] for i in range(1, len(sv) - 1, 2)]
+        if struct.pack(">H", TLS13) not in versions:
+            raise TlsError("peer does not offer TLS 1.3")
+        peer_share = None
+        ks = _Reader(exts.get(EXT_KEY_SHARE, b""))
+        for entry in [ks.vec16()] if exts.get(EXT_KEY_SHARE) else []:
+            er = _Reader(entry)
+            while not er.done():
+                group = er.u16()
+                share = er.vec16()
+                if group == GROUP_X25519:
+                    peer_share = share
+        if peer_share is None:
+            raise TlsError("no x25519 key share (HelloRetry unsupported)")
+        alpn_ext = exts.get(EXT_ALPN)
+        if alpn_ext is None:
+            # RFC 9001 section 8.1: ALPN is mandatory over QUIC, and
+            # libp2p-tls requires "libp2p" specifically
+            raise TlsError("client omitted ALPN")
+        ar = _Reader(alpn_ext)
+        protos = _Reader(ar.vec16())
+        offered = []
+        while not protos.done():
+            offered.append(protos.vec8())
+        if self.alpn not in offered:
+            raise TlsError("no common ALPN protocol")
+        self.negotiated_alpn = self.alpn
+        qtp = exts.get(EXT_QUIC_TRANSPORT_PARAMS)
+        if qtp is None:
+            raise TlsError("client omitted quic_transport_parameters")
+        self.peer_transport_params = qtp
+
+        # ServerHello
+        my_pub = self._eph.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        sh_exts = b"".join([
+            _ext(EXT_SUPPORTED_VERSIONS, struct.pack(">H", TLS13)),
+            _ext(EXT_KEY_SHARE,
+                 struct.pack(">H", GROUP_X25519) + _v16(my_pub)),
+        ])
+        sh = (struct.pack(">H", 0x0303) + os.urandom(32) + _v8(session_id)
+              + struct.pack(">H", TLS_AES_128_GCM_SHA256) + b"\x00"
+              + _v16(sh_exts))
+        self._send(LEVEL_INITIAL, HT_SERVER_HELLO, sh)
+
+        shared = self._eph.exchange(
+            X25519PublicKey.from_public_bytes(peer_share))
+        self._install_handshake(shared)
+
+        # EncryptedExtensions
+        ee_exts = b"".join([
+            _ext(EXT_ALPN, _v16(_v8(self.alpn))),
+            _ext(EXT_QUIC_TRANSPORT_PARAMS, self.transport_params),
+        ])
+        self._send(LEVEL_HANDSHAKE, HT_ENCRYPTED_EXTENSIONS, _v16(ee_exts))
+        # CertificateRequest (mutual auth is mandatory in libp2p)
+        cr_exts = _ext(EXT_SIGNATURE_ALGORITHMS,
+                       _v16(struct.pack(">H", SIG_ECDSA_P256_SHA256)))
+        self._send(LEVEL_HANDSHAKE, HT_CERTIFICATE_REQUEST,
+                   _v8(b"") + _v16(cr_exts))
+        self._send_certificate()
+        self._send_certificate_verify(_CV_SERVER)
+        # server Finished
+        fin = _finished_mac(self._server_hs, self._th())
+        self._send(LEVEL_HANDSHAKE, HT_FINISHED, fin)
+        self._server_fin_transcript = self._th()  # CH..server Fin
+        self._install_app(self._server_fin_transcript)
+
+    # -- client side ------------------------------------------------------
+
+    def _on_server_hello(self, body: bytes, raw: bytes) -> None:
+        self._transcript.update(raw)
+        r = _Reader(body)
+        if r.u16() != 0x0303:
+            raise TlsError("bad legacy_version")
+        r.bytes(32)
+        r.vec8()  # session id echo
+        if r.u16() != TLS_AES_128_GCM_SHA256:
+            raise TlsError("server picked unknown suite")
+        if r.u8() != 0:
+            raise TlsError("nonzero compression")
+        exts = _parse_extensions(r.vec16())
+        if exts.get(EXT_SUPPORTED_VERSIONS) != struct.pack(">H", TLS13):
+            raise TlsError("server did not select TLS 1.3")
+        ksr = _Reader(exts.get(EXT_KEY_SHARE, b""))
+        if ksr.u16() != GROUP_X25519:
+            raise TlsError("server key share not x25519")
+        peer_share = ksr.vec16()
+        shared = self._eph.exchange(
+            X25519PublicKey.from_public_bytes(peer_share))
+        self._install_handshake(shared)
+
+    def _on_encrypted_extensions(self, body: bytes, raw: bytes) -> None:
+        self._transcript.update(raw)
+        exts = _parse_extensions(_Reader(body).vec16())
+        alpn_ext = exts.get(EXT_ALPN)
+        if alpn_ext is None:
+            raise TlsError("server omitted ALPN")
+        ar = _Reader(alpn_ext)
+        lr = _Reader(ar.vec16())
+        self.negotiated_alpn = lr.vec8()
+        if self.negotiated_alpn != self.alpn:
+            raise TlsError("server picked foreign ALPN")
+        qtp = exts.get(EXT_QUIC_TRANSPORT_PARAMS)
+        if qtp is None:
+            raise TlsError("server omitted quic_transport_parameters")
+        self.peer_transport_params = qtp
+
+    def _on_certificate_request(self, body: bytes, raw: bytes) -> None:
+        self._transcript.update(raw)
+        # context must be echoed; we only ever see the empty context
+        if _Reader(body).vec8() != b"":
+            raise TlsError("nonempty certificate_request_context")
+
+    # -- shared: certificates and finished --------------------------------
+
+    def _send_certificate(self) -> None:
+        entry = _v24(self.cert_der) + _v16(b"")
+        self._send(LEVEL_HANDSHAKE, HT_CERTIFICATE, _v8(b"") + _v24(entry))
+
+    def _send_certificate_verify(self, context: bytes) -> None:
+        content = context + self._th()
+        sig = self.cert_key.sign(content, ec.ECDSA(hashes.SHA256()))
+        self._send(LEVEL_HANDSHAKE, HT_CERTIFICATE_VERIFY,
+                   struct.pack(">H", SIG_ECDSA_P256_SHA256) + _v16(sig))
+
+    def _on_certificate(self, body: bytes, raw: bytes) -> None:
+        self._transcript.update(raw)
+        r = _Reader(body)
+        if r.vec8() != b"":
+            raise TlsError("nonempty certificate context")
+        entries = _Reader(r.vec24())
+        cert_der = entries.vec24()
+        entries.vec16()  # per-entry extensions
+        self.peer_id, self._peer_cert_pub = verify_libp2p_cert(cert_der)
+
+    def _on_certificate_verify(self, body: bytes, raw: bytes) -> None:
+        # signature covers the transcript UP TO (not including) this message
+        th = self._th()
+        self._transcript.update(raw)
+        r = _Reader(body)
+        if r.u16() != SIG_ECDSA_P256_SHA256:
+            raise TlsError("unsupported CertificateVerify algorithm")
+        sig = r.vec16()
+        context = _CV_SERVER if self.role == "client" else _CV_CLIENT
+        try:
+            self._peer_cert_pub.verify(
+                sig, context + th, ec.ECDSA(hashes.SHA256()))
+        except Exception:
+            raise TlsError("CertificateVerify signature invalid") from None
+
+    def _on_finished(self, body: bytes, raw: bytes) -> None:
+        th = self._th()
+        peer_hs = self._server_hs if self.role == "client" else self._client_hs
+        expect = _finished_mac(peer_hs, th)
+        if not hmac_mod.compare_digest(body, expect):
+            raise TlsError("Finished verify_data mismatch")
+        self._transcript.update(raw)
+        if self.role == "client":
+            # CH..server Fin fixes the application secrets
+            self._server_fin_transcript = self._th()
+            self._install_app(self._server_fin_transcript)
+            self._send_certificate()
+            self._send_certificate_verify(_CV_CLIENT)
+            fin = _finished_mac(self._client_hs, self._th())
+            self._send(LEVEL_HANDSHAKE, HT_FINISHED, fin)
+            self.complete = True
+        else:
+            self.complete = True

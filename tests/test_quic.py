@@ -1,0 +1,453 @@
+"""QUIC v1 transport: crypto KATs, TLS 1.3 handshake, streams, libp2p.
+
+Capability twin of the reference's QUIC transport tests (quinn under
+`lighthouse_network/src/service/utils.rs:39-48` builds TCP+QUIC pairs;
+`lighthouse_network/tests/rpc_tests.rs` exercises both).  The protection
+layer is pinned to RFC 9001 Appendix A vectors; everything above it is
+exercised over real UDP sockets on localhost.
+"""
+
+import threading
+import time
+
+import pytest
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from lighthouse_tpu.network import quic as q
+from lighthouse_tpu.network import rpc as rpc_mod
+from lighthouse_tpu.network.libp2p import Libp2pHost
+from lighthouse_tpu.network.noise import peer_id_from_pubkey
+from lighthouse_tpu.network.tls13 import (
+    LEVEL_APP,
+    LEVEL_HANDSHAKE,
+    TlsEngine,
+    TlsError,
+    make_libp2p_cert,
+    verify_libp2p_cert,
+)
+
+
+def _key():
+    return ec.generate_private_key(ec.SECP256K1())
+
+
+def _pub_id(key) -> bytes:
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+    )
+    return peer_id_from_pubkey(pub)
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+class TestVarint:
+    def test_rfc9000_a1_examples(self):
+        # RFC 9000 Appendix A.1's worked examples, both directions
+        for value, encoding in [
+            (151_288_809_941_952_652, "c2197c5eff14e88c"),
+            (494_878_333, "9d7f3e7d"),
+            (15_293, "7bbd"),
+            (37, "25"),
+        ]:
+            assert q.enc_varint(value).hex() == encoding
+            got, pos = q.dec_varint(bytes.fromhex(encoding), 0)
+            assert (got, pos) == (value, len(encoding) // 2)
+
+    def test_boundaries(self):
+        for v in [0, 63, 64, 16383, 16384, (1 << 30) - 1, 1 << 30,
+                  (1 << 62) - 1]:
+            enc = q.enc_varint(v)
+            got, pos = q.dec_varint(enc, 0)
+            assert (got, pos) == (v, len(enc))
+        with pytest.raises(q.QuicError):
+            q.enc_varint(1 << 62)
+
+
+class TestPacketNumbers:
+    def test_rfc9000_a3_decode(self):
+        # RFC 9000 Appendix A.3's worked example
+        assert q.decode_pn(0x9B32, 16, 0xA82F30EA) == 0xA82F9B32
+
+    def test_roundtrip_windows(self):
+        for largest_acked, pn in [(-1, 0), (-1, 3), (0, 1), (90, 94),
+                                  (0xABE8B3, 0xAC5C02),
+                                  (1_000_000, 1_000_300)]:
+            enc = q.encode_pn(pn, largest_acked)
+            truncated = int.from_bytes(enc, "big")
+            # receiver's largest seen is at least largest_acked
+            assert q.decode_pn(truncated, len(enc) * 8, pn - 1) == pn
+
+
+class TestInitialKeys:
+    """RFC 9001 Appendix A.1: full derivation chain for the documented
+    client DCID 0x8394c8f03e515708."""
+
+    DCID = bytes.fromhex("8394c8f03e515708")
+
+    def test_client_side(self):
+        client, _ = q.initial_keys(self.DCID)
+        assert client.secret.hex() == (
+            "c00cf151ca5be075ed0ebfb5c80323c4"
+            "2d6b7db67881289af4008f1f6c357aea")
+        assert client.key.hex() == "1f369613dd76d5467730efcbe3b1a22d"
+        assert client.iv.hex() == "fa044b2f42a3fd3b46fb255c"
+        assert client.hp.hex() == "9f50449e04a0e810283a1e9933adedd2"
+
+    def test_server_side(self):
+        _, server = q.initial_keys(self.DCID)
+        assert server.secret.hex() == (
+            "3c199828fd139efd216c155ad844cc81"
+            "fb82fa8d7446fa7d78be803acdda951b")
+        assert server.key.hex() == "cf3a5331653c364c88f0f379b6067e37"
+        assert server.iv.hex() == "0ac1493ca1905853b0bba03e"
+        assert server.hp.hex() == "c206b8d9b9f0f37644430b490eeaa314"
+
+
+class TestPacketProtection:
+    def test_roundtrip_long_header(self):
+        ck, _ = q.initial_keys(b"\x01" * 8)
+        payload = b"\x06\x00\x05hello" + b"\x00" * 20
+        pn_bytes = q.encode_pn(7, -1)
+        hdr = q.build_long_header(q.PKT_INITIAL, b"\xaa" * 8, b"\xbb" * 8,
+                                  pn_bytes, len(payload))
+        datagram = q.protect(ck, hdr, 7, len(pn_bytes), payload)
+        pkt = q.parse_packet(datagram, 0, 8)
+        assert pkt.ptype == q.PKT_INITIAL
+        assert pkt.dcid == b"\xaa" * 8 and pkt.scid == b"\xbb" * 8
+        pn, plain = q.unprotect(ck, datagram, pkt, -1)
+        assert pn == 7 and plain == payload
+
+    def test_roundtrip_short_header(self):
+        keys = q.DirectionKeys(b"\x42" * 32)
+        payload = b"\x01" + b"\x00" * 10
+        pn_bytes = q.encode_pn(123, 120)
+        hdr = q.build_short_header(b"\xcc" * 8, pn_bytes)
+        datagram = q.protect(keys, hdr, 123, len(pn_bytes), payload)
+        pkt = q.parse_packet(datagram, 0, 8)
+        assert pkt.ptype == q.PKT_1RTT and pkt.dcid == b"\xcc" * 8
+        pn, plain = q.unprotect(keys, datagram, pkt, 122)
+        assert pn == 123 and plain == payload
+
+    def test_tamper_detected(self):
+        ck, _ = q.initial_keys(b"\x02" * 8)
+        payload = b"\x01" + b"\x00" * 30
+        pn_bytes = q.encode_pn(0, -1)
+        hdr = q.build_long_header(q.PKT_INITIAL, b"\xaa" * 8, b"", pn_bytes,
+                                  len(payload))
+        datagram = bytearray(q.protect(ck, hdr, 0, len(pn_bytes), payload))
+        datagram[-1] ^= 0x01
+        pkt = q.parse_packet(bytes(datagram), 0, 8)
+        with pytest.raises(q.QuicError):
+            q.unprotect(ck, bytes(datagram), pkt, -1)
+
+    def test_truncated_packet_is_quic_error(self):
+        # shorter than the 4+16-byte header-protection sample: must be a
+        # QuicError (droppable garbage), never an IndexError
+        keys = q.DirectionKeys(b"\x01" * 32)
+        datagram = b"\x40" + b"\xab" * 10
+        pkt = q.parse_packet(datagram, 0, 8)
+        with pytest.raises(q.QuicError, match="too short"):
+            q.unprotect(keys, datagram, pkt, -1)
+
+    def test_wrong_direction_keys_rejected(self):
+        ck, sk = q.initial_keys(b"\x03" * 8)
+        payload = b"\x01" + b"\x00" * 30
+        pn_bytes = q.encode_pn(0, -1)
+        hdr = q.build_long_header(q.PKT_INITIAL, b"\xaa" * 8, b"", pn_bytes,
+                                  len(payload))
+        datagram = q.protect(ck, hdr, 0, len(pn_bytes), payload)
+        pkt = q.parse_packet(datagram, 0, 8)
+        with pytest.raises(q.QuicError):
+            q.unprotect(sk, datagram, pkt, -1)
+
+
+# ---------------------------------------------------------------------------
+# TLS 1.3 engine
+# ---------------------------------------------------------------------------
+
+def _run_handshake(client: TlsEngine, server: TlsEngine):
+    client.start()
+    for _ in range(6):
+        moved = False
+        for src, dst in ((client, server), (server, client)):
+            for level, data in src.take_output():
+                dst.on_data(level, data)
+                moved = True
+        if client.complete and server.complete and not moved:
+            break
+    return client, server
+
+
+class TestLibp2pCertificate:
+    def test_roundtrip(self):
+        identity = _key()
+        cert_der, cert_key = make_libp2p_cert(identity)
+        peer_id, cert_pub = verify_libp2p_cert(cert_der)
+        assert peer_id == _pub_id(identity)
+        assert cert_pub.public_numbers() == \
+            cert_key.public_key().public_numbers()
+
+    def test_foreign_identity_signature_rejected(self):
+        # certificate whose SignedKey was produced by a DIFFERENT node key
+        # than the one marshaled into the extension
+        identity, imposter = _key(), _key()
+        cert_der, _ = make_libp2p_cert(identity)
+        ok_id, _ = verify_libp2p_cert(cert_der)
+        assert ok_id == _pub_id(identity)
+        # splice: regenerate with imposter, then claim identity's pubkey —
+        # simplest equivalent: flip a byte inside the DER extension body
+        broken = bytearray(cert_der)
+        # find the extension payload by locating the signature prefix bytes
+        idx = broken.rfind(b"\x04", 0, len(broken) - 80)
+        broken[idx + 2] ^= 0xFF
+        with pytest.raises(Exception):
+            verify_libp2p_cert(bytes(broken))
+
+
+class TestTlsHandshake:
+    def test_mutual_authentication(self):
+        ck, sk = _key(), _key()
+        client = TlsEngine("client", ck, b"\x01\x02\x03")
+        server = TlsEngine("server", sk, b"\x04\x05")
+        _run_handshake(client, server)
+        assert client.complete and server.complete
+        assert client.peer_id == _pub_id(sk)
+        assert server.peer_id == _pub_id(ck)
+        assert client.secrets[LEVEL_HANDSHAKE] == server.secrets[LEVEL_HANDSHAKE]
+        assert client.secrets[LEVEL_APP] == server.secrets[LEVEL_APP]
+        assert client.negotiated_alpn == b"libp2p"
+        assert client.peer_transport_params == b"\x04\x05"
+        assert server.peer_transport_params == b"\x01\x02\x03"
+
+    def test_missing_transport_params_fatal(self):
+        ck, sk = _key(), _key()
+        client = TlsEngine("client", ck, b"\x01")
+        server = TlsEngine("server", sk, b"\x02")
+        client.start()
+        (level, ch), = client.take_output()
+        # surgically strip the quic_transport_parameters extension: the
+        # server must refuse a ClientHello without it (RFC 9001 §8.2)
+        idx = ch.find(b"\x00\x39")
+        assert idx > 0
+        ln = int.from_bytes(ch[idx + 2:idx + 4], "big")
+        stripped = ch[:idx] + ch[idx + 4 + ln:]
+        # fix outer lengths: handshake body and extensions vector
+        body = bytearray(stripped[4:])
+        removed = 4 + ln
+        # extensions length sits right before the first extension; walk to it
+        p = 2 + 32  # version + random
+        p += 1 + body[p]          # session id
+        p += 2 + int.from_bytes(body[p:p + 2], "big")  # cipher suites
+        p += 1 + body[p]          # compression
+        ext_len = int.from_bytes(body[p:p + 2], "big") - removed
+        body[p:p + 2] = ext_len.to_bytes(2, "big")
+        fixed = bytes([stripped[0]]) + len(body).to_bytes(3, "big") + bytes(body)
+        with pytest.raises(TlsError, match="transport_parameters"):
+            server.on_data(level, fixed)
+
+    def test_alpn_is_mandatory(self):
+        # RFC 9001 §8.1: no ALPN agreement → handshake failure, on both
+        # sides; libp2p-tls requires "libp2p" specifically
+        ck, sk = _key(), _key()
+        client = TlsEngine("client", ck, b"\x01", alpn=b"not-libp2p")
+        server = TlsEngine("server", sk, b"\x02")
+        client.start()
+        (level, ch), = client.take_output()
+        with pytest.raises(TlsError, match="ALPN"):
+            server.on_data(level, ch)
+
+    def test_finished_tamper_detected(self):
+        ck, sk = _key(), _key()
+        client = TlsEngine("client", ck, b"\x01")
+        server = TlsEngine("server", sk, b"\x02")
+        client.start()
+        for level, data in client.take_output():
+            server.on_data(level, data)
+        outputs = server.take_output()
+        # server flight ends with Finished (type 20); corrupt its last byte
+        tampered = []
+        for level, data in outputs:
+            if data[0] == 20:
+                data = data[:-1] + bytes([data[-1] ^ 1])
+            tampered.append((level, data))
+        with pytest.raises(TlsError, match="Finished"):
+            for level, data in tampered:
+                client.on_data(level, data)
+
+
+# ---------------------------------------------------------------------------
+# endpoint + streams over real UDP sockets
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def endpoints():
+    eps = [q.QuicEndpoint(_key()) for _ in range(2)]
+    yield eps
+    for ep in eps:
+        ep.stop()
+
+
+class TestQuicEndpoint:
+    def test_dial_accept_echo(self, endpoints):
+        srv, cli = endpoints
+
+        def serve():
+            conn = srv.accept(timeout=10)
+            st = conn.accept_stream(timeout=10)
+            st.write(b"echo:" + st.read_until_eof(timeout=10))
+            st.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        assert conn.remote_peer_id == _pub_id(srv.identity_key)
+        st = conn.open_stream()
+        st.write(b"hello quic")
+        st.close()
+        assert st.read_until_eof(timeout=10) == b"echo:hello quic"
+
+    def test_identity_pinning(self, endpoints):
+        srv, cli = endpoints
+        with pytest.raises(q.QuicError, match="identity"):
+            cli.dial("127.0.0.1", srv.port, timeout=10,
+                     expected_peer_id=_pub_id(cli.identity_key))
+
+    def test_concurrent_streams(self, endpoints):
+        srv, cli = endpoints
+
+        def serve():
+            conn = srv.accept(timeout=10)
+            for _ in range(8):
+                st = conn.accept_stream(timeout=10)
+                threading.Thread(
+                    target=lambda st=st: (
+                        st.write(st.read_until_eof(timeout=10)[::-1]),
+                        st.close()),
+                    daemon=True).start()
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        oks = []
+
+        def one(i):
+            st = conn.open_stream()
+            msg = f"s{i}".encode() * 50
+            st.write(msg)
+            st.close()
+            oks.append(st.read_until_eof(timeout=10) == msg[::-1])
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15)
+        assert len(oks) == 8 and all(oks)
+
+    def test_bulk_transfer_crosses_windows(self, endpoints):
+        """> stream window AND > connection window: MAX_STREAM_DATA /
+        MAX_DATA credit flow keeps the transfer moving (RFC 9000 §4)."""
+        srv, cli = endpoints
+        blob = bytes(range(256)) * 20000  # 5 MB > both windows
+
+        def serve():
+            conn = srv.accept(timeout=10)
+            st = conn.accept_stream(timeout=10)
+            data = st.read_until_eof(timeout=60, limit=1 << 24)
+            st.write(len(data).to_bytes(8, "big"))
+            st.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        st = conn.open_stream()
+        st.write(blob, timeout=60)
+        st.close()
+        assert int.from_bytes(st.read(8, timeout=60), "big") == len(blob)
+
+    def test_reset_propagates(self, endpoints):
+        srv, cli = endpoints
+        got = {}
+
+        def serve():
+            conn = srv.accept(timeout=10)
+            st = conn.accept_stream(timeout=10)
+            try:
+                st.read(100, timeout=10)
+            except q.QuicStreamError as exc:
+                got["err"] = str(exc)
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        st = conn.open_stream()
+        st.write(b"partial")
+        st.reset()
+        deadline = time.time() + 5
+        while time.time() < deadline and "err" not in got:
+            time.sleep(0.05)
+        assert "reset" in got.get("err", ""), got
+
+    def test_connection_close_wakes_readers(self, endpoints):
+        srv, cli = endpoints
+
+        def serve():
+            srv.accept(timeout=10)
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        st = conn.open_stream()
+        st.write(b"x")
+        conn.close("test teardown")
+        with pytest.raises(q.QuicStreamError):
+            st.read(10, timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# libp2p over QUIC
+# ---------------------------------------------------------------------------
+
+TOPIC = "/eth2/00000000/beacon_block/ssz_snappy"
+
+
+class TestLibp2pOverQuic:
+    def test_rpc_and_mixed_transport_gossip(self):
+        """a --QUIC-- b --TCP-- c: req/resp over QUIC streams, gossip
+        relayed across the transport boundary.  The reference's node runs
+        both listeners from one behaviour the same way."""
+        a = Libp2pHost(heartbeat=False, quic_port=0)
+        b = Libp2pHost(heartbeat=False, quic_port=0)
+        c = Libp2pHost(heartbeat=False)
+        a.start(); b.start(); c.start()
+        try:
+            got = []
+            for h, nm in zip((a, b, c), "abc"):
+                h.subscribe(TOPIC,
+                            lambda p, pid, nm=nm: (got.append(nm), "accept")[1])
+            b.rpc_handlers["status"] = \
+                lambda req, pid: (rpc_mod.SUCCESS, b"ok:" + req)
+            conn_ab = a.dial_quic("127.0.0.1", b.quic_port,
+                                  expected_peer_id=b.peer_id)
+            assert conn_ab.peer_id == b.peer_id
+            b.dial("127.0.0.1", c.port)
+            time.sleep(0.5)
+            code, resp = conn_ab.request("status", b"\x09")
+            assert (code, resp) == (rpc_mod.SUCCESS, b"ok:\x09")
+            a.publish(TOPIC, b"payload" * 20)
+            deadline = time.time() + 8
+            while time.time() < deadline and not {"b", "c"} <= set(got):
+                time.sleep(0.05)
+            assert {"b", "c"} <= set(got), got
+        finally:
+            a.stop(); b.stop(); c.stop()
+
+    def test_quic_identity_pinning_via_host(self):
+        a = Libp2pHost(heartbeat=False, quic_port=0)
+        b = Libp2pHost(heartbeat=False, quic_port=0)
+        a.start(); b.start()
+        try:
+            with pytest.raises(Exception, match="identity"):
+                a.dial_quic("127.0.0.1", b.quic_port,
+                            expected_peer_id=a.peer_id)
+            assert b.peer_id not in a.connections
+        finally:
+            a.stop(); b.stop()
