@@ -20,7 +20,7 @@ backend is unavailable, a CPU-XLA fallback measurement runs in a fresh
 subprocess so the round always records a real measured number, clearly
 labeled with the device it came from and the TPU error alongside.
 
-Env knobs: BENCH_BATCH (default 4096 — the measured sweet spot, PERF.md),
+Env knobs: BENCH_BATCH (default 8192 — the measured best, PERF.md),
 BENCH_ITERS (default 3), BENCH_CPU_BATCH (default 64),
 BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT.
 """
@@ -68,7 +68,7 @@ def _arm_watchdog(seconds: float, stage: str):
 def run_measurement(force_cpu: bool) -> None:
     """Child mode: measure on the chosen platform, print one JSON line."""
     B = int(
-        os.environ.get("BENCH_BATCH", "4096")
+        os.environ.get("BENCH_BATCH", "8192")
         if not force_cpu
         else os.environ.get("BENCH_CPU_BATCH", "64")
     )

@@ -45,6 +45,7 @@ class BeaconNode:
         http_port: int = 0,
         tcp_port: int = 0,
         udp_port: int | None = None,
+        quic_port: int | None = None,
         store=None,
         slasher: bool = False,
         execution=None,
@@ -60,8 +61,9 @@ class BeaconNode:
         )
         self._gvr = bytes(genesis_state.genesis_validators_root)
         self.digest = topics_mod.fork_digest(spec, 0, self._gvr)
-        # 2. transports
-        self.host = Libp2pHost(port=tcp_port)
+        # 2. transports (TCP always; QUIC beside it when configured —
+        # the reference's service builds the same pair, utils.rs:39-48)
+        self.host = Libp2pHost(port=tcp_port, quic_port=quic_port)
         self.discovery = None
         if udp_port is not None:
             from ..network.discv5 import Discv5Service
@@ -80,6 +82,7 @@ class BeaconNode:
                 ip4="127.0.0.1",
                 udp=self.discovery.port,
                 tcp=self.host.port,
+                quic=self.host.quic_port,
                 extra={b"eth2": self.digest + bytes(12)},
             )
         # 3. gossip subscriptions -> chain (one family per fork digest;
@@ -217,6 +220,7 @@ class BeaconNode:
                 ip4="127.0.0.1",
                 udp=self.discovery.port,
                 tcp=self.host.port,
+                quic=self.host.quic_port,
                 extra={b"eth2": new + bytes(12)},
             )
         return True
@@ -286,7 +290,12 @@ class BeaconNode:
         for rec in found:
             eth2 = rec.kv.get(b"eth2")
             tcp = rec.tcp_port
-            if eth2 is None or eth2[:4] != self.digest or tcp is None:
+            quic_ok = (self.host.quic is not None
+                       and rec.quic_port is not None)
+            # dialable = any transport both ends speak: TCP, or QUIC-only
+            # records when this node runs QUIC too
+            if (eth2 is None or eth2[:4] != self.digest
+                    or (tcp is None and not quic_ok)):
                 continue
             nid = rec.node_id
             if nid in self._dialed:
@@ -297,9 +306,24 @@ class BeaconNode:
 
                 pub = rec.kv.get(b"secp256k1")
                 expected = peer_id_from_pubkey(pub) if pub else None
-                conn = self.host.dial(
-                    rec.ip4 or "127.0.0.1", tcp, expected_peer_id=expected
-                )
+                conn = None
+                # prefer QUIC when both ends run it (one handshake, no
+                # separate muxer negotiation); TCP stays the fallback
+                if quic_ok:
+                    try:
+                        conn = self.host.dial_quic(
+                            rec.ip4 or "127.0.0.1", rec.quic_port,
+                            expected_peer_id=expected,
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        log.debug("QUIC dial %s failed (%s); trying TCP",
+                                  nid.hex()[:8], exc)
+                        if tcp is None:
+                            raise
+                if conn is None:
+                    conn = self.host.dial(
+                        rec.ip4 or "127.0.0.1", tcp, expected_peer_id=expected
+                    )
                 self._status_handshake(conn)
                 # only a COMPLETED handshake counts as a usable peer and
                 # excludes it from future rounds; failures stay retryable
@@ -801,6 +825,7 @@ class BeaconNode:
             ip4="127.0.0.1",
             udp=self.discovery.port,
             tcp=self.host.port,
+            quic=self.host.quic_port,
             extra={b"eth2": self.digest + bytes(12), b"attnets": attnets},
         )
 

@@ -83,7 +83,11 @@ def _wide_square(a):
         p = a[i][None, :] * tail  # a_i * a_j, j >= i
         # double the cross terms (j > i); diagonal stays single.
         # products < QMAX^2 ~ 2^30.01, doubled < 2^31.1: no overflow.
-        p = jnp.concatenate([p[:1], p[1:] + p[1:]], axis=0)
+        # i=25 has no cross terms: p[1:] would be a zero-row vector,
+        # which real Mosaic lowering rejects ("vector types must have
+        # positive constant sizes") even though interpret mode allows it
+        if p.shape[0] > 1:
+            p = jnp.concatenate([p[:1], p[1:] + p[1:]], axis=0)
         plo = p & MASK
         phi = p >> 15
         acc = _acc_add(acc, plo, 2 * i)

@@ -122,6 +122,13 @@ class Enr:
         return rlp.decode_uint(raw) if raw is not None else None
 
     @property
+    def quic_port(self) -> int | None:
+        """The QUIC/UDP listening port (reference: `discovery/enr.rs`
+        advertises libp2p-quic under the "quic" key)."""
+        raw = self.kv.get(b"quic")
+        return rlp.decode_uint(raw) if raw is not None else None
+
+    @property
     def tcp_port(self) -> int | None:
         raw = self.kv.get(b"tcp")
         return rlp.decode_uint(raw) if raw is not None else None
@@ -194,6 +201,7 @@ def build_enr(
     ip4: str | None = None,
     udp: int | None = None,
     tcp: int | None = None,
+    quic: int | None = None,
     extra: dict | None = None,
 ) -> Enr:
     """Create and sign a record for ``key`` (v4 identity scheme)."""
@@ -204,6 +212,8 @@ def build_enr(
         kv[b"udp"] = rlp.encode_uint(udp)
     if tcp is not None:
         kv[b"tcp"] = rlp.encode_uint(tcp)
+    if quic is not None:
+        kv[b"quic"] = rlp.encode_uint(quic)
     for k, v in (extra or {}).items():
         kv[k] = v
     rec = Enr(seq=seq, kv=kv)

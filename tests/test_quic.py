@@ -451,3 +451,86 @@ class TestLibp2pOverQuic:
             assert b.peer_id not in a.connections
         finally:
             a.stop(); b.stop()
+
+
+# ---------------------------------------------------------------------------
+# node level: discovery-advertised QUIC, sync + follow over it
+# ---------------------------------------------------------------------------
+
+class TestNodeOverQuic:
+    def test_discover_dial_sync_follow_over_quic(self):
+        """Two beacon nodes with QUIC enabled: the ENR advertises the
+        "quic" key (ref `discovery/enr.rs`), discovery finds it, the
+        dialer PREFERS QUIC, range sync and gossip follow ride QUIC
+        streams end to end — no TCP connection between the nodes."""
+        from lighthouse_tpu.beacon.node import BeaconNode
+        from lighthouse_tpu.consensus import spec as S
+        from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+        from lighthouse_tpu.network.discv5 import BootNode
+
+        spec = phase0_spec(S.MINIMAL)
+        state, keypairs = interop_state(16, spec, fork="altair")
+        boot = BootNode()
+        a = BeaconNode(spec, state, keypairs=keypairs, udp_port=0,
+                       quic_port=0)
+        b = BeaconNode(spec, state, keypairs=keypairs, udp_port=0,
+                       quic_port=0)
+        boot.start(); a.start(); b.start()
+        try:
+            assert a.discovery.enr.quic_port == a.host.quic_port
+            for slot in range(1, 4):
+                a.produce_and_publish(slot)
+            a.bootstrap([boot.enr])
+            b.bootstrap([boot.enr])
+            assert b.discover_and_dial() == 1
+            # the connection is the QUIC one: no raw TCP socket on it
+            conn = next(iter(b.host.connections.values()))
+            assert conn.sock is None, "dial must have preferred QUIC"
+            deadline = time.time() + 15
+            while (time.time() < deadline
+                   and int(b.chain.head_state().slot) < 3):
+                time.sleep(0.1)
+            assert int(b.chain.head_state().slot) == 3
+            assert b.chain.head_root == a.chain.head_root
+            time.sleep(1.2)  # a heartbeat so gossip meshes form
+            a.produce_and_publish(4)
+            deadline = time.time() + 15
+            while (time.time() < deadline
+                   and b.chain.head_root != a.chain.head_root):
+                time.sleep(0.1)
+            assert b.chain.head_root == a.chain.head_root, \
+                "gossip follow over QUIC"
+        finally:
+            a.stop(); b.stop(); boot.stop()
+
+
+class TestResilience:
+    def test_tls_errors_are_protocol_errors(self):
+        # TlsError must be a QuicError so a failed handshake takes the
+        # per-packet close path (CONNECTION_CLOSE) instead of escaping
+        # to the rx loop's blanket logger
+        assert issubclass(TlsError, q.QuicError)
+
+    def test_keepalive_outlives_idle_timeout(self, endpoints, monkeypatch):
+        """A quiet connection must NOT idle out: keepalive PINGs flow
+        well inside the timeout and the peer's ACKs refresh last_rx."""
+        monkeypatch.setattr(q, "IDLE_TIMEOUT", 2.0)
+        srv, cli = endpoints
+        holder = {}
+
+        def serve():
+            holder["conn"] = srv.accept(timeout=10)
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        time.sleep(6.0)  # 3x the idle timeout, zero application traffic
+        assert not conn._closed, conn.close_reason
+        assert not holder["conn"]._closed, holder["conn"].close_reason
+        # and the connection still works
+        def echo():
+            st = holder["conn"].accept_stream(timeout=10)
+            st.write(st.read_until_eof(timeout=10)); st.close()
+        threading.Thread(target=echo, daemon=True).start()
+        st = conn.open_stream()
+        st.write(b"still-alive"); st.close()
+        assert st.read_until_eof(timeout=10) == b"still-alive"

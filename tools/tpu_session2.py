@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Round-5 follow-up TPU session: the post-fix chains A/B + final warm.
+
+The first keeper session (TPU_SESSION_r05.jsonl, 03:49-04:32Z) settled
+miller (WIN, now default-on) and h2c (loses the kernel A/B at B=512),
+but the chains stage crashed in real Mosaic lowering on a zero-row
+vector `_wide_square` emitted at i=25 — a bug interpret mode cannot
+see, fixed in-round.  This session, serialized like the first:
+
+  1. B=512 chains=1 miller=0 — does the FIXED chain kernel compile and
+     beat the 2,606.6 sets/s baseline?
+  2. if it wins: B=512 chains=1 miller=1 — do the two levers compose?
+  3. B=8192 in the final default config — re-warms .jax_cache for the
+     driver's round-end bench (the _wide_square fix changed the miller
+     kernels' program hash too) and produces the headline number.
+
+Appends to the same TPU_SESSION_r05.jsonl ledger.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_session import log, ok, run_bench_child  # noqa: E402
+
+BASELINE_B512 = 2606.6   # keeper session 03:52Z, chains=0 miller=0
+MILLER_B512 = 3060.9     # keeper session 04:10Z, chains=0 miller=1
+
+
+def main() -> None:
+    log({"stage": "session2 start (post-fix chains A/B)", "pid": os.getpid()})
+
+    chains = run_bench_child(512, chains=True, miller=False, timeout=5500)
+    chains_compiles = ok(chains)
+    chains_win = chains_compiles and chains["value"] > BASELINE_B512
+    log({
+        "stage": "post-fix chains verdict",
+        "chains_on": (chains or {}).get("value"),
+        "baseline_off": BASELINE_B512,
+        "compiles": chains_compiles,
+        "chains_win": chains_win,
+    })
+
+    composed_win = False
+    if chains_win:
+        both = run_bench_child(512, chains=True, miller=True, timeout=7000)
+        composed_win = ok(both) and both["value"] > MILLER_B512
+        log({
+            "stage": "chains+miller compose verdict",
+            "both_on": (both or {}).get("value"),
+            "miller_only": MILLER_B512,
+            "composed_win": composed_win,
+        })
+
+    final_chains = chains_win and composed_win
+    run_bench_child(8192, chains=final_chains, miller=True, timeout=7000)
+    log({"stage": "session2 done", "final_chains_default": final_chains})
+
+
+if __name__ == "__main__":
+    main()
