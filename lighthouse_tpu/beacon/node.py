@@ -282,7 +282,9 @@ class BeaconNode:
 
     def discover_and_dial(self) -> int:
         """One discovery round: lookup, dial every new peer advertising
-        our fork digest and a TCP port (subnet_predicate analog)."""
+        our fork digest plus a transport both ends speak — TCP, or
+        QUIC-only records when this node runs QUIC (subnet_predicate
+        analog; QUIC preferred when both are available)."""
         if self.discovery is None:
             return 0
         found = self.discovery.lookup()

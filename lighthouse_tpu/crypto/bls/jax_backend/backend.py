@@ -111,9 +111,22 @@ def _verify_kernel(pk_aff, sig_aff, h_aff, wbits):
 
     # 1. signature subgroup checks (blst.rs:71-81)
     ok_sub = jnp.all(P.g2_subgroup_check(sig_aff))
-    # 2. weight scalar muls
-    wpk = P.scalar_mul_bits(P.FP_OPS, P.from_affine(P.FP_OPS, pk_aff), wbits)
-    wsig = P.scalar_mul_bits(P.FP2_OPS, P.from_affine(P.FP2_OPS, sig_aff), wbits)
+    # 2. weight scalar muls (the dispatch leader after the fused Miller
+    # loop: LIGHTHOUSE_TPU_WSM runs each double-and-add bit as one
+    # Mosaic program per curve — pallas_wsm.py)
+    if F.wsm_fused_active():
+        from . import pallas_wsm
+
+        no_inf = jnp.zeros(wbits.shape[1:], dtype=bool)
+        wpk = pallas_wsm.scalar_mul_bits_fused(
+            P.FP_OPS, pk_aff, no_inf, wbits)
+        wsig = pallas_wsm.scalar_mul_bits_fused(
+            P.FP2_OPS, sig_aff, no_inf, wbits)
+    else:
+        wpk = P.scalar_mul_bits(
+            P.FP_OPS, P.from_affine(P.FP_OPS, pk_aff), wbits)
+        wsig = P.scalar_mul_bits(
+            P.FP2_OPS, P.from_affine(P.FP2_OPS, sig_aff), wbits)
     # 3. signature accumulation: S = sum_i [r_i] sig_i
     S = _tree_reduce_g2(wsig)
     s_inf = P.pt_is_infinity(P.FP2_OPS, S)

@@ -364,6 +364,38 @@ def chains_active() -> bool:
     )
 
 
+_WSM_MODE: bool | None = None
+
+
+def wsm_enabled() -> bool:
+    """LIGHTHOUSE_TPU_WSM=1 routes the 64-bit weight scalar muls through
+    the fused double-and-add step kernels (pallas_wsm.py;
+    interpret-proven — flips to default-on once measured on hardware).
+    After the fused Miller loop these became the dispatch leader
+    (~900 stacked pallas calls per batch)."""
+    global _WSM_MODE
+    if _WSM_MODE is None:
+        import os
+
+        _WSM_MODE = os.environ.get("LIGHTHOUSE_TPU_WSM", "") == "1"
+    return _WSM_MODE
+
+
+def set_wsm(enabled: bool) -> None:
+    """In-process A/B toggle (mirrors set_chains)."""
+    global _WSM_MODE
+    _WSM_MODE = enabled
+
+
+def wsm_fused_active() -> bool:
+    """Gate for the fused scalar-mul step kernels: pallas on + opted in
+    + a real TPU backend (interpret mode is reached explicitly by
+    tests)."""
+    return (
+        pallas_enabled() and wsm_enabled() and jax.default_backend() == "tpu"
+    )
+
+
 _MILLER_MODE: bool | None = None
 
 

@@ -1,0 +1,325 @@
+"""Fused weight-scalar-mul step kernels (LIGHTHOUSE_TPU_WSM-gated).
+
+After the fused Miller loop landed (pallas_miller.py, measured +17-35%
+on chip), the 64-bit weight scalar multiplications became the dispatch
+leader: `points.scalar_mul_bits` runs a 64-step `lax.scan` whose body
+issues ~7 stacked `pallas_call` groups per curve (double 7 muls, add 16,
+selects) — ~900 dispatches per batch verify against the Miller loop's
+~126.  Here each double-and-always-add step runs as ONE Mosaic program
+per curve: Jacobian double + MIXED add (the base point arrives affine,
+so Z2=1 drops 5 of the generic add's 16 muls) + bit/infinity selects,
+every intermediate in VMEM under pallas_miller's in-kernel lazy-bound
+discipline (KFp / k2_*).  64 steps -> 128 dispatches for both curves.
+
+The mixed-add formulas compute the exact same Jacobian representative
+as `points._raw_add` specialised to Z2=1 (U1=X1, S1=Y1, W-Z1Z1-Z2Z2 =
+2*Z1), so the fused path is value-identical coordinate-wise, not just
+equivalent-as-a-point — the differential tests assert canonical
+equality of X, Y, Z and the infinity flags.
+
+Capability twin of blst's scalar multiplication inside
+`verify_multiple_aggregate_signatures` (crypto/bls/src/impls/blst.rs:
+35-117); the batching/weights design is backend.py's.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import fp as F
+from . import pallas_fp as PF
+from .pallas_miller import (
+    N_CONSTS,
+    _const_arrays,
+    _Ctx,
+    _pad_flat,
+    KFp,
+    k2_add,
+    k2_dbl,
+    k2_guard,
+    k2_mul,
+    k2_neg,
+    k2_reduce,
+    k2_select,
+    k2_sqr,
+    k2_sub,
+    kadd,
+    kdbl,
+    kguard,
+    kmul,
+    kneg,
+    kreduce,
+    kselect,
+    ksqr,
+    ksub,
+)
+
+N = F.N
+LANE_TILE = PF.LANE_TILE
+
+
+# ---------------------------------------------------------------------------
+# in-kernel field namespaces mirroring points.FP_OPS / FP2_OPS
+# ---------------------------------------------------------------------------
+
+class _K1:
+    ncoords = 1
+
+    @staticmethod
+    def read(ins, base, i):
+        return KFp(ins[base + i][:], 2.0)
+
+    add = staticmethod(kadd)
+    sub = staticmethod(ksub)
+    dbl = staticmethod(kdbl)
+    mul = staticmethod(kmul)
+    sqr = staticmethod(ksqr)
+    neg = staticmethod(kneg)
+    reduce = staticmethod(kreduce)
+    select = staticmethod(kselect)
+
+    @staticmethod
+    def guard(ctx, a, m: float = 11.0):
+        return kguard(ctx, a, m)
+
+    @staticmethod
+    def lanes(v):
+        return [v]
+
+
+class _K2:
+    ncoords = 2
+
+    @staticmethod
+    def read(ins, base, i):
+        return (KFp(ins[base + 2 * i][:], 2.0),
+                KFp(ins[base + 2 * i + 1][:], 2.0))
+
+    add = staticmethod(k2_add)
+    sub = staticmethod(k2_sub)
+    dbl = staticmethod(k2_dbl)
+    mul = staticmethod(k2_mul)
+    sqr = staticmethod(k2_sqr)
+    neg = staticmethod(k2_neg)
+    reduce = staticmethod(k2_reduce)
+    select = staticmethod(k2_select)
+
+    @staticmethod
+    def guard(ctx, a, m: float = 11.0):
+        return k2_guard(ctx, a, m)
+
+    @staticmethod
+    def lanes(v):
+        return [v[0], v[1]]
+
+
+# ---------------------------------------------------------------------------
+# in-kernel point formulas (points.py twins; see module docstring for the
+# representative-equality argument)
+# ---------------------------------------------------------------------------
+
+def _k_jac_double(K, ctx, X, Y, Z):
+    """points.jac_double, in-kernel: 7 muls/sqrs + carries."""
+    A = K.sqr(ctx, X)
+    B = K.sqr(ctx, Y)
+    YZ = K.mul(ctx, Y, Z)
+    E = K.add(ctx, K.dbl(ctx, A), A)
+    XB = K.add(ctx, X, B)
+    C = K.sqr(ctx, B)
+    t = K.sqr(ctx, K.guard(ctx, XB))
+    Fv = K.sqr(ctx, K.guard(ctx, E))
+    D = K.dbl(ctx, K.sub(ctx, K.sub(ctx, t, A), C))
+    X3 = K.sub(ctx, Fv, K.dbl(ctx, D))
+    m = K.mul(ctx, K.guard(ctx, E), K.guard(ctx, K.sub(ctx, D, X3)))
+    C8 = K.dbl(ctx, K.dbl(ctx, K.dbl(ctx, C)))
+    Y3 = K.sub(ctx, m, C8)
+    Z3 = K.dbl(ctx, YZ)
+    return (K.reduce(ctx, X3), K.reduce(ctx, Y3), K.reduce(ctx, Z3))
+
+
+def _k_mixed_add(K, ctx, X1, Y1, Z1, x2, y2):
+    """points._raw_add with Z2 = 1 (affine base): 11 muls/sqrs.
+
+    Z2=1 makes Z2Z2=1, U1=X1, S1=Y1, and the Z3 pre-factor
+    (Z1+Z2)^2 - Z1Z1 - Z2Z2 collapse to 2*Z1 — identical VALUES to the
+    generic path, five fewer products.
+    """
+    Z1Z1 = K.sqr(ctx, Z1)
+    U2 = K.mul(ctx, x2, Z1Z1)
+    Z1cu = K.mul(ctx, Z1, Z1Z1)
+    S2 = K.mul(ctx, y2, Z1cu)
+    H = K.sub(ctx, U2, X1)
+    rr = K.dbl(ctx, K.sub(ctx, S2, Y1))
+    H2 = K.dbl(ctx, H)
+    I = K.sqr(ctx, K.guard(ctx, H2))
+    J = K.mul(ctx, K.guard(ctx, H), I)
+    V = K.mul(ctx, X1, I)
+    rr2 = K.sqr(ctx, K.guard(ctx, rr))
+    X3 = K.sub(ctx, K.sub(ctx, rr2, J), K.dbl(ctx, V))
+    m1 = K.mul(ctx, K.guard(ctx, rr), K.guard(ctx, K.sub(ctx, V, X3)))
+    m2 = K.mul(ctx, Y1, J)
+    Y3 = K.sub(ctx, m1, K.dbl(ctx, m2))
+    Z3 = K.mul(ctx, K.dbl(ctx, Z1), K.guard(ctx, H))
+    return (K.reduce(ctx, X3), K.reduce(ctx, Y3), K.reduce(ctx, Z3))
+
+
+def _pt_select_lanes(K, mask, a_pt, b_pt):
+    return tuple(K.select(mask, a, b) for a, b in zip(a_pt, b_pt))
+
+
+def _make_step_kernel(K):
+    """One double-and-always-add bit for one curve, flags included.
+
+    refs in:  acc coords (3*ncoords planes), acc_inf (1,T),
+              base affine (2*ncoords planes), base_inf (1,T),
+              bit (1,T), one (the Montgomery 1 for Z of a lifted base),
+              consts
+    refs out: coords' (3*ncoords), inf' (1,T)
+    """
+    nc = K.ncoords
+    n_acc = 3 * nc
+    n_base = 2 * nc
+
+    def kernel(*refs):
+        n_in = n_acc + 1 + n_base + 1 + 1 + N_CONSTS
+        ins, outs = refs[:n_in], refs[n_in:]
+        ctx = _Ctx(ins[n_acc + 1 + n_base + 1 + 1:])
+        acc = tuple(K.read(ins, 0, i) for i in range(3))
+        inf_acc = ins[n_acc][:]           # (1, T) uint32
+        base = tuple(K.read(ins, n_acc + 1, i) for i in range(2))
+        inf_base = ins[n_acc + 1 + n_base][:]
+        bit = ins[n_acc + 1 + n_base + 1][:]
+
+        dbl_pt = _k_jac_double(K, ctx, *acc)
+        add_pt = _k_mixed_add(K, ctx, *dbl_pt, *base)
+        # jac_add_fast's flag discipline: base at infinity keeps the
+        # doubled acc; acc at infinity takes the (lifted) base
+        add_pt = _pt_select_lanes(K, inf_base, dbl_pt, add_pt)
+        base_jac = (base[0], base[1], _base_z_one(K, ctx))
+        add_pt = _pt_select_lanes(K, inf_acc, base_jac, add_pt)
+        inf_add = inf_acc & inf_base
+
+        out_pt = _pt_select_lanes(K, bit, add_pt, dbl_pt)
+        inf_out = jnp.where(bit != 0, inf_add, inf_acc)
+
+        lanes = []
+        for v in out_pt:
+            lanes += K.lanes(v)
+        for ref, v in zip(outs[:n_acc], lanes):
+            assert v.bound <= 2.0
+            ref[:] = v.cols
+        outs[n_acc][:] = inf_out
+
+    return kernel
+
+
+def _base_z_one(K, ctx):
+    """Z = 1 (Montgomery one) for lifting the affine base to Jacobian."""
+    if K.ncoords == 1:
+        return KFp(ctx.one, 2.0)
+    zero = KFp(ctx.one - ctx.one, 1.0)
+    return (KFp(ctx.one, 2.0), zero)
+
+
+@functools.lru_cache(maxsize=8)
+def _step_call(ncoords: int, n_padded: int, tile: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    K = _K1 if ncoords == 1 else _K2
+    grid = (n_padded // tile,)
+    spec = pl.BlockSpec((N, tile), lambda i: (0, i), memory_space=pltpu.VMEM)
+    flag_spec = pl.BlockSpec((1, tile), lambda i: (0, i),
+                             memory_space=pltpu.VMEM)
+    const_spec = pl.BlockSpec((N, tile), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+    n_acc = 3 * ncoords
+    n_base = 2 * ncoords
+    in_specs = ([spec] * n_acc + [flag_spec] + [spec] * n_base
+                + [flag_spec] + [flag_spec] + [const_spec] * N_CONSTS)
+    out_shape = tuple(
+        jax.ShapeDtypeStruct((N, n_padded), jnp.uint32)
+        for _ in range(n_acc)
+    ) + (jax.ShapeDtypeStruct((1, n_padded), jnp.uint32),)
+    return pl.pallas_call(
+        _make_step_kernel(K),
+        out_shape=out_shape,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(spec,) * n_acc + (flag_spec,),
+        interpret=interpret,
+    )
+
+
+def scalar_mul_bits_fused(ops, p_aff, inf_base, wbits):
+    """[k]P per lane, fused step kernels; drop-in for
+    `points.scalar_mul_bits(ops, from_affine(ops, p_aff), wbits)`.
+
+    ``p_aff``: affine (x, y) field elements (LFp / fp2 pairs);
+    ``inf_base``: (*batch,) bool — lanes whose base is the identity;
+    ``wbits``: (nbits, *batch) MSB-first scalar bits.
+    Returns a Jacobian point tuple exactly like scalar_mul_bits.
+    """
+    from . import points as P
+
+    ncoords = ops.ncoords
+    interpret = jax.default_backend() != "tpu"
+
+    def pin(c):
+        return F.relabel(F.guard_le(c, 2.0), 2.0)
+
+    coords = [pin(c) for xy in p_aff for c in ops.lanes(xy)]
+    batch = F.batch_shape(coords[0])
+
+    def flat(x: F.LFp):
+        return x.limbs.reshape(N, -1)
+
+    base_lanes = [flat(c) for c in coords]
+    n = base_lanes[0].shape[-1]
+    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+
+    one = F.one_like(coords[0])
+    zero = F.zero_like(coords[0])
+    # acc starts at pt_infinity_like: (one, one, zero) + flag set
+    acc_lanes = ([flat(one)] * ncoords + [flat(one)] * ncoords
+                 + [flat(zero)] * ncoords)
+    inf_acc = jnp.ones((1, n), dtype=jnp.uint32)
+    inf_b = jnp.asarray(inf_base, dtype=jnp.uint32).reshape(1, -1)
+
+    all_in, n0, n_padded = _pad_flat(
+        acc_lanes + [inf_acc] + base_lanes + [inf_b], tile
+    )
+    n_acc = 3 * ncoords
+    acc_arr = jnp.stack(all_in[:n_acc])
+    inf_acc_p = all_in[n_acc]
+    base_arr = jnp.stack(all_in[n_acc + 1:n_acc + 1 + 2 * ncoords])
+    inf_b_p = all_in[-1]
+
+    call = _step_call(ncoords, n_padded, tile, interpret)
+    consts = _const_arrays(tile)
+    bits = wbits.reshape(wbits.shape[0], -1).astype(jnp.uint32)
+    bits = jnp.pad(bits, ((0, 0), (0, n_padded - n0))) if n_padded != n0 \
+        else bits
+
+    def step(carry, bit):
+        acc_arr, inf_acc_p = carry
+        outs = call(*[acc_arr[i] for i in range(n_acc)], inf_acc_p,
+                    *[base_arr[i] for i in range(2 * ncoords)], inf_b_p,
+                    bit.reshape(1, -1), *consts)
+        return (jnp.stack(outs[:n_acc]), outs[n_acc]), None
+
+    (acc_arr, inf_acc_p), _ = jax.lax.scan(step, (acc_arr, inf_acc_p), bits)
+
+    def unflat(i):
+        return F.LFp(acc_arr[i][:, :n0].reshape((N,) + batch), 2.0)
+
+    out_coords = [unflat(i) for i in range(n_acc)]
+    pt = tuple(
+        ops.unlanes(out_coords[i * ncoords:(i + 1) * ncoords])
+        for i in range(3)
+    )
+    inf_out = inf_acc_p[0, :n0].reshape(batch).astype(bool)
+    return pt + (inf_out,)

@@ -1,0 +1,110 @@
+"""Fused weight-scalar-mul step kernels vs the oracle curve (interpret).
+
+Like the chain-kernel proofs (test_pallas_fp), these run the exact
+Mosaic program on CPU via pallas interpret mode — and like them they are
+opt-in: interpret compiles of the fused step kernels take minutes on a
+1-core host, so the file is env-gated and run standalone:
+
+    LIGHTHOUSE_TPU_WSM=1 python -m pytest tests/test_pallas_wsm.py
+
+Correctness claim being proven: `pallas_wsm.scalar_mul_bits_fused`
+computes the same point as `points.scalar_mul_bits` after
+`from_affine` — including infinity-flag discipline — for the production
+shape (64-bit MSB-first weight bits, blst.rs:14's RAND_BITS).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from lighthouse_tpu.crypto.bls import params  # noqa: E402
+from lighthouse_tpu.crypto.bls.curve import (  # noqa: E402
+    Fp,
+    Fp2,
+    G1_GENERATOR,
+    G2_GENERATOR,
+    affine_mul,
+)
+from lighthouse_tpu.crypto.bls.jax_backend import points as P  # noqa: E402
+from lighthouse_tpu.crypto.bls.jax_backend import (  # noqa: E402
+    pallas_wsm as W,
+)
+
+_WSM_OPTIN = pytest.mark.skipif(
+    os.environ.get("LIGHTHOUSE_TPU_WSM", "") != "1",
+    reason="fused-wsm interpret proofs are multi-minute compiles; run "
+    "this file standalone with LIGHTHOUSE_TPU_WSM=1",
+)
+
+rng = random.Random(0x5CA1A)
+
+
+def _bits(ks, nbits):
+    out = np.zeros((nbits, len(ks)), dtype=np.uint32)
+    for j, k in enumerate(ks):
+        for i, c in enumerate(bin(k)[2:].zfill(nbits)):
+            out[i, j] = int(c)
+    return jnp.asarray(out)
+
+
+@_WSM_OPTIN
+def test_g1_fused_matches_oracle_64bit():
+    """The production shape: 64-bit nonzero weights on G1."""
+    B = 4
+    pts = [affine_mul(G1_GENERATOR, rng.randrange(1, params.R), Fp)
+           for _ in range(B)]
+    ks = [1, 2, rng.randrange(1, 2**64), 2**63 + 5]  # edges + random
+    got = P.g1_decode_jac(W.scalar_mul_bits_fused(
+        P.FP_OPS, P.g1_encode(pts), np.zeros(B, bool), _bits(ks, 64)))
+    assert got == [affine_mul(a, k, Fp) for a, k in zip(pts, ks)]
+
+
+@_WSM_OPTIN
+def test_g1_fused_matches_xla_path():
+    """Differential against the in-repo XLA scan path, not just the
+    oracle — the two must agree lane for lane."""
+    B = 3
+    pts = [affine_mul(G1_GENERATOR, rng.randrange(1, params.R), Fp)
+           for _ in range(B)]
+    ks = [rng.randrange(1, 2**16) for _ in range(B)]
+    bits = _bits(ks, 16)
+    aff = P.g1_encode(pts)
+    fused = P.g1_decode_jac(W.scalar_mul_bits_fused(
+        P.FP_OPS, aff, np.zeros(B, bool), bits))
+    xla = P.g1_decode_jac(P.scalar_mul_bits(
+        P.FP_OPS, P.from_affine(P.FP_OPS, aff), bits))
+    assert fused == xla
+
+
+@_WSM_OPTIN
+def test_g2_fused_matches_oracle():
+    B = 3
+    pts = [affine_mul(G2_GENERATOR, rng.randrange(1, params.R), Fp2)
+           for _ in range(B)]
+    ks = [rng.randrange(1, 2**16) for _ in range(B)]
+    got = P.g2_decode_jac(W.scalar_mul_bits_fused(
+        P.FP2_OPS, P.g2_encode(pts), np.zeros(B, bool), _bits(ks, 16)))
+    assert got == [affine_mul(a, k, Fp2) for a, k in zip(pts, ks)]
+
+
+@_WSM_OPTIN
+def test_infinity_base_lanes_stay_infinite():
+    """Lanes whose base is the identity must come out infinite without
+    poisoning neighbours (the in-kernel flag discipline)."""
+    B = 4
+    pts = [affine_mul(G1_GENERATOR, rng.randrange(1, params.R), Fp)
+           for _ in range(B)]
+    inf_base = np.array([False, True, False, True])
+    ks = [rng.randrange(1, 2**8) for _ in range(B)]
+    got = P.g1_decode_jac(W.scalar_mul_bits_fused(
+        P.FP_OPS, P.g1_encode(pts), inf_base, _bits(ks, 8)))
+    for i in range(B):
+        if inf_base[i]:
+            assert got[i] is None
+        else:
+            assert got[i] == affine_mul(pts[i], ks[i], Fp)
