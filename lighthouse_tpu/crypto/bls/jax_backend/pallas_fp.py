@@ -31,6 +31,14 @@ from . import fp as F
 
 LANE_TILE = 512  # lanes per grid step (multiple of 128)
 
+
+def pick_tile(n: int) -> int:
+    """The ONE tiling rule for lane-padded pallas calls: full LANE_TILE
+    when the batch fills it, else the smallest 128-multiple cover —
+    every fused kernel family must pad identically or their operands
+    misalign."""
+    return LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+
 _P_COLS = np.asarray(F.int_to_limbs(F.P_INT)).reshape(26, 1)
 _PP_COLS = np.asarray(F.int_to_limbs(F.PPRIME_INT)).reshape(26, 1)
 
@@ -253,7 +261,7 @@ def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
     exit are <= ~18P (callers re-reduce)."""
     assert bits and bits[0] == 1
     n = a0_limbs.shape[-1]
-    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    tile = pick_tile(n)
     n_padded = -(-n // tile) * tile
     if n_padded != n:
         pad = ((0, 0), (0, n_padded - n))
@@ -305,7 +313,7 @@ def pow_chain_limbs(base_limbs, exponent: int, interpret: bool = False):
     strict×strict, far under the bound-product ceiling)."""
     bits = [c == "1" for c in bin(exponent)[2:]]
     n = base_limbs.shape[-1]
-    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    tile = pick_tile(n)
     n_padded = -(-n // tile) * tile
     if n_padded != n:
         base_limbs = jnp.pad(base_limbs, ((0, 0), (0, n_padded - n)))
@@ -330,7 +338,7 @@ def mont_mul_limbs(a_limbs, b_limbs, interpret: bool = False):
     """(26, N) x (26, N) quasi limbs -> (26, N) strict Montgomery product.
     Pads N up to a lane multiple; slices back."""
     n = a_limbs.shape[-1]
-    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    tile = pick_tile(n)
     n_padded = -(-n // tile) * tile
     if n_padded != n:
         pad = ((0, 0), (0, n_padded - n))

@@ -554,7 +554,7 @@ def miller_loop_fused(p_aff, q_aff):
         return x.limbs.reshape(N, -1)
 
     n = flat(xp).shape[-1]
-    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    tile = PF.pick_tile(n)
 
     one2 = tuple(F.relabel(c, 2.0) for c in T.fp2_one_like(q0))
     f_init = (

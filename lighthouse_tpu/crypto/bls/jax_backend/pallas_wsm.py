@@ -279,7 +279,7 @@ def scalar_mul_bits_fused(ops, p_aff, inf_base, wbits):
 
     base_lanes = [flat(c) for c in coords]
     n = base_lanes[0].shape[-1]
-    tile = LANE_TILE if n >= LANE_TILE else max(128, -(-n // 128) * 128)
+    tile = PF.pick_tile(n)
 
     one = F.one_like(coords[0])
     zero = F.zero_like(coords[0])

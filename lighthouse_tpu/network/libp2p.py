@@ -25,6 +25,7 @@ import threading
 from typing import Callable
 
 from ..utils.logging import get_logger
+from ..utils.metrics import Counter, Gauge
 from . import rpc as rpc_mod
 from . import snappy
 from .gossip import PeerManager, SeenCache, message_id
@@ -46,6 +47,13 @@ log = get_logger("libp2p")
 MULTISTREAM = "/multistream/1.0.0"
 NOISE_PROTO = "/noise"
 YAMUX_PROTO = "/yamux/1.0.0"
+
+# transport observability (the reference's libp2p metrics: peers by
+# transport, dial outcomes — lighthouse_network metrics.rs)
+PEERS_GAUGE = Gauge("libp2p_peers_connected", "Connected peers",
+                    ("transport",))
+DIALS = Counter("libp2p_dials_total", "Outbound dial outcomes",
+                ("transport", "outcome"))
 
 # errors any transport's streams can surface (yamux-over-noise-over-TCP
 # or native QUIC streams — the two stacks share the Stream contract)
@@ -296,6 +304,7 @@ class Connection:
         # bounded FIFO — stale entries age out with the seen-cache window
         self.dont_want: "OrderedDict[bytes, bool]" = OrderedDict()
         self._gossip_out: Stream | None = None
+        self.transport = "tcp" if sock is not None else "quic"
         self._lock = threading.Lock()
         self._gossip_write_lock = threading.Lock()
         self.alive = True
@@ -620,6 +629,7 @@ class Libp2pHost:
             self._drop_connection(old)
             old.close()
         self.connections[conn.peer_id] = conn
+        PEERS_GAUGE.inc(labels=(conn.transport,))
         self.peer_manager.connect(conn.peer_id.hex())
         # announce our subscriptions
         if self.subscriptions:
@@ -653,9 +663,21 @@ class Libp2pHost:
         """``expected_peer_id``: pin the identity the noise handshake must
         prove (derived from the discovered ENR's secp256k1 key) — a
         hijacked endpoint cannot impersonate the discovered peer."""
-        sock = socket.create_connection((ip, port), timeout=10.0)
-        return self._upgrade(sock, dialer=True,
-                             expected_peer_id=expected_peer_id)
+        sock = None
+        try:
+            sock = socket.create_connection((ip, port), timeout=10.0)
+            conn = self._upgrade(sock, dialer=True,
+                                 expected_peer_id=expected_peer_id)
+        except Exception:
+            DIALS.inc(labels=("tcp", "failed"))
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise
+        DIALS.inc(labels=("tcp", "ok"))
+        return conn
 
     # -- QUIC transport ----------------------------------------------------
 
@@ -681,8 +703,15 @@ class Libp2pHost:
                   expected_peer_id: bytes | None = None) -> Connection:
         if self.quic is None:
             raise Libp2pError("QUIC transport not enabled on this host")
-        qconn = self.quic.dial(ip, port, expected_peer_id=expected_peer_id)
-        return self._adopt_quic(qconn, expected_peer_id)
+        try:
+            qconn = self.quic.dial(ip, port,
+                                   expected_peer_id=expected_peer_id)
+            conn = self._adopt_quic(qconn, expected_peer_id)
+        except Exception:
+            DIALS.inc(labels=("quic", "failed"))
+            raise
+        DIALS.inc(labels=("quic", "ok"))
+        return conn
 
     def _drop_connection(self, conn: Connection) -> None:
         """Muxer died (peer hung up or send failed): forget the connection
@@ -690,6 +719,7 @@ class Libp2pHost:
         conn.alive = False
         if self.connections.get(conn.peer_id) is conn:
             del self.connections[conn.peer_id]
+            PEERS_GAUGE.dec(labels=(conn.transport,))
         with self._mesh_lock:
             for mesh in self.mesh.values():
                 mesh.discard(conn.peer_id)  # stale entries eat publishes

@@ -54,7 +54,10 @@ class TimeLatch:
 
     def __init__(self, interval: float = 30.0):
         self.interval = interval
-        self._last = 0.0
+        # a fresh latch must fire on its FIRST call: time.monotonic() is
+        # seconds since boot, so a 0.0 sentinel silently suppressed the
+        # first interval's worth of warnings on freshly-booted hosts
+        self._last = time.monotonic() - interval
 
     def elapsed(self) -> bool:
         now = time.monotonic()

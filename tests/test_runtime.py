@@ -118,8 +118,10 @@ class TestLogging:
         assert any("Block imported, slot: 123, root: 0xab" in ln for ln in lines)
 
     def test_time_latch(self):
-        # a generous interval so a loaded 1-CPU host cannot take longer
-        # than it between the two calls (the 1s variant flaked under load)
-        tl = TimeLatch(interval=600_000)
+        # interval is SECONDS; generous so a loaded 1-CPU host cannot
+        # stall past it between the two calls.  A fresh latch fires on
+        # the first call regardless of host uptime (the old 0.0 sentinel
+        # suppressed it for the first `interval` seconds after boot).
+        tl = TimeLatch(interval=600.0)
         assert tl.elapsed() is True
         assert tl.elapsed() is False

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Third-wave relay keeper: wait for tpu_session2.py to exit (it owns the
+# chip until then), then probe the relay on a cadence and run
+# tools/tpu_session3.py (fused-WSM A/B + entry warm) once on first
+# contact.  Same serialization discipline as tpu_keeper.sh.
+cd /root/repo
+echo "[keeper3] waiting for session2 to release the relay"
+while pgrep -f "tools/tpu_session2.py" > /dev/null; do
+  sleep 60
+done
+echo "[keeper3] session2 gone at $(date -u +%H:%M:%SZ); probing"
+PROBE=/tmp/tpu_probe3.py
+cat > "$PROBE" <<'EOF'
+import os, sys, time, threading
+def fire():
+    print("PROBE: init exceeded 150s (relay wedged)", flush=True)
+    os._exit(3)
+t = threading.Timer(150, fire); t.daemon = True; t.start()
+t0 = time.time()
+import jax
+d = jax.devices()
+if not any("TPU" in str(x) for x in d):
+    print(f"PROBE: no TPU in {d}", flush=True)
+    os._exit(4)
+import jax.numpy as jnp
+x = jnp.ones((8, 8))
+(x @ x).block_until_ready()
+print(f"PROBE ok devices={d} total={time.time()-t0:.1f}s", flush=True)
+EOF
+n=0
+while true; do
+  n=$((n+1))
+  echo "[keeper3] probe attempt $n at $(date -u +%H:%M:%SZ)"
+  if python "$PROBE"; then
+    echo "[keeper3] relay ALIVE — running session3"
+    python tools/tpu_session3.py
+    echo "[keeper3] session3 finished at $(date -u +%H:%M:%SZ); exiting"
+    exit 0
+  fi
+  sleep 1200
+done

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Round-5 third TPU session: fused-WSM A/B + final warms.
+
+Runs after session2 settles the chains/miller composition.  Reads the
+session ledger to find the best measured B=512 config, then:
+
+  1. B=512 best-config + LIGHTHOUSE_TPU_WSM=1 — do the fused
+     scalar-mul step kernels (pallas_wsm.py, interpret-proven) win on
+     real silicon?
+  2. if they win: B=8192 in the new best config (headline + warm for
+     the driver's round-end bench)
+  3. warm the driver's entry() compile-check program (B=4, device-h2c,
+     production defaults) so the graft check never pays a cold Mosaic
+     compile on the relay
+
+Appends to TPU_SESSION_r05.jsonl like its predecessors.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_session import LOG, ROOT, log, ok, run_bench_child  # noqa: E402
+
+
+def best_b512() -> tuple[float, bool, bool]:
+    """(value, chains, miller) of the best successful B=512 verify."""
+    best = (0.0, False, False)
+    with open(LOG) as f:
+        for line in f:
+            d = json.loads(line)
+            r = d.get("result") or {}
+            if (isinstance(r, dict) and r.get("batch") == 512
+                    and r.get("value", 0) > best[0]
+                    and not r.get("device_h2c")
+                    and "TPU" in str(r.get("device", ""))):
+                best = (r["value"], bool(r.get("chains")),
+                        bool(r.get("miller_fused")))
+    return best
+
+
+def run_entry_warm(timeout: float = 5500) -> None:
+    """Compile-run entry() exactly as the driver's graft check does."""
+    code = (
+        "import __graft_entry__ as G, jax; "
+        "G._enable_compile_cache(jax); "
+        "fn, args = G.entry(); "
+        "import time; t0=time.time(); "
+        "r = jax.jit(fn)(*args); "
+        "getattr(r, 'block_until_ready', lambda: r)(); "
+        "print('entry warm ok in %.1fs' % (time.time()-t0))"
+    )
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=ROOT, capture_output=True,
+            text=True, timeout=timeout,
+        )
+        out = (proc.stdout + proc.stderr)[-300:]
+    except subprocess.TimeoutExpired:
+        out = f"timeout {timeout}s"
+    log({"stage": "entry warm (B=4 h2c, production defaults)",
+         "wall_sec": round(time.time() - t0, 1), "tail": out})
+
+
+def main() -> None:
+    base_val, base_chains, base_miller = best_b512()
+    log({"stage": "session3 start (wsm A/B)", "pid": os.getpid(),
+         "best_b512": base_val, "chains": base_chains,
+         "miller": base_miller})
+    if base_val <= 0:
+        log({"stage": "abort", "why": "no successful B=512 in ledger"})
+        return
+
+    os.environ["LIGHTHOUSE_TPU_WSM"] = "1"
+    wsm = run_bench_child(512, chains=base_chains, miller=base_miller,
+                          timeout=6000)
+    del os.environ["LIGHTHOUSE_TPU_WSM"]
+    wsm_win = ok(wsm) and wsm["value"] > base_val
+    log({"stage": "wsm verdict", "wsm_on": (wsm or {}).get("value"),
+         "base": base_val, "wsm_win": wsm_win})
+
+    if wsm_win:
+        os.environ["LIGHTHOUSE_TPU_WSM"] = "1"
+        run_bench_child(8192, chains=base_chains, miller=base_miller,
+                        timeout=7000)
+        del os.environ["LIGHTHOUSE_TPU_WSM"]
+
+    run_entry_warm()
+    log({"stage": "session3 done", "wsm_default": wsm_win})
+
+
+if __name__ == "__main__":
+    main()
