@@ -148,6 +148,7 @@ def run_measurement(force_cpu: bool) -> None:
         "kernel": "pallas" if _fp.pallas_enabled() else "scan",
         "chains": _fp.chains_active(),
         "miller_fused": _fp.miller_fused_active(),
+        "wsm": _fp.wsm_fused_active(),
     }
     if "TPU" in str(dev):
         _record_tpu_history(result)
@@ -209,14 +210,17 @@ def orchestrate() -> None:
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "2800"))
     result = _run_child(force_cpu=False, timeout=tpu_timeout)
     if result and result.get("value", 0) > 0:
-        # the chip is ALIVE: opportunistic A/B of the exponent-chain
-        # kernels (two verdicts have asked for this measurement; a live
-        # window must never be wasted).  Skipped when the caller already
-        # pinned LIGHTHOUSE_TPU_CHAINS or set BENCH_AB_CHAINS=0; the
-        # faster of the two REAL measurements becomes the headline.
+        # Opportunistic chains A/B — now DEFAULT OFF: the r5 sessions
+        # measured chains standalone (WIN at B=512: 2,759 vs 2,607,
+        # TPU_SESSION_r05.jsonl 04:59Z) but the chains+miller COMPOSED
+        # program is a pathological Mosaic compile (>6,700 s without
+        # finishing, session2 06:52Z) — with miller default-on, an
+        # automatic chains arm would re-enter that compile.  Re-enable
+        # explicitly with BENCH_AB_CHAINS=1 after the composition is
+        # tamed (e.g. segment-count reduction in the chain kernels).
         if (
             "LIGHTHOUSE_TPU_CHAINS" not in os.environ
-            and os.environ.get("BENCH_AB_CHAINS", "1") == "1"
+            and os.environ.get("BENCH_AB_CHAINS", "0") == "1"
             and "TPU" in str(result.get("device", ""))
         ):
             os.environ["LIGHTHOUSE_TPU_CHAINS"] = "1"

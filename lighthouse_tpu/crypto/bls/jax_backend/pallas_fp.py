@@ -30,6 +30,7 @@ import numpy as np
 from . import fp as F
 
 LANE_TILE = 512  # lanes per grid step (multiple of 128)
+CHAIN_WINDOW = 4  # chain window width: 2^w precomputed powers, w sqr + 1 mul
 
 
 def pick_tile(n: int) -> int:
@@ -135,23 +136,24 @@ def _mont_kernel(a_ref, b_ref, p_ref, pp_ref, o_ref):
     o_ref[:] = _mont_core(a_ref[:], b_ref[:], p_ref[:], pp_ref[:])
 
 
-def _make_chain_kernel(pattern: tuple[bool, ...]):
-    """Square-and-multiply segment: for each bit, acc = acc²; if bit,
-    acc = acc·base — the WHOLE segment one kernel, state in VMEM.
-    Replaces per-bit pallas calls in fixed-exponent chains (Fermat
-    inversion for affinization), cutting call count by the segment
-    length."""
+def _make_window_kernel(w: int):
+    """One fixed-window step: acc^(2^w) * operand, the WHOLE window one
+    kernel with state in VMEM.  The exponent is STATIC, so the window
+    digit picks WHICH precomputed power rides in as ``operand`` — the
+    kernel itself is digit-independent.  One compiled program serves
+    every window of every chain (the per-pattern variant compiled ~24
+    distinct programs for the Fermat chain alone, which is what made
+    the chains+miller composition a pathological Mosaic compile —
+    session2 06:52Z)."""
 
-    def kernel(acc_ref, base_ref, p_ref, pp_ref, o_ref):
+    def kernel(acc_ref, operand_ref, p_ref, pp_ref, o_ref):
         acc = acc_ref[:]
-        base = base_ref[:]
+        operand = operand_ref[:]
         pl_ = p_ref[:]
         pp = pp_ref[:]
-        for mul_bit in pattern:
+        for _ in range(w):
             acc = _mont_sqr_core(acc, pl_, pp)  # triangle square (~-16%)
-            if mul_bit:
-                acc = _mont_core(acc, base, pl_, pp)
-        o_ref[:] = acc
+        o_ref[:] = _mont_core(acc, operand, pl_, pp)
 
     return kernel
 
@@ -210,10 +212,17 @@ def _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2):
     return r0, r1
 
 
-def _make_fp2_chain_kernel(pattern: tuple[bool, ...]):
-    """Fp2 square-and-multiply segment in one kernel (the h2c sqrt /
-    cofactor chains: fp2_pow_static's per-bit scan dispatched stacked XLA
-    ops per bit; here a whole segment keeps both coordinates in VMEM)."""
+def _make_fp2_window_kernel(w: int):
+    """Fp2 fixed-window step: acc^(2^w) * operand, one uniform kernel
+    (w=0 degenerates to a pure fp2 multiply — used to build the power
+    table).  Same static-digit design as _make_window_kernel: the
+    per-pattern variant compiled one program per 8-bit pattern, the
+    exact blowup that made composed traces pathological to compile.
+
+    Bounds: window entry is worst-case post-mul (<=3.2P, <=5.2P), which
+    _fp2_sqr_core's envelope admits; the final multiply's subtrahends
+    are Montgomery outputs (<1.2P) so the k=2 biases hold for any
+    in-envelope operand, including power-table entries."""
 
     def kernel(a0_ref, a1_ref, b0_ref, b1_ref, p_ref, pp_ref, b16_ref,
                b2_ref, o0_ref, o1_ref):
@@ -221,19 +230,17 @@ def _make_fp2_chain_kernel(pattern: tuple[bool, ...]):
         b0, b1 = b0_ref[:], b1_ref[:]
         pl_, pp = p_ref[:], pp_ref[:]
         b16, b2 = b16_ref[:], b2_ref[:]
-        for mul_bit in pattern:
+        for _ in range(w):
             a0, a1 = _fp2_sqr_core(a0, a1, pl_, pp, b16)
-            if mul_bit:
-                a0, a1 = _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2)
+        a0, a1 = _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2)
         o0_ref[:] = a0
         o1_ref[:] = a1
 
     return kernel
 
 
-@functools.lru_cache(maxsize=256)
-def _fp2_chain_call(n_padded: int, tile: int, pattern: tuple,
-                    interpret: bool):
+@functools.lru_cache(maxsize=32)
+def _fp2_chain_call(n_padded: int, tile: int, w: int, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -244,7 +251,7 @@ def _fp2_chain_call(n_padded: int, tile: int, pattern: tuple,
                               memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((26, n_padded), jnp.uint32)
     return pl.pallas_call(
-        _make_fp2_chain_kernel(pattern),
+        _make_fp2_window_kernel(w),
         out_shape=(out_shape, out_shape),
         grid=grid,
         in_specs=[spec, spec, spec, spec, const_spec, const_spec,
@@ -255,10 +262,12 @@ def _fp2_chain_call(n_padded: int, tile: int, pattern: tuple,
 
 
 def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
-                  chunk: int = 8, interpret: bool = False):
+                  w: int = CHAIN_WINDOW, interpret: bool = False):
     """(a0 + a1·u)^e for static MSB-first bits (leading bit must be 1);
-    inputs reduced (bound <= 2).  Returns raw limb pair; value bounds on
-    exit are <= ~18P (callers re-reduce)."""
+    inputs reduced (bound <= 2).  Fixed-window like pow_chain_limbs:
+    one uniform kernel + a power table built with the w=0 (pure-mul)
+    variant.  Returns raw limb pair (exit bounds <= (3.2P, 5.2P);
+    callers re-reduce)."""
     assert bits and bits[0] == 1
     n = a0_limbs.shape[-1]
     tile = pick_tile(n)
@@ -271,20 +280,31 @@ def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
         jnp.broadcast_to(jnp.asarray(c, dtype=jnp.uint32), (26, tile))
         for c in (_P_COLS, _PP_COLS, _BIAS16_COLS, _BIAS2_COLS)
     ]
-    acc0, acc1 = a0_limbs, a1_limbs
-    rest = [bool(b) for b in bits[1:]]
-    for off in range(0, len(rest), chunk):
-        pattern = tuple(rest[off : off + chunk])
-        acc0, acc1 = _fp2_chain_call(n_padded, tile, pattern, interpret)(
-            acc0, acc1, a0_limbs, a1_limbs, *consts
-        )
+    digits = _window_digits(
+        "".join("1" if b else "0" for b in bits), w)
+
+    one0 = jnp.broadcast_to(
+        jnp.asarray(np.asarray(F.int_to_limbs(F.R1_INT)).reshape(26, 1),
+                    dtype=jnp.uint32), (26, n_padded))
+    zero1 = jnp.zeros((26, n_padded), dtype=jnp.uint32)
+    mul = _fp2_chain_call(n_padded, tile, 0, interpret)
+    powers = [(one0, zero1), (a0_limbs, a1_limbs)]
+    for _ in range(2, 1 << w):
+        p0, p1 = powers[-1]
+        powers.append(mul(p0, p1, a0_limbs, a1_limbs, *consts))
+
+    call = _fp2_chain_call(n_padded, tile, w, interpret)
+    acc0, acc1 = powers[digits[0]]
+    for d in digits[1:]:
+        b0, b1 = powers[d]
+        acc0, acc1 = call(acc0, acc1, b0, b1, *consts)
     if n_padded != n:
         return acc0[:, :n], acc1[:, :n]
     return acc0, acc1
 
 
 @functools.lru_cache(maxsize=256)
-def _chain_call(n_padded: int, tile: int, pattern: tuple, interpret: bool):
+def _chain_call(n_padded: int, tile: int, w: int, interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -294,7 +314,7 @@ def _chain_call(n_padded: int, tile: int, pattern: tuple, interpret: bool):
     const_spec = pl.BlockSpec((26, tile), lambda i: (0, 0),
                               memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        _make_chain_kernel(pattern),
+        _make_window_kernel(w),
         out_shape=jax.ShapeDtypeStruct((26, n_padded), jnp.uint32),
         grid=grid,
         in_specs=[spec, spec, const_spec, const_spec],
@@ -303,15 +323,29 @@ def _chain_call(n_padded: int, tile: int, pattern: tuple, interpret: bool):
     )
 
 
-CHAIN_CHUNK = 16  # square-and-multiply bits per kernel (compile-size knob)
+def _window_digits(bitstr: str, w: int) -> list[int]:
+    """MSB-aligned base-2^w digits of a binary string (shared by both
+    chain families — the decomposition must never drift between them)."""
+    pad = (-len(bitstr)) % w
+    bitstr = "0" * pad + bitstr
+    return [int(bitstr[i:i + w], 2) for i in range(0, len(bitstr), w)]
 
 
-def pow_chain_limbs(base_limbs, exponent: int, interpret: bool = False):
-    """base^exponent (Montgomery domain) via chunked in-kernel chains.
+def pow_chain_limbs(base_limbs, exponent: int, interpret: bool = False,
+                    w: int = CHAIN_WINDOW):
+    """base^exponent (Montgomery domain) via fixed-window in-kernel
+    chains: MSB-first base-2^w digits; per digit one uniform kernel runs
+    w squares + one multiply by the statically-selected precomputed
+    power (digit 0 multiplies by the Montgomery one — value-preserving,
+    keeps the kernel uniform).  For the 381-bit Fermat exponent this is
+    ~475 in-kernel products vs ~610 for sparse square-and-multiply AND
+    one compiled program instead of ~24.
+
     base must be strict/quasi limbs of a value bounded < 4.3P (mont
     outputs and reduced values qualify: every in-kernel product is then
     strict×strict, far under the bound-product ceiling)."""
-    bits = [c == "1" for c in bin(exponent)[2:]]
+    digits = _window_digits(bin(exponent)[2:], w)
+
     n = base_limbs.shape[-1]
     tile = pick_tile(n)
     n_padded = -(-n // tile) * tile
@@ -323,14 +357,21 @@ def pow_chain_limbs(base_limbs, exponent: int, interpret: bool = False):
     pp_tile = jnp.broadcast_to(
         jnp.asarray(_PP_COLS, dtype=jnp.uint32), (26, tile)
     )
-    # first bit is always 1: start acc = base (skips one square+mul)
-    acc = base_limbs
-    rest = bits[1:]
-    for off in range(0, len(rest), CHAIN_CHUNK):
-        pattern = tuple(rest[off : off + CHAIN_CHUNK])
-        acc = _chain_call(n_padded, tile, pattern, interpret)(
-            acc, base_limbs, p_tile, pp_tile
-        )
+    # power table base^0..base^(2^w - 1) via the shared mont kernel
+    one = jnp.broadcast_to(
+        jnp.asarray(
+            np.asarray(F.int_to_limbs(F.R1_INT)).reshape(26, 1),
+            dtype=jnp.uint32),
+        (26, n_padded))
+    powers = [one, base_limbs]
+    mont = _mont_call(n_padded, tile, interpret)
+    for _ in range(2, 1 << w):
+        powers.append(mont(powers[-1], base_limbs, p_tile, pp_tile))
+
+    call = _chain_call(n_padded, tile, w, interpret)
+    acc = powers[digits[0]]  # leading digit initializes the accumulator
+    for d in digits[1:]:
+        acc = call(acc, powers[d], p_tile, pp_tile)
     return acc[:, :n] if n_padded != n else acc
 
 

@@ -52,7 +52,6 @@ from . import pairing as _PR
 from . import pallas_fp as PF
 
 N = F.N
-LANE_TILE = PF.LANE_TILE
 MASK = PF.MASK
 
 _P_NP = np.asarray(F.int_to_limbs(F.P_INT)).reshape(N, 1)

@@ -58,7 +58,6 @@ from .pallas_miller import (
 )
 
 N = F.N
-LANE_TILE = PF.LANE_TILE
 
 
 # ---------------------------------------------------------------------------

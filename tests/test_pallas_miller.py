@@ -71,68 +71,51 @@ def _canon_f12(f):
     return [_canon(v) for v in PM._f12_lanes(f)]
 
 
+def _run_tool(mode: str, timeout: int = 3600):
+    """Every slow fused-miller proof runs in a FRESH interpreter via
+    tools/verify_fused_miller.py: the eager proofs are stable standalone
+    but an XLA:CPU process-state bug segfaults them inside a pytest
+    process that already ran dozens of compiles (reproduced: the r5
+    slow tier crashed at exactly this point twice).  Isolation matches
+    production anyway — one process, one trace — and the persistent
+    compile cache keeps reruns fast."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(
+            os.path.dirname(__file__), "..", "tools",
+            "verify_fused_miller.py", mode,
+        )],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    return proc.stdout
+
+
 @pytest.mark.slow
 def test_fused_step_matches_xla_step_both_arms():
-    """Two consecutive full steps through the fused kernels in ONE
-    process, reusing the tool's shared fixture (the subprocess halves
-    test is the fast proof; this covers step chaining end-to-end —
-    >45 min on this 1-core image)."""
-    import importlib.util
-    import os
+    """One full fused step (dbl kernel chained into add kernel on live
+    outputs) vs the XLA step, subprocess-isolated."""
+    assert "fused-miller step OK" in _run_tool("--step")
 
-    spec = importlib.util.spec_from_file_location(
-        "verify_fused_miller",
-        os.path.join(os.path.dirname(__file__), "..", "tools",
-                     "verify_fused_miller.py"),
-    )
-    vfm = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(vfm)
-    fx = vfm.build_fixture()
-    dbl = PM._dbl_call(fx["n_padded"], fx["tile"], True)
-    add = PM._add_call(fx["n_padded"], fx["tile"], True)
 
-    def step(f_arr, T_arr, bit):
-        outs = dbl(*f_arr, *T_arr, fx["xp_a"], fx["yp_a"], *fx["consts"])
-        bit_row = jax.numpy.full(
-            (1, fx["n_padded"]), bit, dtype=jax.numpy.uint32
-        )
-        outs = add(*list(outs[:12]), *list(outs[12:]), *fx["q_arr"],
-                   fx["xp_a"], fx["yp_a"], bit_row, *fx["consts"])
-        return list(outs[:12]), list(outs[12:])
-
-    f1, T1 = step(fx["f_arr"], fx["T_arr"], 1)
-    vfm.check_lanes("step1", fx["ref_f1"], fx["ref_T1"], f1 + T1,
-                    fx["n0"], fx["batch"])
 @pytest.mark.slow
 def test_fused_loop_matches_xla_loop():
-    """Full 63-step loop equality (interpret compile is >40 min on one
-    core — the step-level test above is the fast proof)."""
-    pairs = rand_pairs(2)
-    p_aff, q_aff = encode(pairs)
-    ref = jax.jit(JP.miller_loop)(p_aff, q_aff)
-    fused = jax.jit(PM.miller_loop_fused)(p_aff, q_aff)
-    ref_vals = T.fp12_decode(ref)
-    fused_vals = T.fp12_decode(fused)
-    assert fused_vals == ref_vals, "fused Miller loop diverges from XLA path"
-    for (pp, qq), dev in zip(pairs, fused_vals):
-        want = OP.final_exponentiation(OP.miller_loop(pp, qq))
-        assert OP.final_exponentiation(dev) == want
+    """Full 63-step loop equality vs the XLA loop + host oracle
+    (interpret compile is >40 min on one core), subprocess-isolated."""
+    assert "fused-miller loop OK" in _run_tool("--loop", timeout=5400)
 
 
 @pytest.mark.slow
 def test_fused_pairing_check_bilinear():
-    a = rng.randrange(1, params.R)
-    b = rng.randrange(1, params.R)
-    Pt = affine_mul(G1_GENERATOR, a, Fp)
-    Qt = affine_mul(G2_GENERATOR, b, Fp2)
-    pairs = [(Pt, Qt), (affine_neg(Pt, Fp), Qt)]
-    p_aff, q_aff = encode(pairs)
+    """e(P,Q)*e(-P,Q) == 1 through the fused loop, subprocess-isolated."""
+    assert "fused-miller bilinear OK" in _run_tool("--bilinear",
+                                                   timeout=5400)
 
-    def check(p, q):
-        f = PM.miller_loop_fused(p, q)
-        return JP.final_exp_is_one(JP.gt_product(f))
-
-    assert bool(jax.jit(check)(p_aff, q_aff)) is True
 
 def test_fused_kernel_halves_match_xla_halves():
     """Per-kernel-half canonical equality vs the XLA formulas, run in a

@@ -5,8 +5,20 @@
 # contact.  Same serialization discipline as tpu_keeper.sh.
 cd /root/repo
 echo "[keeper3] waiting for session2 to release the relay"
-while pgrep -f "tools/tpu_session2.py" > /dev/null; do
+# gate on the LEDGER, not just the process table: a pure pgrep check
+# races a not-yet-started session2 (keeper3 would then probe the relay
+# concurrently with it — the documented wedge mode)
+waited=0
+while ! grep -q '"stage": "session2 done"' TPU_SESSION_r05.jsonl 2>/dev/null \
+      || pgrep -f "tools/tpu_session2.py" > /dev/null; do
   sleep 60
+  waited=$((waited+60))
+  if [ "$waited" -ge 14400 ] && ! pgrep -f "tools/tpu_session2.py" > /dev/null; then
+    # session2 died without its ledger line; 4h is long past any
+    # legitimate run — claim the relay rather than waiting forever
+    echo "[keeper3] session2 never logged done after ${waited}s; proceeding"
+    break
+  fi
 done
 echo "[keeper3] session2 gone at $(date -u +%H:%M:%SZ); probing"
 PROBE=/tmp/tpu_probe3.py
@@ -28,7 +40,7 @@ x = jnp.ones((8, 8))
 print(f"PROBE ok devices={d} total={time.time()-t0:.1f}s", flush=True)
 EOF
 n=0
-while true; do
+while [ "$n" -lt 40 ]; do
   n=$((n+1))
   echo "[keeper3] probe attempt $n at $(date -u +%H:%M:%SZ)"
   if python "$PROBE"; then
@@ -39,3 +51,5 @@ while true; do
   fi
   sleep 1200
 done
+echo "[keeper3] gave up after $n wedged probes"
+exit 1

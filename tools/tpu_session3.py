@@ -1,15 +1,19 @@
 #!/usr/bin/env python3
-"""Round-5 third TPU session: fused-WSM A/B + final warms.
+"""Round-5 third TPU session: fused-WSM A/B, windowed-chains compose, warms.
 
-Runs after session2 settles the chains/miller composition.  Reads the
-session ledger to find the best measured B=512 config, then:
+Runs after session2 released the relay.  Reads the session ledger for
+the best measured B=512 config (excluding wsm-on records), then:
 
   1. B=512 best-config + LIGHTHOUSE_TPU_WSM=1 — do the fused
      scalar-mul step kernels (pallas_wsm.py, interpret-proven) win on
      real silicon?
-  2. if they win: B=8192 in the new best config (headline + warm for
-     the driver's round-end bench)
-  3. warm the driver's entry() compile-check program (B=4, device-h2c,
+  2. B=512 chains=1 miller=1 — the composition session2 could not
+     compile (>6,700 s with ~24 per-pattern chain kernels) retried on
+     the WINDOWED chain rewrite (one uniform kernel + power table,
+     ~475 in-kernel products vs ~610).
+  3. B=8192 in the best config found (headline + warm for the
+     driver's round-end bench)
+  4. warm the driver's entry() compile-check program (B=4, device-h2c,
      production defaults) so the graft check never pays a cold Mosaic
      compile on the relay
 
@@ -37,6 +41,7 @@ def best_b512() -> tuple[float, bool, bool]:
             if (isinstance(r, dict) and r.get("batch") == 512
                     and r.get("value", 0) > best[0]
                     and not r.get("device_h2c")
+                    and not r.get("wsm")
                     and "TPU" in str(r.get("device", ""))):
                 best = (r["value"], bool(r.get("chains")),
                         bool(r.get("miller_fused")))
@@ -76,22 +81,37 @@ def main() -> None:
         log({"stage": "abort", "why": "no successful B=512 in ledger"})
         return
 
-    os.environ["LIGHTHOUSE_TPU_WSM"] = "1"
-    wsm = run_bench_child(512, chains=base_chains, miller=base_miller,
-                          timeout=6000)
-    del os.environ["LIGHTHOUSE_TPU_WSM"]
+    try:
+        os.environ["LIGHTHOUSE_TPU_WSM"] = "1"
+        wsm = run_bench_child(512, chains=base_chains, miller=base_miller,
+                              timeout=6000)
+    finally:
+        os.environ.pop("LIGHTHOUSE_TPU_WSM", None)
     wsm_win = ok(wsm) and wsm["value"] > base_val
+    best = max(base_val, (wsm or {}).get("value", 0) if ok(wsm) else 0)
     log({"stage": "wsm verdict", "wsm_on": (wsm or {}).get("value"),
          "base": base_val, "wsm_win": wsm_win})
 
-    if wsm_win:
-        os.environ["LIGHTHOUSE_TPU_WSM"] = "1"
-        run_bench_child(8192, chains=base_chains, miller=base_miller,
+    # windowed-chains composition (session2's pathological compile,
+    # retried on the one-uniform-kernel rewrite)
+    comp = run_bench_child(512, chains=True, miller=True, timeout=6000)
+    comp_win = ok(comp) and comp["value"] > best
+    log({"stage": "windowed chains+miller verdict",
+         "composed": (comp or {}).get("value"), "best_so_far": best,
+         "comp_win": comp_win})
+
+    final_chains = comp_win
+    try:
+        if wsm_win:
+            os.environ["LIGHTHOUSE_TPU_WSM"] = "1"
+        run_bench_child(8192, chains=final_chains, miller=True,
                         timeout=7000)
-        del os.environ["LIGHTHOUSE_TPU_WSM"]
+    finally:
+        os.environ.pop("LIGHTHOUSE_TPU_WSM", None)
 
     run_entry_warm()
-    log({"stage": "session3 done", "wsm_default": wsm_win})
+    log({"stage": "session3 done", "wsm_default": wsm_win,
+         "chains_default": final_chains})
 
 
 if __name__ == "__main__":

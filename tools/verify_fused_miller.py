@@ -133,6 +133,80 @@ def check_lanes(tag, ref_f, ref_T, outs, n0, batch):
         assert np.array_equal(r, g), f"{tag}: T lane {i} diverges"
 
 
+def step_proof() -> None:
+    """One full fused step — dbl kernel chained into add kernel on live
+    outputs — against the XLA step (canonical equality, every lane)."""
+    import jax.numpy as jnp
+
+    fx = build_fixture()
+    dbl = PM._dbl_call(fx["n_padded"], fx["tile"], True)
+    add = PM._add_call(fx["n_padded"], fx["tile"], True)
+    outs = dbl(*fx["f_arr"], *fx["T_arr"], fx["xp_a"], fx["yp_a"],
+               *fx["consts"])
+    bit_row = jnp.full((1, fx["n_padded"]), 1, dtype=jnp.uint32)
+    outs = add(*list(outs[:12]), *list(outs[12:]), *fx["q_arr"],
+               fx["xp_a"], fx["yp_a"], bit_row, *fx["consts"])
+    check_lanes("step", fx["ref_f1"], fx["ref_T1"],
+                list(outs[:12]) + list(outs[12:]), fx["n0"], fx["batch"])
+    print("fused-miller step OK")
+
+
+def loop_proof() -> None:
+    """Full 63-step fused loop vs the XLA loop + host oracle (the
+    interpret compile is >40 min on one core)."""
+    import random
+
+    import jax
+
+    from lighthouse_tpu.crypto.bls import pairing as OP
+    from lighthouse_tpu.crypto.bls.jax_backend import points as Pt
+    from lighthouse_tpu.crypto.bls.jax_backend import tower as T
+
+    rng = random.Random(0xF05ED)
+    pairs = []
+    for _ in range(2):
+        a = rng.randrange(1, params.R)
+        b = rng.randrange(1, params.R)
+        pairs.append((affine_mul(G1_GENERATOR, a, Fp),
+                      affine_mul(G2_GENERATOR, b, Fp2)))
+    p_aff = Pt.g1_encode([p for p, _ in pairs])
+    q_aff = Pt.g2_encode([q for _, q in pairs])
+    ref = jax.jit(JP.miller_loop)(p_aff, q_aff)
+    fused = jax.jit(PM.miller_loop_fused)(p_aff, q_aff)
+    assert T.fp12_decode(fused) == T.fp12_decode(ref), \
+        "fused Miller loop diverges from XLA path"
+    for (pp, qq), dev in zip(pairs, T.fp12_decode(fused)):
+        want = OP.final_exponentiation(OP.miller_loop(pp, qq))
+        assert OP.final_exponentiation(dev) == want
+    print("fused-miller loop OK")
+
+
+def bilinear_proof() -> None:
+    """e(P,Q)·e(-P,Q) == 1 through the fused loop."""
+    import random
+
+    import jax
+
+    from lighthouse_tpu.crypto.bls.curve import affine_neg
+    from lighthouse_tpu.crypto.bls.jax_backend import points as Pt
+
+    rng = random.Random(0xF05ED)
+    a = rng.randrange(1, params.R)
+    b = rng.randrange(1, params.R)
+    P_ = affine_mul(G1_GENERATOR, a, Fp)
+    Q_ = affine_mul(G2_GENERATOR, b, Fp2)
+    pairs = [(P_, Q_), (affine_neg(P_, Fp), Q_)]
+    p_aff = Pt.g1_encode([p for p, _ in pairs])
+    q_aff = Pt.g2_encode([q for _, q in pairs])
+
+    def check(p, q):
+        f = PM.miller_loop_fused(p, q)
+        return JP.final_exp_is_one(JP.gt_product(f))
+
+    assert bool(jax.jit(check)(p_aff, q_aff)) is True
+    print("fused-miller bilinear OK")
+
+
 def main() -> None:
     fx = build_fixture()
     f_arr, T_arr, q_arr = fx["f_arr"], fx["T_arr"], fx["q_arr"]
@@ -161,4 +235,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    mode = sys.argv[1] if len(sys.argv) > 1 else ""
+    if mode == "--step":
+        step_proof()
+    elif mode == "--loop":
+        loop_proof()
+    elif mode == "--bilinear":
+        bilinear_proof()
+    else:
+        main()
