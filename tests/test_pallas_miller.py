@@ -10,6 +10,7 @@ carry path), proves the loop.  The full 63-step loop equality test is
 kept under `slow` (its interpret-mode XLA graph takes >40 min to compile
 on this 1-core image)."""
 
+import os
 import random
 
 import numpy as np
@@ -71,14 +72,25 @@ def _canon_f12(f):
     return [_canon(v) for v in PM._f12_lanes(f)]
 
 
+_MILLER_OPTIN = pytest.mark.skipif(
+    os.environ.get("LIGHTHOUSE_TPU_MILLER_PROOFS", "") != "1",
+    reason="each isolated fused-miller proof is a ~45-55 min fresh-process "
+    "interpret compile (the XLA:CPU persistent cache does not cover "
+    "them); run standalone with LIGHTHOUSE_TPU_MILLER_PROOFS=1 — green "
+    "runs are recorded in MILLER_RECHECK.log",
+)
+
+
 def _run_tool(mode: str, timeout: int = 3600):
     """Every slow fused-miller proof runs in a FRESH interpreter via
     tools/verify_fused_miller.py: the eager proofs are stable standalone
     but an XLA:CPU process-state bug segfaults them inside a pytest
     process that already ran dozens of compiles (reproduced: the r5
     slow tier crashed at exactly this point twice).  Isolation matches
-    production anyway — one process, one trace — and the persistent
-    compile cache keeps reruns fast."""
+    production anyway — one process, one trace.  Reruns are NOT cheap:
+    the XLA:CPU persistent cache does not cover these interpret-mode
+    compiles, so every invocation pays the full ~45-55 min — hence the
+    opt-in gate above."""
     import os
     import subprocess
     import sys
@@ -97,6 +109,7 @@ def _run_tool(mode: str, timeout: int = 3600):
 
 
 @pytest.mark.slow
+@_MILLER_OPTIN
 def test_fused_step_matches_xla_step_both_arms():
     """One full fused step (dbl kernel chained into add kernel on live
     outputs) vs the XLA step, subprocess-isolated."""
@@ -104,6 +117,7 @@ def test_fused_step_matches_xla_step_both_arms():
 
 
 @pytest.mark.slow
+@_MILLER_OPTIN
 def test_fused_loop_matches_xla_loop():
     """Full 63-step loop equality vs the XLA loop + host oracle
     (interpret compile is >40 min on one core), subprocess-isolated."""
@@ -111,6 +125,7 @@ def test_fused_loop_matches_xla_loop():
 
 
 @pytest.mark.slow
+@_MILLER_OPTIN
 def test_fused_pairing_check_bilinear():
     """e(P,Q)*e(-P,Q) == 1 through the fused loop, subprocess-isolated."""
     assert "fused-miller bilinear OK" in _run_tool("--bilinear",
