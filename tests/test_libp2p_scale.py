@@ -169,3 +169,58 @@ class TestReferenceScale:
             hub.stop()
             for p in peers:
                 p.stop()
+
+
+class TestTransportMetrics:
+    def test_dial_outcomes_and_peer_gauge(self):
+        """Dial counters cover success AND pre-upgrade connection
+        failures on both transports; the peers gauge tracks adoption,
+        and replacing a duplicate connection to the same peer leaves it
+        flat (the reference exports the same shapes from
+        lighthouse_network's metrics)."""
+        from lighthouse_tpu.network.libp2p import DIALS, PEERS_GAUGE
+
+        def series(metric):
+            return {k: v for k, v in metric.samples()}
+
+        dials0 = series(DIALS)
+        a = Libp2pHost(heartbeat=False, quic_port=0)
+        b = Libp2pHost(heartbeat=False, quic_port=0)
+        a.start(); b.start()
+        try:
+            with pytest.raises(Exception):
+                a.dial("127.0.0.1", 1)  # refused before upgrade
+            a.dial_quic("127.0.0.1", b.quic_port,
+                        expected_peer_id=b.peer_id)
+            # the listener side adopts on its accept thread: poll, don't
+            # sleep (loaded 1-core hosts race a fixed delay)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if series(PEERS_GAUGE).get(("quic",), 0) >= 2:
+                    break
+                time.sleep(0.05)
+            d = series(DIALS)
+
+            def delta(transport, outcome):
+                key = (transport, outcome)
+                return d.get(key, 0) - dials0.get(key, 0)
+
+            assert delta("tcp", "failed") == 1
+            assert delta("quic", "ok") == 1
+            g = series(PEERS_GAUGE)
+            assert g.get(("quic",), 0) >= 2  # both ends of the dial
+            # duplicate replacement: a second dial to the same peer
+            # replaces the old connection — the gauge must stay flat
+            before = series(PEERS_GAUGE).get(("quic",), 0)
+            a.dial_quic("127.0.0.1", b.quic_port,
+                        expected_peer_id=b.peer_id)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if series(DIALS).get(("quic", "ok"), 0) \
+                        - dials0.get(("quic", "ok"), 0) >= 2:
+                    break
+                time.sleep(0.05)
+            time.sleep(0.3)  # let both replacements settle
+            assert series(PEERS_GAUGE).get(("quic",), 0) == before
+        finally:
+            a.stop(); b.stop()

@@ -91,7 +91,6 @@ def _run_tool(mode: str, timeout: int = 3600):
     the XLA:CPU persistent cache does not cover these interpret-mode
     compiles, so every invocation pays the full ~45-55 min — hence the
     opt-in gate above."""
-    import os
     import subprocess
     import sys
 

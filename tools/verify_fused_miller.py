@@ -195,7 +195,7 @@ def bilinear_proof() -> None:
     b = rng.randrange(1, params.R)
     P_ = affine_mul(G1_GENERATOR, a, Fp)
     Q_ = affine_mul(G2_GENERATOR, b, Fp2)
-    pairs = [(P_, Q_), (affine_neg(P_, Fp), Q_)]
+    pairs = [(P_, Q_), (affine_neg(P_), Q_)]
     p_aff = Pt.g1_encode([p for p, _ in pairs])
     q_aff = Pt.g2_encode([q for _, q in pairs])
 
