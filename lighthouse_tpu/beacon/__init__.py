@@ -4,4 +4,11 @@ harness)."""
 from .chain import BeaconChain, BlockError, ChainError  # noqa: F401
 from .harness import BeaconChainHarness  # noqa: F401
 from .op_pool import OperationPool  # noqa: F401
-from .processor import BeaconProcessor, WorkEvent, WorkKind  # noqa: F401
+from .processor import (  # noqa: F401
+    BeaconProcessor,
+    BreakerState,
+    CircuitBreaker,
+    ResilientVerifier,
+    WorkEvent,
+    WorkKind,
+)

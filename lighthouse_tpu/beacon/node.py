@@ -136,6 +136,20 @@ class BeaconNode:
             from ..slasher import Slasher
 
             self.slasher = Slasher()
+        # graceful degradation: gossip envelope verification routes through
+        # a breaker-guarded verifier, so device infrastructure failures fall
+        # back to the pure-Python engine instead of dropping (or worse,
+        # wrongly rejecting) the gossip message.  Signature INVALIDITY is
+        # unaffected — both engines return the same verdicts.
+        from ..crypto.bls import api as _bls_api
+        from .processor import CircuitBreaker, ResilientVerifier
+
+        self.breaker = CircuitBreaker()
+        self.verifier = ResilientVerifier(
+            device_verify=lambda s: _bls_api.get_backend().verify_signature_sets(s),
+            cpu_verify=lambda s: _bls_api.cpu_backend().verify_signature_sets(s),
+            breaker=self.breaker,
+        )
         self.slot_timer = None
         self._running = False
 
@@ -703,7 +717,6 @@ class BeaconNode:
         attestation, the last checked by chain.process_attestation)."""
         from ..consensus.containers import SignedAggregateAndProof
         from ..consensus.state_processing import signature_sets as sets
-        from ..crypto.bls import api as bls
 
         try:
             agg = SignedAggregateAndProof.deserialize_value(payload)
@@ -727,7 +740,9 @@ class BeaconNode:
                         state, self.chain.get_pubkey, agg, self.spec.preset
                     ),
                 ]
-            if not bls.verify_signature_sets(envelope):
+            # breaker-guarded: a device infrastructure failure degrades to
+            # the CPU engine rather than dropping the aggregate
+            if not all(self.verifier.verify_batch(envelope).verdicts):
                 return "reject"
             # feed the slasher BEFORE fork-choice import: conflicting-head
             # votes (the primary slashable offense) reference unknown

@@ -86,6 +86,17 @@ BATCHED_KINDS = {
     WorkKind.GOSSIP_SYNC_SIGNATURE,
 }
 
+# kinds it is acceptable to shed while the device is down (degraded mode):
+# replaceable per-validator data whose value decays within a slot and whose
+# information survives in aggregated form.  NEVER blocks (chain liveness),
+# never the anti-censorship FIFO ops (exits/slashings — shedding those is a
+# censorship vector), never aggregates (the compressed form we keep).
+DEGRADED_SHED_KINDS = {
+    WorkKind.GOSSIP_ATTESTATION,
+    WorkKind.GOSSIP_SYNC_SIGNATURE,
+    WorkKind.API_REQUEST_P1,
+}
+
 
 @dataclass
 class WorkEvent:
@@ -143,6 +154,8 @@ class BeaconProcessor:
         batch_size_for: Callable[[WorkKind], int] | None = None,
         bounds: dict[WorkKind, int] | None = None,
         journal: list | None = None,
+        breaker: "CircuitBreaker | None" = None,
+        injector=None,
     ):
         bounds = {**DEFAULT_QUEUE_BOUNDS, **(bounds or {})}
         self.queues = {
@@ -152,8 +165,35 @@ class BeaconProcessor:
         self.batch_size_for = batch_size_for or (lambda k: 64)
         # the work journal (lib.rs:759-766): every dispatch is observable
         self.journal = journal if journal is not None else []
+        # degraded-mode wiring: when the breaker is not CLOSED the CPU
+        # fallback is the verifier, so ingress sheds the shed-eligible
+        # kinds rather than queueing more than the slow path can drain
+        self.breaker = breaker
+        if injector is None:
+            from ..utils import faults as _faults
+
+            injector = _faults.INJECTOR
+        self.injector = injector
+        self.shed = 0
+
+    @property
+    def degraded(self) -> bool:
+        return self.breaker is not None and not self.breaker.is_closed
 
     def try_send(self, ev: WorkEvent) -> bool:
+        if self.injector.check("processor.enqueue"):
+            # injected queue overflow: the bound is "reached" regardless
+            # of actual occupancy — same drop accounting as a real one
+            self.queues[ev.kind].dropped += 1
+            self.journal.append(("dropped", ev.kind.name))
+            return False
+        if self.degraded and ev.kind in DEGRADED_SHED_KINDS:
+            from ..utils.metrics import PROCESSOR_SHED
+
+            PROCESSOR_SHED.inc(labels=(ev.kind.name,))
+            self.shed += 1
+            self.journal.append(("shed", ev.kind.name))
+            return False
         ok = self.queues[ev.kind].push(ev)
         if not ok:
             self.journal.append(("dropped", ev.kind.name))
@@ -223,6 +263,215 @@ def verify_with_bisection(
 
     verdicts = go(list(sets))
     return BatchOutcome(verdicts=verdicts, device_calls=calls)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker + graceful degradation
+# ---------------------------------------------------------------------------
+
+
+class BreakerState(Enum):
+    CLOSED = auto()      # device healthy: batches go to the TPU
+    OPEN = auto()        # device down: everything on the CPU fallback
+    HALF_OPEN = auto()   # backoff elapsed: ONE probe batch may try the device
+
+
+class CircuitBreaker:
+    """Trip-open / probe / re-close state machine over the device backend.
+
+    After ``failure_threshold`` CONSECUTIVE infrastructure failures the
+    breaker opens: batches route to the CPU fallback and the scheduler
+    sheds low-priority kinds (``DEGRADED_SHED_KINDS``).  After
+    ``reset_timeout`` (doubling per failed probe up to ``max_backoff``)
+    the breaker half-opens and admits a single probe batch; a probe
+    success re-closes it, a probe failure re-opens with doubled backoff.
+    The same shape as the reference's fallback beacon-node candidate
+    rotation — health is observed, never assumed.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        reset_timeout: float = 1.0,
+        backoff_factor: float = 2.0,
+        max_backoff: float = 60.0,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.backoff_factor = backoff_factor
+        self.max_backoff = max_backoff
+        self.now = now
+        self.state = BreakerState.CLOSED
+        self.consecutive_failures = 0
+        self.trips = 0
+        self._backoff = reset_timeout
+        self._opened_at: float | None = None
+
+    def _transition(self, state: "BreakerState") -> None:
+        if state is self.state:
+            return
+        self.state = state
+        from ..utils.metrics import BREAKER_TRANSITIONS
+
+        BREAKER_TRANSITIONS.inc(labels=(state.name,))
+
+    @property
+    def is_closed(self) -> bool:
+        return self.state is BreakerState.CLOSED
+
+    def allow_device(self) -> bool:
+        """May the next batch touch the device?  True while CLOSED; while
+        OPEN, True exactly once per elapsed backoff window (the probe),
+        flipping the breaker to HALF_OPEN."""
+        if self.state is BreakerState.CLOSED:
+            return True
+        if self.state is BreakerState.HALF_OPEN:
+            return False  # a probe is already in flight
+        if self._opened_at is not None and (
+            self.now() - self._opened_at >= self._backoff
+        ):
+            self._transition(BreakerState.HALF_OPEN)
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self._backoff = self.reset_timeout
+        self._opened_at = None
+        self._transition(BreakerState.CLOSED)
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state is BreakerState.HALF_OPEN:
+            # failed probe: back to OPEN with a longer wait
+            self._backoff = min(
+                self._backoff * self.backoff_factor, self.max_backoff
+            )
+            self._open()
+        elif (self.state is BreakerState.CLOSED
+              and self.consecutive_failures >= self.failure_threshold):
+            self.trips += 1
+            self._open()
+
+    def _open(self) -> None:
+        self._opened_at = self.now()
+        self._transition(BreakerState.OPEN)
+
+
+@dataclass
+class RetryBudget:
+    """Bounded retry allowance for ONE batch: device attempts + deadline."""
+
+    attempts: int
+    deadline: float
+
+    def spend(self, now: float) -> bool:
+        """Consume one attempt; False when the budget is gone."""
+        if self.attempts <= 0 or now >= self.deadline:
+            return False
+        self.attempts -= 1
+        return True
+
+
+class ResilientVerifier:
+    """Batch verification with a specified failure ladder.
+
+    device healthy   -> on-device AND-reduce + poisoned-batch bisection
+                        (``verify_with_bisection``), exactly as before
+    device erroring  -> the batch is retried, then infra-bisected (halved
+                        and re-tried per half — one poison input crashing
+                        a kernel must not drag the whole batch to the
+                        CPU), all under one bounded :class:`RetryBudget`
+    budget exhausted
+    or breaker OPEN  -> the pure-Python/NumPy verifier takes the batch
+
+    A batch handed to :meth:`verify_batch` is NEVER silently dropped and
+    the call never raises: every set gets a verdict from *some* engine.
+    Infrastructure failures (exceptions out of the device call) are
+    distinct from signature failures (the AND-reduce returning False) —
+    only the former feed the breaker; the latter keep the existing
+    on-device bisection semantics.
+    """
+
+    def __init__(
+        self,
+        device_verify: Callable[[list], bool],
+        cpu_verify: Callable[[list], bool],
+        breaker: CircuitBreaker | None = None,
+        max_device_attempts: int = 4,
+        retry_deadline: float = 2.0,
+        now: Callable[[], float] = time.monotonic,
+        injector=None,
+    ):
+        self.device_verify = device_verify
+        self.cpu_verify = cpu_verify
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.max_device_attempts = max_device_attempts
+        self.retry_deadline = retry_deadline
+        self.now = now
+        if injector is None:
+            from ..utils import faults as _faults
+
+            injector = _faults.INJECTOR
+        self.injector = injector
+        # observability: ("device" | "cpu", batch_size) per engine run
+        self.journal: list[tuple[str, int]] = []
+
+    def verify_batch(self, sets: list) -> BatchOutcome:
+        sets = list(sets)
+        if not sets:
+            return BatchOutcome(verdicts=[], device_calls=0)
+        budget = RetryBudget(
+            attempts=self.max_device_attempts,
+            deadline=self.now() + self.retry_deadline,
+        )
+        verdicts = self._device_or_cpu(sets, budget)
+        return BatchOutcome(verdicts=verdicts, device_calls=0)
+
+    # -- internals ---------------------------------------------------------
+
+    def _device_call(self, items: list) -> bool:
+        self.injector.fire("processor.verify")
+        return self.device_verify(items)
+
+    def _device_or_cpu(self, items: list, budget: RetryBudget) -> list[bool]:
+        """Verdicts for ``items``: device with retry/infra-bisection under
+        ``budget``, CPU once the budget (or the breaker) says stop.
+
+        ``allow_device`` is the ONLY gate consulted per attempt — it both
+        admits the half-open probe and denies everything else while OPEN.
+        """
+        while self.breaker.allow_device() and budget.spend(self.now()):
+            try:
+                out = verify_with_bisection(self._device_call, items)
+            except Exception:  # noqa: BLE001 — infrastructure, not verdict
+                from ..utils.metrics import VERIFY_DEVICE_RETRIES
+
+                VERIFY_DEVICE_RETRIES.inc()
+                self.breaker.record_failure()
+                if (len(items) > 1 and budget.attempts >= 2
+                        and self.breaker.is_closed):
+                    # infra-bisection: isolate a kernel-crashing input so
+                    # the healthy half keeps its device throughput
+                    mid = len(items) // 2
+                    return (self._device_or_cpu(items[:mid], budget)
+                            + self._device_or_cpu(items[mid:], budget))
+                continue  # whole-batch retry
+            self.breaker.record_success()
+            self.journal.append(("device", len(items)))
+            return out.verdicts
+        return self._cpu(items).verdicts
+
+    def _cpu(self, sets: list) -> BatchOutcome:
+        """Degraded mode: the CPU oracle, with the SAME bisection
+        attribution so poisoned batches still name their bad sets."""
+        from ..utils.metrics import VERIFY_DEGRADED_BATCHES
+
+        VERIFY_DEGRADED_BATCHES.inc()
+        self.journal.append(("cpu", len(sets)))
+        out = verify_with_bisection(self.cpu_verify, sets)
+        return BatchOutcome(verdicts=out.verdicts, device_calls=0)
 
 
 class DeadlineBatcher:

@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="use a built-in network config (boot ENRs + spec)")
     bn.add_argument("--testnet-dir", default=None,
                     help="load config.yaml/boot_enr.yaml from a directory")
+    bn.add_argument("--chaos", action="append", default=[],
+                    metavar="SITE=KIND[:ARG][xN]",
+                    help="arm a fault before startup (repeatable), e.g. "
+                         "bls.device_verify=errorx3 or "
+                         "bls.device_verify=slow:0.5 — see utils/faults.py")
     bn.add_argument("--upnp", action="store_true",
                     help="attempt UPnP port mapping for p2p/discovery "
                          "(best-effort; nat.rs analog)")
@@ -133,6 +138,11 @@ def run_bn(args) -> int:
     import logging
 
     log = get_logger("bn")
+    for spec_str in getattr(args, "chaos", []):
+        from .utils import faults
+
+        faults.arm_from_spec(spec_str)
+        log_with(log, logging.WARNING, "Chaos fault armed", spec=spec_str)
     spec = _spec_for(args.spec, args.validators)
     boot_enrs = []
     if args.testnet_dir:

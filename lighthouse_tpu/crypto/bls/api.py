@@ -353,6 +353,13 @@ def get_backend():
     return _ACTIVE[0]
 
 
+def cpu_backend() -> PythonBackend:
+    """The always-available pure-Python engine, regardless of which backend
+    is active — the degraded-mode fallback the CircuitBreaker routes to
+    when the device backend is tripping."""
+    return _BACKENDS["python"]
+
+
 register_backend(PythonBackend())
 register_backend(FakeBackend())
 _ACTIVE.append(_BACKENDS["python"])

@@ -349,6 +349,11 @@ class JaxBackend:
     # -- the batch hot path ------------------------------------------------
 
     def verify_signature_sets(self, sets) -> bool:
+        # Chaos hook: the armed site for device errors / hung compiles.
+        # Unarmed cost is one dict lookup (faults.py).
+        from lighthouse_tpu.utils import faults as _faults
+
+        _faults.fire("bls.device_verify")
         if not sets:
             return False
         n = len(sets)

@@ -388,6 +388,21 @@ F_CONNECTION_CLOSE = 0x1C
 F_CONNECTION_CLOSE_APP = 0x1D
 F_HANDSHAKE_DONE = 0x1E
 
+# RFC 9000 §12.4 (table 3): frames only valid in 1-RTT packets.  STREAM
+# (0x08..0x0f) is checked by range alongside this set.  An Initial/Handshake
+# packet carrying one of these is a protocol violation — enforcing it keeps
+# pre-handshake-authentication packets from touching stream/flow-control
+# state (or faking handshake confirmation).
+_APP_ONLY_FRAMES = frozenset({
+    F_RESET_STREAM, F_STOP_SENDING, F_NEW_TOKEN,
+    F_MAX_DATA, F_MAX_STREAM_DATA, F_MAX_STREAMS_BIDI, F_MAX_STREAMS_UNI,
+    F_DATA_BLOCKED, F_STREAM_DATA_BLOCKED,
+    F_STREAMS_BLOCKED_BIDI, F_STREAMS_BLOCKED_UNI,
+    F_NEW_CONNECTION_ID, F_RETIRE_CONNECTION_ID,
+    F_PATH_CHALLENGE, F_PATH_RESPONSE,
+    F_CONNECTION_CLOSE_APP, F_HANDSHAKE_DONE,
+})
+
 
 def _enc_ack_frame(ranges: list[list[int]], ack_delay_us: int = 0) -> bytes:
     """ranges: sorted descending, non-overlapping [lo, hi] pairs."""
@@ -741,6 +756,9 @@ class QuicConnection:
             LEVEL_INITIAL: deque(), LEVEL_HANDSHAKE: deque(),
             LEVEL_APP: deque()}
         self._undecryptable: list[tuple[Packet, bytes]] = []
+        # levels whose keys were discarded (RFC 9001 §4.9): packets there
+        # are DROPPED, not parked — the keys are never coming back
+        self._discarded_levels: set[int] = set()
         self._pto_count = 0
         self._max_payload = MAX_UDP_PAYLOAD
         self._last_rx = time.monotonic()
@@ -812,15 +830,20 @@ class QuicConnection:
                 return
             self._closed = True
             self.close_reason = reason
-            level = (LEVEL_APP if LEVEL_APP in self.send_keys
-                     else LEVEL_INITIAL)
+            # highest level with live send keys (Initial/Handshake may be
+            # discarded per RFC 9001 §4.9 — a CONNECTION_CLOSE can only
+            # ride a level both sides still hold keys for)
+            level = next((lv for lv in (LEVEL_APP, LEVEL_HANDSHAKE,
+                                        LEVEL_INITIAL)
+                          if lv in self.send_keys), None)
             frame = (enc_varint(F_CONNECTION_CLOSE) + enc_varint(error_code)
                      + enc_varint(0) + enc_varint(len(reason))
                      + reason.encode())
-            try:
-                self._send_one(level, [frame], ack_eliciting=False)
-            except OSError:
-                pass
+            if level is not None:
+                try:
+                    self._send_one(level, [frame], ack_eliciting=False)
+                except OSError:
+                    pass
             self._cv.notify_all()
         self.endpoint._forget(self)
 
@@ -871,8 +894,47 @@ class QuicConnection:
                 self._pending[LEVEL_APP].append(
                     ("raw", enc_varint(F_HANDSHAKE_DONE)))
                 self.handshake_confirmed = True
+                # RFC 9001 §4.9.2: the server confirms at handshake
+                # completion and retires its Handshake keys (the final
+                # ACK for the client's Finished goes out first)
+                self._discard_keys(LEVEL_HANDSHAKE)
             self.handshake_complete.set()
             self._cv.notify_all()
+
+    def _discard_keys(self, level: int) -> None:
+        """Retire an encryption level (RFC 9001 §4.9): keys, loss-recovery
+        state and queued frames all go — nothing at this level will ever
+        be sent or processed again.  Lock held.
+
+        Before dropping the send keys, flush one final ACK for anything
+        received at this level: the peer may not have confirmed yet (e.g.
+        its Finished is un-ACKed) and without it would burn a PTO
+        retransmitting into our discarded keys.
+        """
+        if level in self._discarded_levels:
+            return
+        self._discarded_levels.add(level)
+        space = self.spaces[level]
+        if level in self.send_keys and space.recv.ranges:
+            try:
+                self._send_one(level, [_enc_ack_frame(space.recv.ranges)],
+                               ack_eliciting=False)
+            except OSError:
+                pass
+        self.send_keys.pop(level, None)
+        self.recv_keys.pop(level, None)
+        self._pending[level].clear()
+        space.sent.clear()
+        space.inflight = 0
+        space.recv.ack_pending = False
+        space.recv.unacked_eliciting = 0
+        space.recv.oldest_unacked = None
+        # parked packets at this level are undecryptable forever: free
+        # their slots for levels that can still progress
+        self._undecryptable = [
+            p for p in self._undecryptable
+            if _LEVEL_FOR_TYPE.get(p.ptype) != level
+        ]
 
     def _validate_peer_tp(self) -> None:
         peer_scid = self._peer_tp.get(TP_INITIAL_SCID)
@@ -916,6 +978,19 @@ class QuicConnection:
                     finally:
                         self._cv.acquire()
                     return
+                except Exception as exc:  # noqa: BLE001
+                    # malformed input escaping as ValueError/IndexError
+                    # (cert parsing, varint truncation, ...) must close
+                    # the connection, not zombie its handshake slot with
+                    # the rx thread's exception swallowed
+                    log.warning("internal error on packet: %r", exc)
+                    self._cv.release()
+                    try:
+                        self.close(f"internal error: {exc!r}",
+                                   error_code=0x01)
+                    finally:
+                        self._cv.acquire()
+                    return
                 pos = pkt.payload_end
             try:
                 self._drive_tls_locked()
@@ -933,6 +1008,8 @@ class QuicConnection:
         level = _LEVEL_FOR_TYPE.get(pkt.ptype)
         if level is None:
             return  # 0-RTT / Retry: not used by this stack
+        if level in self._discarded_levels:
+            return  # keys retired (RFC 9001 §4.9): drop, don't park
         keys = self.recv_keys.get(level)
         if keys is None:
             if len(self._undecryptable) < 8:
@@ -954,6 +1031,11 @@ class QuicConnection:
         if level == LEVEL_HANDSHAKE and not self._addr_validated:
             self._addr_validated = True  # RFC 9001 §4.9: address proven
         self._process_frames(level, plain)
+        if level == LEVEL_HANDSHAKE:
+            # RFC 9001 §4.9.1: a successfully processed Handshake packet
+            # proves the peer is past the Initial exchange on both ends —
+            # Initial keys (and any Initial retransmission state) retire
+            self._discard_keys(LEVEL_INITIAL)
 
     def _process_frames(self, level: int, plain: bytes) -> None:
         space = self.spaces[level]
@@ -961,6 +1043,12 @@ class QuicConnection:
         ack_eliciting = False
         while pos < len(plain):
             ftype, pos = dec_varint(plain, pos)
+            if level != LEVEL_APP and (
+                    F_STREAM_BASE <= ftype <= 0x0F
+                    or ftype in _APP_ONLY_FRAMES):
+                raise QuicError(
+                    f"frame type {ftype:#x} forbidden at encryption "
+                    f"level {level} (RFC 9000 §12.4)")
             if ftype == F_PADDING:
                 continue
             if ftype == F_PING:
@@ -1051,7 +1139,13 @@ class QuicConnection:
                 self.endpoint._forget(self)
                 return
             elif ftype == F_HANDSHAKE_DONE:
+                if not self.is_client:
+                    # only the server sends HANDSHAKE_DONE (RFC 9000 §19.20)
+                    raise QuicError("client sent HANDSHAKE_DONE")
                 self.handshake_confirmed = True
+                # RFC 9001 §4.9.2: handshake confirmation retires the
+                # Handshake keys on the client
+                self._discard_keys(LEVEL_HANDSHAKE)
             else:
                 raise QuicError(f"unknown frame type {ftype:#x}")
         if ack_eliciting:
@@ -1067,6 +1161,14 @@ class QuicConnection:
     def _on_ack(self, space: _Space, plain: bytes, pos: int,
                 ecn: bool) -> int:
         largest, pos = dec_varint(plain, pos)
+        if largest >= space.next_pn:
+            # RFC 9000 §13.1: acknowledging a packet number we never sent
+            # is a protocol violation, not a no-op — a forged/corrupt ACK
+            # must not poison largest_acked (it would mark every genuine
+            # in-flight packet "lost" via the packet-threshold rule)
+            raise QuicError(
+                f"ACK for unsent packet number {largest} "
+                f"(next_pn {space.next_pn})")
         _delay, pos = dec_varint(plain, pos)
         nranges, pos = dec_varint(plain, pos)
         first, pos = dec_varint(plain, pos)
@@ -1382,13 +1484,17 @@ class QuicConnection:
                     for pkt in parked:
                         self._handle_packet(pkt, pkt.raw)
                     self._drive_tls_locked()
-                except QuicError as exc:
-                    log.warning("protocol violation (parked replay): %s",
+                except Exception as exc:  # noqa: BLE001
+                    violation = isinstance(exc, QuicError)
+                    log.warning("parked replay failed (%s): %r",
+                                "violation" if violation else "internal",
                                 exc)
                     self._cv.release()
                     try:
-                        self.close(f"protocol violation: {exc}",
-                                   error_code=0x03)
+                        self.close(
+                            f"protocol violation: {exc}" if violation
+                            else f"internal error: {exc!r}",
+                            error_code=0x03 if violation else 0x01)
                     finally:
                         self._cv.acquire()
                     return

@@ -2,6 +2,13 @@
 lighthouse_metrics,logging} (L1 in SURVEY §1)."""
 
 from .executor import ShutdownReason, TaskExecutor  # noqa: F401
+from .faults import (  # noqa: F401
+    INJECTOR,
+    DeviceFault,
+    FaultError,
+    FaultInjector,
+    InjectedCrash,
+)
 from .logging import TimeLatch, get_logger, log_with, recent_logs  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, render  # noqa: F401
 from .slot_clock import ManualSlotClock, SlotClock, SystemTimeSlotClock  # noqa: F401

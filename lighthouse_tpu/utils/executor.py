@@ -15,7 +15,12 @@ import concurrent.futures
 import threading
 from typing import Any, Callable, Coroutine
 
-from .metrics import Counter, Gauge
+from .metrics import (
+    TASKS_ABANDONED,
+    TASKS_RESTARTED,
+    Counter,
+    Gauge,
+)
 
 TASKS_STARTED = Counter("executor_tasks_started", "Tasks spawned, by name")
 TASKS_ENDED = Counter("executor_tasks_ended", "Tasks finished, by name")
@@ -67,6 +72,56 @@ class TaskExecutor:
 
         task.add_done_callback(done)
         return task
+
+    def spawn_supervised(
+        self,
+        factory: Callable[[], Coroutine],
+        name: str,
+        max_restarts: int = 5,
+        backoff: float = 0.1,
+        backoff_factor: float = 2.0,
+        max_backoff: float = 30.0,
+    ) -> asyncio.Task:
+        """Supervised service task WITH restart: a crash restarts the
+        coroutine (rebuilt via ``factory``) after an exponential backoff,
+        up to ``max_restarts``; only exhausting the cap escalates to the
+        failure shutdown that plain :meth:`spawn` triggers on the first
+        crash.  A normal return ends supervision.
+
+        The long-running services a node cannot live without (gossip
+        pumps, the scheduler manager loop) ride this instead of ``spawn``
+        so one transient exception — device hiccup, socket error, an
+        injected ``executor.task.<name>`` fault — degrades to a restart
+        counter instead of taking the process down.
+        """
+
+        async def supervisor():
+            from . import faults
+
+            attempt = 0
+            delay = backoff
+            while True:
+                try:
+                    faults.fire(f"executor.task.{name}")
+                    await factory()
+                    return  # clean completion: supervision over
+                except asyncio.CancelledError:
+                    raise  # shutdown path, not a crash
+                except Exception as exc:  # noqa: BLE001 — any crash
+                    attempt += 1
+                    if attempt > max_restarts:
+                        TASKS_ABANDONED.inc(labels=(name,))
+                        self.shutdown(
+                            f"task {name} crashed {attempt} times "
+                            f"(last: {exc!r}); restart cap exhausted",
+                            failure=True,
+                        )
+                        return
+                    TASKS_RESTARTED.inc(labels=(name,))
+                    await asyncio.sleep(delay)
+                    delay = min(delay * backoff_factor, max_backoff)
+
+        return self.spawn(supervisor(), name)
 
     async def spawn_blocking(self, fn: Callable[..., Any], *args, name: str = "?"):
         """Run CPU/disk-bound work on the thread pool (spawn_blocking :207)

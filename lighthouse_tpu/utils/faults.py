@@ -1,0 +1,202 @@
+"""FaultInjector: the chaos layer for the verification pipeline.
+
+The reference client's failure behavior is *specified* (fallback beacon-node
+candidates, beacon_processor drop policies); ours must be too — and a
+failure mode that cannot be simulated cannot be tested.  This module gives
+tests and the CLI one switchboard to inject faults at named sites across
+the stack:
+
+  site                      armed at
+  ------------------------  ---------------------------------------------
+  ``bls.device_verify``     the jax backend's batch entry (L3) — device
+                            errors, hung/slow compiles
+  ``processor.enqueue``     BeaconProcessor.try_send (L6) — forced queue
+                            overflow
+  ``processor.verify``      ResilientVerifier's device call (L6)
+  ``executor.task.<name>``  each (re)start of a supervised task (L1)
+
+A site that nothing armed costs one dict lookup (an unarmed ``fire`` is a
+no-op), so production paths keep the hooks compiled in — the same sites
+every later scaling PR (multichip, sharding) injects faults through.
+
+Fault kinds:
+
+* ``error``    raise (default :class:`DeviceFault`) — infrastructure
+               failure, NOT a signature verdict
+* ``slow``     sleep ``delay`` seconds, then pass (hung-compile analog)
+* ``corrupt``  apply ``mutate`` to the payload ``fire`` was given and
+               return the result (corrupted-signature analog)
+* ``overflow`` ``check`` reports the site as saturated (queue-full analog)
+* ``crash``    raise :class:`InjectedCrash` — task-death analog; the
+               supervisor, not the breaker, owns this one
+
+Arming is bounded: ``times=N`` auto-disarms after N firings (the breaker
+recovery tests ride this), ``probability`` makes soak tests stochastic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from .metrics import FAULTS_INJECTED
+
+
+class FaultError(RuntimeError):
+    """Base class for every injected *infrastructure* failure."""
+
+
+class DeviceFault(FaultError):
+    """Injected device/XLA failure (the TPU went away mid-batch)."""
+
+
+class InjectedCrash(FaultError):
+    """Injected task death (a service coroutine raising unexpectedly)."""
+
+
+_KINDS = ("error", "slow", "corrupt", "overflow", "crash")
+
+
+@dataclass
+class Fault:
+    kind: str
+    exc: Callable[[], BaseException] | None = None
+    delay: float = 0.0
+    mutate: Callable[[Any], Any] | None = None
+    remaining: int | None = None  # None = until disarmed
+    probability: float = 1.0
+
+
+class FaultInjector:
+    """Thread-safe switchboard of armed faults, keyed by site name.
+
+    ``fire(site, payload)`` applies whatever is armed and returns the
+    (possibly mutated) payload; ``check(site)`` is the non-raising peek
+    used by overflow-style sites.  Both decrement bounded arms.
+    """
+
+    def __init__(self, rng: Callable[[], float] | None = None):
+        self._armed: dict[str, Fault] = {}
+        self._lock = threading.Lock()
+        self.injected: int = 0
+        if rng is None:
+            import random
+
+            rng = random.random
+        self._rng = rng
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(
+        self,
+        site: str,
+        kind: str = "error",
+        *,
+        exc: Callable[[], BaseException] | BaseException | None = None,
+        delay: float = 0.0,
+        mutate: Callable[[Any], Any] | None = None,
+        times: int | None = None,
+        probability: float = 1.0,
+    ) -> None:
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; have {_KINDS}")
+        if isinstance(exc, BaseException):
+            _e = exc
+            exc = lambda: _e  # noqa: E731
+        if exc is None and kind == "error":
+            exc = lambda: DeviceFault(f"injected device fault at {site}")  # noqa: E731
+        if exc is None and kind == "crash":
+            exc = lambda: InjectedCrash(f"injected crash at {site}")  # noqa: E731
+        with self._lock:
+            self._armed[site] = Fault(
+                kind=kind, exc=exc, delay=delay, mutate=mutate,
+                remaining=times, probability=probability,
+            )
+
+    def disarm(self, site: str | None = None) -> None:
+        """Disarm one site, or everything when ``site`` is None."""
+        with self._lock:
+            if site is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(site, None)
+
+    def armed(self, site: str) -> bool:
+        with self._lock:
+            return site in self._armed
+
+    def arm_from_spec(self, spec: str) -> None:
+        """Parse a CLI arming spec: ``site=kind[:arg][xN]``.
+
+        ``arg`` is the delay in seconds for ``slow`` faults; ``xN`` bounds
+        the arm to N firings.  Examples::
+
+            bls.device_verify=error x3   ->  "bls.device_verify=errorx3"
+            bls.device_verify=slow:0.5
+            executor.task.gossip=crashx1
+        """
+        site, _, rest = spec.partition("=")
+        if not site or not rest:
+            raise ValueError(f"bad fault spec {spec!r}; want site=kind[:arg][xN]")
+        times = None
+        if "x" in rest:
+            rest, _, n = rest.rpartition("x")
+            times = int(n)
+        kind, _, arg = rest.partition(":")
+        delay = float(arg) if (arg and kind == "slow") else 0.0
+        self.arm(site.strip(), kind.strip(), delay=delay, times=times)
+
+    # -- firing ------------------------------------------------------------
+
+    def _take(self, site: str) -> Fault | None:
+        """Pop one firing from the armed fault at ``site`` (or None)."""
+        with self._lock:
+            f = self._armed.get(site)
+            if f is None:
+                return None
+            if f.probability < 1.0 and self._rng() >= f.probability:
+                return None
+            if f.remaining is not None:
+                f.remaining -= 1
+                if f.remaining <= 0:
+                    del self._armed[site]
+            self.injected += 1
+        FAULTS_INJECTED.inc(labels=(site,))
+        return f
+
+    def fire(self, site: str, payload: Any = None) -> Any:
+        """Apply the armed fault (raise / sleep / mutate) and return the
+        payload.  Unarmed sites return the payload untouched."""
+        f = self._take(site)
+        if f is None:
+            return payload
+        if f.kind == "slow":
+            time.sleep(f.delay)
+            return payload
+        if f.kind == "corrupt":
+            return f.mutate(payload) if f.mutate is not None else payload
+        if f.kind in ("error", "crash"):
+            raise f.exc()
+        return payload  # "overflow" is a check()-site kind; fire is a no-op
+
+    def check(self, site: str) -> bool:
+        """Non-raising peek for saturation-style sites: True when an
+        ``overflow`` fault fires at ``site`` (the site should then behave
+        as if its resource were exhausted)."""
+        with self._lock:
+            f = self._armed.get(site)
+            if f is None or f.kind != "overflow":
+                return False
+        return self._take(site) is not None
+
+
+# The process-global injector every production site fires through; tests
+# either arm it (and disarm in teardown) or pass their own instance.
+INJECTOR = FaultInjector()
+
+arm = INJECTOR.arm
+disarm = INJECTOR.disarm
+fire = INJECTOR.fire
+arm_from_spec = INJECTOR.arm_from_spec

@@ -121,6 +121,49 @@ class _Timer:
         self.hist.observe(time.perf_counter() - self.t0, self.labels)
 
 
+# ---------------------------------------------------------------------------
+# Shared robustness counters (fault injection / graceful degradation).
+# Declared here, in the registry module, because they are written from
+# several layers (L1 executor, L3 backend, L6 scheduler) and scraped as one
+# failure-behavior surface.
+# ---------------------------------------------------------------------------
+
+FAULTS_INJECTED = Counter(
+    "faults_injected_total",
+    "Faults fired by the FaultInjector, by site",
+    ("site",),
+)
+BREAKER_TRANSITIONS = Counter(
+    "breaker_transitions_total",
+    "CircuitBreaker state transitions, by new state",
+    ("state",),
+)
+VERIFY_DEGRADED_BATCHES = Counter(
+    "verify_degraded_batches_total",
+    "Signature batches verified on the CPU fallback (breaker open or "
+    "device retry budget exhausted)",
+)
+VERIFY_DEVICE_RETRIES = Counter(
+    "verify_device_retries_total",
+    "Device batch-verify attempts retried after an infrastructure failure",
+)
+PROCESSOR_SHED = Counter(
+    "processor_shed_total",
+    "Work events shed in degraded mode, by kind",
+    ("kind",),
+)
+TASKS_RESTARTED = Counter(
+    "executor_tasks_restarted_total",
+    "Supervised task restarts after a crash, by name",
+    ("name",),
+)
+TASKS_ABANDONED = Counter(
+    "executor_tasks_abandoned_total",
+    "Supervised tasks that exhausted their restart cap, by name",
+    ("name",),
+)
+
+
 def render() -> str:
     """Prometheus text exposition of every registered metric."""
     out = []

@@ -1,0 +1,542 @@
+"""Fault injection + graceful degradation: the chaos suite.
+
+Covers the robustness ladder end to end on the CPU (no cryptography, no
+device): the FaultInjector switchboard, the CircuitBreaker state machine,
+ResilientVerifier's device→retry→bisect→CPU ladder, BeaconProcessor's
+degraded-mode load shedding, and TaskExecutor's supervised restarts.  The
+acceptance scenario — device backend dies mid-load, every queued
+block/aggregate still drains through the CPU fallback, breaker re-closes
+once the fault clears — lives in TestDegradedPipeline.
+"""
+
+import asyncio
+
+import pytest
+
+from lighthouse_tpu.beacon.processor import (
+    DEGRADED_SHED_KINDS,
+    BeaconProcessor,
+    BreakerState,
+    CircuitBreaker,
+    ResilientVerifier,
+    WorkEvent,
+    WorkKind,
+)
+from lighthouse_tpu.utils import TaskExecutor, faults
+from lighthouse_tpu.utils.faults import (
+    DeviceFault,
+    FaultInjector,
+    InjectedCrash,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_injector():
+    """Never leak an armed fault into (or out of) a test."""
+    faults.INJECTOR.disarm()
+    yield
+    faults.INJECTOR.disarm()
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_unarmed_site_is_noop(self):
+        inj = FaultInjector()
+        assert inj.fire("bls.device_verify", payload=41) == 41
+        assert inj.injected == 0
+
+    def test_error_fault_raises(self):
+        inj = FaultInjector()
+        inj.arm("bls.device_verify", "error")
+        with pytest.raises(DeviceFault):
+            inj.fire("bls.device_verify")
+        assert inj.injected == 1
+
+    def test_bounded_arm_auto_disarms(self):
+        inj = FaultInjector()
+        inj.arm("s", "error", times=2)
+        for _ in range(2):
+            with pytest.raises(DeviceFault):
+                inj.fire("s")
+        assert not inj.armed("s")
+        inj.fire("s")  # third firing: disarmed, no raise
+        assert inj.injected == 2
+
+    def test_corrupt_mutates_payload(self):
+        inj = FaultInjector()
+        inj.arm("sig", "corrupt", mutate=lambda b: b[::-1])
+        assert inj.fire("sig", b"abc") == b"cba"
+
+    def test_slow_fault_delays(self):
+        import time as _time
+
+        inj = FaultInjector()
+        inj.arm("s", "slow", delay=0.02)
+        t0 = _time.monotonic()
+        inj.fire("s")
+        assert _time.monotonic() - t0 >= 0.015
+
+    def test_overflow_is_check_only(self):
+        inj = FaultInjector()
+        inj.arm("q", "overflow", times=1)
+        assert inj.check("q")
+        assert not inj.check("q")  # bounded arm consumed
+        # non-overflow kinds never trigger check()
+        inj.arm("q", "error")
+        assert not inj.check("q")
+
+    def test_crash_kind(self):
+        inj = FaultInjector()
+        inj.arm("task", "crash")
+        with pytest.raises(InjectedCrash):
+            inj.fire("task")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultInjector().arm("s", "meltdown")
+
+    def test_disarm_all(self):
+        inj = FaultInjector()
+        inj.arm("a", "error")
+        inj.arm("b", "crash")
+        inj.disarm()
+        assert not inj.armed("a") and not inj.armed("b")
+
+    def test_probability_zero_never_fires(self):
+        inj = FaultInjector(rng=lambda: 0.99)
+        inj.arm("s", "error", probability=0.5)
+        inj.fire("s")  # rng 0.99 >= 0.5: no fire
+        assert inj.injected == 0
+
+    def test_arm_from_spec(self):
+        inj = FaultInjector()
+        inj.arm_from_spec("bls.device_verify=errorx3")
+        f = inj._armed["bls.device_verify"]
+        assert f.kind == "error" and f.remaining == 3
+        inj.arm_from_spec("x=slow:0.25")
+        f = inj._armed["x"]
+        assert f.kind == "slow" and f.delay == 0.25 and f.remaining is None
+        with pytest.raises(ValueError):
+            inj.arm_from_spec("nonsense")
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trips_after_consecutive_failures_only(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=3, now=clk)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()  # resets the consecutive count
+        b.record_failure()
+        b.record_failure()
+        assert b.is_closed
+        b.record_failure()
+        assert b.state is BreakerState.OPEN
+        assert b.trips == 1
+
+    def test_open_blocks_until_backoff_then_single_probe(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=1.0, now=clk)
+        b.record_failure()
+        assert not b.allow_device()
+        clk.advance(0.5)
+        assert not b.allow_device()
+        clk.advance(0.6)
+        assert b.allow_device()  # the probe
+        assert b.state is BreakerState.HALF_OPEN
+        assert not b.allow_device()  # only ONE probe per window
+
+    def test_failed_probe_doubles_backoff(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=1.0,
+                           backoff_factor=2.0, now=clk)
+        b.record_failure()
+        clk.advance(1.1)
+        assert b.allow_device()
+        b.record_failure()  # probe failed
+        assert b.state is BreakerState.OPEN
+        clk.advance(1.1)
+        assert not b.allow_device()  # 2x backoff now
+        clk.advance(1.0)
+        assert b.allow_device()
+
+    def test_successful_probe_recloses_and_resets_backoff(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=1.0, now=clk)
+        b.record_failure()
+        clk.advance(1.1)
+        assert b.allow_device()
+        b.record_success()
+        assert b.is_closed
+        assert b.consecutive_failures == 0
+        # a later trip starts from the base backoff again
+        b.record_failure()
+        clk.advance(1.1)
+        assert b.allow_device()
+
+    def test_backoff_capped(self):
+        clk = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=1.0,
+                           backoff_factor=10.0, max_backoff=5.0, now=clk)
+        b.record_failure()
+        for _ in range(4):  # repeated failed probes
+            clk.advance(100.0)
+            assert b.allow_device()
+            b.record_failure()
+        assert b._backoff == 5.0
+
+
+# ---------------------------------------------------------------------------
+# ResilientVerifier
+# ---------------------------------------------------------------------------
+
+
+class _Engines:
+    """Scriptable device + always-true CPU engines with call accounting."""
+
+    def __init__(self, injector=None):
+        self.device_calls = 0
+        self.cpu_calls = 0
+        self.device_exc: Exception | None = None
+        self.bad: set[int] = set()  # ids whose signature is invalid
+
+    def device(self, items):
+        self.device_calls += 1
+        if self.device_exc is not None:
+            raise self.device_exc
+        return all(id(i) not in self.bad for i in items)
+
+    def cpu(self, items):
+        self.cpu_calls += 1
+        return all(id(i) not in self.bad for i in items)
+
+
+def _mk(engines, **kw):
+    kw.setdefault("injector", FaultInjector())
+    kw.setdefault("breaker", CircuitBreaker(failure_threshold=3,
+                                            now=kw.pop("clock", FakeClock())))
+    return ResilientVerifier(engines.device, engines.cpu, **kw)
+
+
+class TestResilientVerifier:
+    def test_healthy_device_path(self):
+        eng = _Engines()
+        rv = _mk(eng)
+        out = rv.verify_batch([object() for _ in range(8)])
+        assert out.verdicts == [True] * 8
+        assert eng.device_calls == 1 and eng.cpu_calls == 0
+        assert rv.journal == [("device", 8)]
+
+    def test_signature_failure_is_not_infrastructure(self):
+        """A False verdict bisects ON DEVICE and never feeds the breaker."""
+        eng = _Engines()
+        items = [object() for _ in range(8)]
+        eng.bad = {id(items[3])}
+        rv = _mk(eng)
+        out = rv.verify_batch(items)
+        assert out.verdicts == [True] * 3 + [False] + [True] * 4
+        assert eng.cpu_calls == 0
+        assert rv.breaker.is_closed
+        assert rv.breaker.consecutive_failures == 0
+
+    def test_infra_failure_falls_back_to_cpu_with_full_verdicts(self):
+        clk = FakeClock()
+        eng = _Engines()
+        eng.device_exc = RuntimeError("device gone")
+        rv = _mk(eng, clock=clk)
+        items = [object() for _ in range(16)]
+        out = rv.verify_batch(items)  # never raises, never drops
+        assert out.verdicts == [True] * 16
+        assert eng.cpu_calls >= 1
+        assert not rv.breaker.is_closed
+        assert ("cpu", 16) in rv.journal or any(
+            e == "cpu" for e, _ in rv.journal)
+
+    def test_open_breaker_skips_device_entirely(self):
+        clk = FakeClock()
+        eng = _Engines()
+        eng.device_exc = RuntimeError("boom")
+        rv = _mk(eng, clock=clk)
+        rv.verify_batch([object()] * 4)  # trips the breaker
+        calls = eng.device_calls
+        out = rv.verify_batch([object()] * 4)
+        assert out.verdicts == [True] * 4
+        assert eng.device_calls == calls  # untouched while OPEN
+
+    def test_probe_recovery_recloses(self):
+        clk = FakeClock()
+        brk = CircuitBreaker(failure_threshold=1, reset_timeout=1.0, now=clk)
+        eng = _Engines()
+        eng.device_exc = RuntimeError("flaky")
+        rv = ResilientVerifier(eng.device, eng.cpu, breaker=brk,
+                               injector=FaultInjector(), now=clk)
+        rv.verify_batch([object()] * 2)
+        assert not brk.is_closed
+        eng.device_exc = None  # fault clears
+        clk.advance(1.5)
+        out = rv.verify_batch([object()] * 2)  # the probe batch
+        assert out.verdicts == [True, True]
+        assert brk.is_closed
+        assert rv.journal[-1] == ("device", 2)
+
+    def test_injected_device_fault_site(self):
+        """The verifier's own chaos site (processor.verify) feeds the
+        same infra ladder as a real device exception."""
+        inj = FaultInjector()
+        clk = FakeClock()
+        eng = _Engines()
+        rv = _mk(eng, injector=inj, clock=clk)
+        inj.arm("processor.verify", "error", times=50)
+        out = rv.verify_batch([object()] * 4)
+        assert out.verdicts == [True] * 4  # CPU saved the batch
+        assert eng.cpu_calls >= 1
+
+    def test_empty_batch(self):
+        rv = _mk(_Engines())
+        assert rv.verify_batch([]).verdicts == []
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode scheduler behavior
+# ---------------------------------------------------------------------------
+
+
+class TestProcessorShedding:
+    def test_injected_queue_overflow_drops_with_accounting(self):
+        inj = FaultInjector()
+        p = BeaconProcessor(handlers={}, injector=inj)
+        inj.arm("processor.enqueue", "overflow", times=2)
+        ev = WorkEvent(WorkKind.GOSSIP_ATTESTATION, "a")
+        assert not p.try_send(ev)
+        assert not p.try_send(ev)
+        assert p.try_send(ev)  # bounded arm consumed
+        assert p.queues[WorkKind.GOSSIP_ATTESTATION].dropped == 2
+        assert p.journal[:2] == [("dropped", "GOSSIP_ATTESTATION")] * 2
+
+    def test_degraded_sheds_only_eligible_kinds(self):
+        clk = FakeClock()
+        brk = CircuitBreaker(failure_threshold=1, now=clk)
+        p = BeaconProcessor(handlers={}, breaker=brk,
+                            injector=FaultInjector())
+        brk.record_failure()  # device down -> degraded
+        assert p.degraded
+        # shed-eligible: refused with a journal entry
+        for kind in DEGRADED_SHED_KINDS:
+            assert not p.try_send(WorkEvent(kind, "x"))
+        assert p.shed == len(DEGRADED_SHED_KINDS)
+        # everything else still queues: blocks, aggregates, exits...
+        for kind in (WorkKind.GOSSIP_BLOCK, WorkKind.GOSSIP_AGGREGATE,
+                     WorkKind.GOSSIP_VOLUNTARY_EXIT, WorkKind.RPC_BLOCK):
+            assert kind not in DEGRADED_SHED_KINDS
+            assert p.try_send(WorkEvent(kind, "x"))
+        # recovery: nothing sheds once the breaker recloses
+        brk.record_success()
+        assert not p.degraded
+        assert p.try_send(WorkEvent(WorkKind.GOSSIP_ATTESTATION, "x"))
+
+    def test_never_sheds_blocks_or_anticensorship_kinds(self):
+        assert WorkKind.GOSSIP_BLOCK not in DEGRADED_SHED_KINDS
+        assert WorkKind.RPC_BLOCK not in DEGRADED_SHED_KINDS
+        assert WorkKind.CHAIN_SEGMENT not in DEGRADED_SHED_KINDS
+        assert WorkKind.GOSSIP_AGGREGATE not in DEGRADED_SHED_KINDS
+        assert WorkKind.GOSSIP_VOLUNTARY_EXIT not in DEGRADED_SHED_KINDS
+        assert WorkKind.GOSSIP_PROPOSER_SLASHING not in DEGRADED_SHED_KINDS
+        assert WorkKind.GOSSIP_ATTESTER_SLASHING not in DEGRADED_SHED_KINDS
+
+
+class TestDegradedPipeline:
+    """The acceptance scenario: the device dies mid-load and every queued
+    block and aggregate still drains through the CPU fallback — zero
+    drops, only shed-eligible kinds shed, breaker re-closes after the
+    fault clears."""
+
+    def test_device_death_drains_everything_on_cpu(self):
+        clk = FakeClock()
+        inj = FaultInjector()
+        brk = CircuitBreaker(failure_threshold=2, reset_timeout=1.0,
+                             now=clk)
+        eng = _Engines()
+        rv = ResilientVerifier(eng.device, eng.cpu, breaker=brk,
+                               injector=inj, now=clk,
+                               max_device_attempts=3, retry_deadline=60.0)
+
+        verified: list = []
+        imported: list = []
+
+        def verify_batch_handler(batch):
+            out = rv.verify_batch([ev.item for ev in batch])
+            assert len(out.verdicts) == len(batch)
+            verified.extend(ev.item for ev in batch)
+
+        def import_block(batch):
+            out = rv.verify_batch([ev.item for ev in batch])
+            assert all(out.verdicts)
+            imported.extend(ev.item for ev in batch)
+
+        p = BeaconProcessor(
+            handlers={
+                WorkKind.GOSSIP_BLOCK: import_block,
+                WorkKind.GOSSIP_AGGREGATE: verify_batch_handler,
+                WorkKind.GOSSIP_ATTESTATION: verify_batch_handler,
+            },
+            batch_size_for=lambda k: 8,
+            breaker=brk,
+            injector=inj,
+        )
+
+        # mid-load: 6 blocks, 20 aggregates, 12 attestations queued...
+        blocks = [f"blk{i}" for i in range(6)]
+        aggs = [f"agg{i}" for i in range(20)]
+        atts = [f"att{i}" for i in range(12)]
+        for b in blocks:
+            assert p.try_send(WorkEvent(WorkKind.GOSSIP_BLOCK, b))
+        for a in aggs:
+            assert p.try_send(WorkEvent(WorkKind.GOSSIP_AGGREGATE, a))
+        for a in atts:
+            assert p.try_send(WorkEvent(WorkKind.GOSSIP_ATTESTATION, a))
+
+        # ...then the device backend dies
+        inj.arm("processor.verify", "error")
+        p.drain()
+
+        # every queued block and aggregate came out the other side
+        assert imported == blocks
+        assert set(aggs) <= set(verified)
+        # the pre-fault attestations were already queued, so they drain
+        # too (shedding is an INGRESS policy, not a queue purge)
+        assert set(atts) <= set(verified)
+        assert eng.cpu_calls > 0
+        assert not brk.is_closed
+        # zero drops anywhere
+        assert all(q.dropped == 0 for q in p.queues.values())
+        assert not any(tag == "dropped" for tag, _ in p.journal)
+
+        # degraded ingress: attestations shed, blocks/aggregates kept
+        assert not p.try_send(WorkEvent(WorkKind.GOSSIP_ATTESTATION, "x"))
+        assert p.try_send(WorkEvent(WorkKind.GOSSIP_BLOCK, "late-blk"))
+        assert p.try_send(WorkEvent(WorkKind.GOSSIP_AGGREGATE, "late-agg"))
+        assert ("shed", "GOSSIP_ATTESTATION") in p.journal
+        p.drain()
+        assert "late-blk" in imported and "late-agg" in verified
+
+        # fault clears; backoff elapses; the next batch is the probe
+        inj.disarm()
+        clk.advance(5.0)
+        assert p.try_send(WorkEvent(WorkKind.GOSSIP_AGGREGATE, "probe-agg"))
+        p.drain()
+        assert "probe-agg" in verified
+        assert brk.is_closed  # recovered
+        assert not p.degraded
+        assert rv.journal[-1][0] == "device"  # back on the device path
+
+
+# ---------------------------------------------------------------------------
+# Supervised task restart
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisedRestart:
+    def test_injected_crash_restarts_until_fault_clears(self):
+        runs = []
+
+        async def main():
+            ex = TaskExecutor(loop=asyncio.get_running_loop())
+            faults.INJECTOR.arm("executor.task.svc", "crash", times=2)
+
+            async def svc():
+                runs.append(1)
+
+            ex.spawn_supervised(lambda: svc(), "svc", max_restarts=5,
+                                backoff=0.005)
+            for _ in range(200):
+                await asyncio.sleep(0.005)
+                if runs:
+                    break
+            assert runs == [1]
+            assert ex._shutdown_reason is None  # no failure escalation
+            ex.shutdown("test over")
+            await ex.wait_for_shutdown()
+
+        asyncio.run(main())
+
+    def test_restart_cap_escalates_to_failure_shutdown(self):
+        async def main():
+            ex = TaskExecutor(loop=asyncio.get_running_loop())
+            faults.INJECTOR.arm("executor.task.doomed", "crash")
+
+            async def svc():  # pragma: no cover - never reached
+                raise AssertionError("unreachable")
+
+            ex.spawn_supervised(lambda: svc(), "doomed", max_restarts=2,
+                                backoff=0.001)
+            reason = await asyncio.wait_for(ex.wait_for_shutdown(), 5.0)
+            assert reason.failure
+            assert "doomed" in reason.reason
+            assert "restart cap" in reason.reason
+
+        asyncio.run(main())
+
+    def test_supervised_crash_from_task_body(self):
+        """Real exceptions (not just injected ones) restart too."""
+        attempts = []
+
+        async def main():
+            ex = TaskExecutor(loop=asyncio.get_running_loop())
+
+            async def svc():
+                attempts.append(1)
+                if len(attempts) < 3:
+                    raise RuntimeError("transient")
+
+            ex.spawn_supervised(lambda: svc(), "flaky", max_restarts=5,
+                                backoff=0.001)
+            for _ in range(200):
+                await asyncio.sleep(0.005)
+                if len(attempts) >= 3:
+                    break
+            assert len(attempts) == 3
+            assert ex._shutdown_reason is None
+            ex.shutdown("test over")
+            await ex.wait_for_shutdown()
+
+        asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# Metrics surface
+# ---------------------------------------------------------------------------
+
+
+class TestRobustnessMetrics:
+    def test_counters_exposed_in_render(self):
+        from lighthouse_tpu.utils.metrics import render
+
+        text = render()
+        for name in ("faults_injected_total", "breaker_transitions_total",
+                     "verify_degraded_batches_total",
+                     "verify_device_retries_total", "processor_shed_total",
+                     "executor_tasks_restarted_total",
+                     "executor_tasks_abandoned_total"):
+            assert name in text

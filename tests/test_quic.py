@@ -504,7 +504,145 @@ class TestNodeOverQuic:
             a.stop(); b.stop(); boot.stop()
 
 
+class TestFrameLevelRestrictions:
+    """RFC 9000 §12.4: 1-RTT-only frames arriving in Initial/Handshake
+    packets are protocol violations, not silently processed state."""
+
+    def _pair(self, endpoints):
+        srv, cli = endpoints
+        holder = {}
+
+        def serve():
+            holder["conn"] = srv.accept(timeout=10)
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        deadline = time.time() + 5
+        while time.time() < deadline and "conn" not in holder:
+            time.sleep(0.02)
+        return conn, holder["conn"]
+
+    def test_app_only_frames_rejected_below_app_level(self, endpoints):
+        conn, _ = self._pair(endpoints)
+        for level in (q.LEVEL_INITIAL, q.LEVEL_HANDSHAKE):
+            for frame in (
+                q.enc_varint(q.F_MAX_DATA) + q.enc_varint(1 << 20),
+                q.enc_varint(q.F_MAX_STREAM_DATA) + q.enc_varint(0)
+                    + q.enc_varint(1 << 20),
+                q.enc_varint(q.F_RESET_STREAM) + q.enc_varint(0)
+                    + q.enc_varint(0) + q.enc_varint(0),
+                q.enc_varint(q.F_STREAM_BASE) + q.enc_varint(0),
+                q.enc_varint(q.F_HANDSHAKE_DONE),
+            ):
+                with pytest.raises(q.QuicError, match="forbidden"):
+                    conn._process_frames(level, frame)
+
+    def test_crypto_ack_ping_still_fine_below_app(self, endpoints):
+        conn, _ = self._pair(endpoints)
+        # PADDING + PING must stay legal at every level
+        conn._process_frames(q.LEVEL_HANDSHAKE,
+                             q.enc_varint(q.F_PADDING) * 3
+                             + q.enc_varint(q.F_PING))
+
+    def test_server_rejects_handshake_done(self, endpoints):
+        # RFC 9000 §19.20: only the SERVER sends HANDSHAKE_DONE; one
+        # arriving at a server is a violation even at the right level
+        _, srv_conn = self._pair(endpoints)
+        with pytest.raises(q.QuicError, match="HANDSHAKE_DONE"):
+            srv_conn._process_frames(q.LEVEL_APP,
+                                     q.enc_varint(q.F_HANDSHAKE_DONE))
+
+    def test_ack_for_unsent_pn_is_violation(self, endpoints):
+        # RFC 9000 §13.1: acknowledging a never-sent packet number must
+        # not poison largest_acked / the loss detector
+        conn, _ = self._pair(endpoints)
+        bogus_ack = (q.enc_varint(q.F_ACK) + q.enc_varint(1 << 40)
+                     + q.enc_varint(0) + q.enc_varint(0) + q.enc_varint(0))
+        with pytest.raises(q.QuicError, match="unsent"):
+            conn._process_frames(q.LEVEL_APP, bogus_ack)
+
+
+class TestKeyDiscard:
+    """RFC 9001 §4.9: Initial keys retire once the handshake level is in
+    use; Handshake keys retire at confirmation — on both sides — and the
+    connection keeps working on 1-RTT keys alone."""
+
+    def test_both_sides_discard_and_survive(self, endpoints):
+        srv, cli = endpoints
+        holder = {}
+
+        def serve():
+            holder["conn"] = srv.accept(timeout=10)
+
+        threading.Thread(target=serve, daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+                "conn" not in holder
+                or q.LEVEL_HANDSHAKE not in conn._discarded_levels):
+            time.sleep(0.02)
+        sconn = holder["conn"]
+        for c in (conn, sconn):
+            assert q.LEVEL_INITIAL in c._discarded_levels
+            assert q.LEVEL_INITIAL not in c.send_keys
+            assert q.LEVEL_INITIAL not in c.recv_keys
+        # confirmation retired the Handshake keys too (server at
+        # completion, client on HANDSHAKE_DONE)
+        assert conn.handshake_confirmed and sconn.handshake_confirmed
+        for c in (conn, sconn):
+            assert q.LEVEL_HANDSHAKE in c._discarded_levels
+            assert q.LEVEL_HANDSHAKE not in c.send_keys
+            assert q.LEVEL_HANDSHAKE not in c.recv_keys
+
+        # 1-RTT traffic unaffected
+        def echo():
+            st = sconn.accept_stream(timeout=10)
+            st.write(st.read_until_eof(timeout=10)); st.close()
+        threading.Thread(target=echo, daemon=True).start()
+        st = conn.open_stream()
+        st.write(b"post-discard"); st.close()
+        assert st.read_until_eof(timeout=10) == b"post-discard"
+
+    def test_packets_at_discarded_levels_are_dropped_not_parked(
+            self, endpoints):
+        srv, cli = endpoints
+        threading.Thread(target=lambda: srv.accept(timeout=10),
+                         daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+        # forge an Initial for this connection: it must vanish (the keys
+        # are gone forever), never occupy an undecryptable-parking slot
+        ck, _ = q.initial_keys(conn.original_dcid)
+        payload = q.enc_varint(q.F_PING) + b"\x00" * 40
+        pn_bytes = q.encode_pn(99, -1)
+        hdr = q.build_long_header(q.PKT_INITIAL, conn.local_cid, b"\xaa" * 8,
+                                  pn_bytes, len(payload))
+        datagram = q.protect(ck, hdr, 99, len(pn_bytes), payload)
+        before = len(conn._undecryptable)
+        conn.handle_datagram(datagram)
+        assert len(conn._undecryptable) == before
+        assert not conn._closed
+
+
 class TestResilience:
+    def test_malformed_input_closes_instead_of_zombie(self, endpoints):
+        """A non-QuicError escaping packet handling (ValueError/IndexError
+        from cert/TLS parsing) must CLOSE the connection — the silent
+        alternative leaves a half-open handshake slot forever."""
+        srv, cli = endpoints
+        threading.Thread(target=lambda: srv.accept(timeout=10),
+                         daemon=True).start()
+        conn = cli.dial("127.0.0.1", srv.port, timeout=10)
+
+        def explode(pkt, datagram):
+            raise ValueError("synthetic parser escape")
+
+        conn._handle_packet = explode
+        # any parseable 1-RTT datagram reaches _handle_packet
+        datagram = bytes([0x40]) + b"\x00" * 8 + b"\x00" * 24
+        conn.handle_datagram(datagram)
+        assert conn._closed
+        assert "internal error" in conn.close_reason
+
     def test_tls_errors_are_protocol_errors(self):
         # TlsError must be a QuicError so a failed handshake takes the
         # per-packet close path (CONNECTION_CLOSE) instead of escaping
