@@ -150,9 +150,69 @@ def run_measurement(force_cpu: bool) -> None:
         "miller_fused": _fp.miller_fused_active(),
         "wsm": _fp.wsm_fused_active(),
     }
+    if os.environ.get("BENCH_PIPELINE", "") == "1":
+        result["pipeline"] = _measure_pipeline(B, device_h2c)
     if "TPU" in str(dev):
         _record_tpu_history(result)
     print(json.dumps(result), flush=True)
+
+
+def _measure_pipeline(B: int, device_h2c: bool) -> dict:
+    """BENCH_PIPELINE=1: serial verify_signature_sets vs the pipelined
+    marshal/dispatch/resolve stream (PipelinedVerifier) over the same
+    batches — the A/B for PERF.md's "wall approaches max(marshal,
+    device)" claim.  Uses real SignatureSets (the backend path includes
+    host marshal, which is the whole point)."""
+    from lighthouse_tpu.beacon.processor import (
+        PipelinedVerifier,
+        ResilientVerifier,
+    )
+    from lighthouse_tpu.crypto.bls.api import (
+        PythonBackend,
+        SecretKey,
+        SignatureSet,
+    )
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import JaxBackend
+    from lighthouse_tpu.utils import metrics as M
+
+    n_batches = int(os.environ.get("BENCH_PIPELINE_BATCHES", "4"))
+    per = max(8, B // n_batches)
+    distinct = min(per, 256)
+    pool = []
+    for i in range(distinct):
+        sk = SecretKey(300 + i)
+        msg = bytes([i % 256, 7]) * 16
+        pool.append(SignatureSet(sk.sign(msg), [sk.public_key()], msg))
+    batches = [
+        [pool[j % distinct] for j in range(per)] for _ in range(n_batches)
+    ]
+
+    backend = JaxBackend(min_batch=8, device_h2c=device_h2c)
+    rv = ResilientVerifier(
+        device_verify=backend.verify_signature_sets,
+        cpu_verify=PythonBackend().verify_signature_sets,
+    )
+    pv = PipelinedVerifier.for_backend(rv, backend)
+
+    backend.verify_signature_sets(batches[0])  # compile, untimed
+    t0 = time.time()
+    for b in batches:
+        assert backend.verify_signature_sets(b)
+    serial = time.time() - t0
+    t0 = time.time()
+    outs = pv.verify_stream(batches)
+    piped = time.time() - t0
+    assert all(all(o.verdicts) for o in outs)
+    out = {
+        "batches": n_batches,
+        "sets_per_batch": per,
+        "serial_wall_sec": round(serial, 3),
+        "pipelined_wall_sec": round(piped, 3),
+        "speedup": round(serial / piped, 3) if piped > 0 else None,
+        "device_occupancy_pct": round(M.PIPELINE_OCCUPANCY.value(), 1),
+    }
+    print(f"pipeline A/B: {out}", file=sys.stderr)
+    return out
 
 
 def _history_path() -> str:

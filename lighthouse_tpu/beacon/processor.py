@@ -474,6 +474,165 @@ class ResilientVerifier:
         return BatchOutcome(verdicts=out.verdicts, device_calls=0)
 
 
+_FALLBACK = object()  # dispatch-stage sentinel: batch must take the ladder
+
+
+class PipelinedVerifier:
+    """Host/device overlap on top of the :class:`ResilientVerifier` ladder.
+
+    Three stages per batch — marshal (host worker pool), dispatch
+    (non-blocking device enqueue), resolve (block on the verdict) — with
+    at most ``depth`` batches in flight on the device (double-buffered by
+    default).  Batch N+1 marshals while batch N's kernel runs, so a
+    stream's wall time approaches max(marshal, device) instead of their
+    sum (PERF.md "Host pipeline": the one-core marshal at 5,008 sets/s
+    and the fused-Miller device at 6,221 sets/s are near co-bound).
+
+    Never-drop/never-raise is preserved by construction: the fast path
+    only short-circuits the all-valid case (device verdict True == every
+    set True, exactly the AND-reduce's meaning).  Everything else —
+    marshal failure, dispatch/resolve failure, breaker OPEN, device
+    verdict False (needs bisection attribution) — hands the RAW sets to
+    ``resilient.verify_batch``, the unchanged ladder.  The breaker is
+    consulted before dispatch and fed by dispatch/resolve outcomes, so
+    pipelined and ladder traffic share one view of device health; the
+    ``processor.verify`` chaos site fires on every device dispatch, same
+    as the ladder's device call.
+    """
+
+    def __init__(
+        self,
+        resilient: "ResilientVerifier",
+        marshal: Callable[[list], Any],
+        dispatch: Callable[[Any], Any],
+        resolve: Callable[[Any], bool],
+        workers: int = 2,
+        depth: int = 2,
+        injector=None,
+        now: Callable[[], float] = time.perf_counter,
+    ):
+        self.resilient = resilient
+        self._marshal = marshal
+        self._dispatch = dispatch
+        self._resolve = resolve
+        self.workers = max(1, workers)
+        self.depth = max(1, depth)
+        self.now = now
+        if injector is None:
+            from ..utils import faults as _faults
+
+            injector = _faults.INJECTOR
+        self.injector = injector
+
+    @classmethod
+    def for_backend(cls, resilient: "ResilientVerifier", backend,
+                    **kw) -> "PipelinedVerifier":
+        """Wire the three stages to a JaxBackend's marshal_sets /
+        dispatch / resolve split (crypto/bls/jax_backend/backend.py)."""
+        return cls(resilient, backend.marshal_sets, backend.dispatch,
+                   backend.resolve, **kw)
+
+    def verify_stream(self, batches: list[list]) -> list[BatchOutcome]:
+        """Verify a stream of batches with marshal/device overlap;
+        outcomes come back in input order, one per batch."""
+        from ..utils import metrics as M
+
+        batches = [list(b) for b in batches]
+        if not batches:
+            return []
+        from concurrent.futures import ThreadPoolExecutor
+
+        wall0 = self.now()
+        marshal_busy = 0.0
+        device_busy = 0.0
+        outcomes: list[BatchOutcome] = []
+        inflight: deque = deque()  # (sets, handle)
+
+        def timed_marshal(sets):
+            t0 = self.now()
+            try:
+                mb = self._marshal(sets)
+            except Exception:  # noqa: BLE001 — marshal failure -> ladder
+                mb = None
+            return mb, self.now() - t0
+
+        with ThreadPoolExecutor(
+            max_workers=self.workers,
+            thread_name_prefix="marshal",
+        ) as pool:
+            futs = [pool.submit(timed_marshal, b) for b in batches]
+            for sets, fut in zip(batches, futs):
+                mb, m_secs = fut.result()
+                marshal_busy += m_secs
+                t0 = self.now()
+                handle = self._dispatch_stage(mb)
+                device_busy += self.now() - t0
+                inflight.append((sets, handle))
+                while len(inflight) > self.depth:
+                    sets_done, h = inflight.popleft()
+                    out, d_secs = self._resolve_stage(sets_done, h)
+                    device_busy += d_secs
+                    outcomes.append(out)
+            while inflight:
+                sets_done, h = inflight.popleft()
+                out, d_secs = self._resolve_stage(sets_done, h)
+                device_busy += d_secs
+                outcomes.append(out)
+
+        wall = max(self.now() - wall0, 1e-9)
+        M.PIPELINE_MARSHAL_SECONDS.inc(marshal_busy)
+        M.PIPELINE_DEVICE_SECONDS.inc(device_busy)
+        M.PIPELINE_OCCUPANCY.set(100.0 * min(device_busy / wall, 1.0))
+        return outcomes
+
+    # -- stages ------------------------------------------------------------
+
+    def _dispatch_stage(self, mb):
+        """Enqueue one marshalled batch on the device, non-blocking.
+        Returns the in-flight handle, or ``_FALLBACK`` when the batch
+        must take the resilient ladder instead (marshal/validation
+        failure, breaker says no device, dispatch raised)."""
+        if mb is None or getattr(mb, "invalid", False):
+            return _FALLBACK
+        if not self.resilient.breaker.allow_device():
+            return _FALLBACK
+        try:
+            self.injector.fire("processor.verify")
+            return self._dispatch(mb)
+        except Exception:  # noqa: BLE001 — infrastructure, not verdict
+            self.resilient.breaker.record_failure()
+            return _FALLBACK
+
+    def _resolve_stage(self, sets, handle):
+        """Block on one in-flight batch; (BatchOutcome, device_seconds).
+        Any outcome but a True verdict delegates to the ladder."""
+        from ..utils import metrics as M
+
+        if handle is _FALLBACK:
+            M.PIPELINE_FALLBACKS.inc()
+            return self.resilient.verify_batch(sets), 0.0
+        t0 = self.now()
+        try:
+            ok = self._resolve(handle)
+        except Exception:  # noqa: BLE001 — infrastructure, not verdict
+            d = self.now() - t0
+            self.resilient.breaker.record_failure()
+            M.PIPELINE_FALLBACKS.inc()
+            return self.resilient.verify_batch(sets), d
+        d = self.now() - t0
+        self.resilient.breaker.record_success()
+        if ok:
+            self.resilient.journal.append(("device", len(sets)))
+            return (
+                BatchOutcome(verdicts=[True] * len(sets), device_calls=1),
+                d,
+            )
+        # verdict False: re-verify through the ladder for per-set
+        # bisection attribution (False batches are the rare case)
+        M.PIPELINE_FALLBACKS.inc()
+        return self.resilient.verify_batch(sets), d
+
+
 class DeadlineBatcher:
     """Deadline-driven batch assembly for one batchable kind.
 

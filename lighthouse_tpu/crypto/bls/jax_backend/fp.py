@@ -335,6 +335,23 @@ def set_pallas(enabled: bool) -> None:
     _PALLAS_MODE = enabled
 
 
+_FORCE_DEVICE_PATHS = False
+
+
+def set_force_device_paths(enabled: bool) -> None:
+    """Treat the backend as a TPU for routing decisions (the *_active()
+    gates) regardless of jax.default_backend().  For CPU-side tracing and
+    auditing of the exact device composition (tools/dispatch_audit.py):
+    pallas calls reached this way must run with interpret=True or be
+    abstractly traced, never Mosaic-compiled."""
+    global _FORCE_DEVICE_PATHS
+    _FORCE_DEVICE_PATHS = enabled
+
+
+def _device_backend() -> bool:
+    return _FORCE_DEVICE_PATHS or jax.default_backend() == "tpu"
+
+
 _CHAINS_MODE: bool | None = None
 
 
@@ -360,7 +377,7 @@ def chains_active() -> bool:
     """The ONE gate for chain-kernel routing (fp_pow, h2c fp2 chains):
     pallas on + chains opted in + a real TPU backend."""
     return (
-        pallas_enabled() and chains_enabled() and jax.default_backend() == "tpu"
+        pallas_enabled() and chains_enabled() and _device_backend()
     )
 
 
@@ -392,7 +409,7 @@ def wsm_fused_active() -> bool:
     + a real TPU backend (interpret mode is reached explicitly by
     tests)."""
     return (
-        pallas_enabled() and wsm_enabled() and jax.default_backend() == "tpu"
+        pallas_enabled() and wsm_enabled() and _device_backend()
     )
 
 
@@ -422,7 +439,7 @@ def miller_fused_active() -> bool:
     """Gate for the fused Miller-step kernels: pallas on + opted in + a
     real TPU backend (interpret mode is reached explicitly by tests)."""
     return (
-        pallas_enabled() and miller_enabled() and jax.default_backend() == "tpu"
+        pallas_enabled() and miller_enabled() and _device_backend()
     )
 
 

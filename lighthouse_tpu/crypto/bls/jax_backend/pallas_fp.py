@@ -136,26 +136,52 @@ def _mont_kernel(a_ref, b_ref, p_ref, pp_ref, o_ref):
     o_ref[:] = _mont_core(a_ref[:], b_ref[:], p_ref[:], pp_ref[:])
 
 
-def _make_window_kernel(w: int):
-    """One fixed-window step: acc^(2^w) * operand, the WHOLE window one
-    kernel with state in VMEM.  The exponent is STATIC, so the window
-    digit picks WHICH precomputed power rides in as ``operand`` — the
-    kernel itself is digit-independent.  One compiled program serves
-    every window of every chain (the per-pattern variant compiled ~24
-    distinct programs for the Fermat chain alone, which is what made
-    the chains+miller composition a pathological Mosaic compile —
-    session2 06:52Z)."""
+def _select_power(d, powers):
+    """Value-level one-hot select of powers[d] for a traced digit d —
+    Mosaic has no dynamic gather over a trace-time list, so this is
+    2^w - 1 vector selects (cheap next to the w Montgomery squares each
+    digit already costs)."""
+    sel = powers[0]
+    for k in range(1, len(powers)):
+        sel = jnp.where(d == k, powers[k], sel)
+    return sel
 
-    def kernel(acc_ref, operand_ref, p_ref, pp_ref, o_ref):
-        acc = acc_ref[:]
-        operand = operand_ref[:]
-        pl_ = p_ref[:]
-        pp = pp_ref[:]
-        for _ in range(w):
-            acc = _mont_sqr_core(acc, pl_, pp)  # triangle square (~-16%)
-        o_ref[:] = _mont_core(acc, operand, pl_, pp)
 
-    return kernel
+def _make_megachain_kernel(w: int, n_digits: int):
+    """The WHOLE exponent chain as ONE Pallas program: the MSB-first
+    base-2^w digit tape rides in as a scalar-prefetch operand (SMEM),
+    the 2^w-entry power table is built in-kernel (2^w - 2 Montgomery
+    products), and a fori_loop walks the tape — w squares plus one
+    table-selected multiply per digit.  The compiled program depends
+    only on (w, n_digits), never on the digit VALUES: the Fermat
+    affinization chain, the h2c sqrt chains, and any future exponent of
+    equal digit count share one Mosaic program.  The previous design
+    stacked one pallas_call per digit (~96 dispatches for Fermat alone)
+    and keyed programs per window pattern (~24 distinct programs), which
+    is what made the chains+miller composition a pathological >6,700 s
+    Mosaic compile — session2 06:52Z.
+
+    Digit 0 multiplies by the Montgomery one (value-preserving), so the
+    loop body is uniform and needs no predication."""
+
+    def megachain_kernel(tape_ref, base_ref, p_ref, pp_ref, one_ref,
+                         o_ref):
+        base = base_ref[:]
+        pl_, pp = p_ref[:], pp_ref[:]
+        powers = [one_ref[:], base]
+        for _ in range(2, 1 << w):
+            powers.append(_mont_core(powers[-1], base, pl_, pp))
+
+        def step(i, acc):
+            for _ in range(w):
+                acc = _mont_sqr_core(acc, pl_, pp)  # triangle sqr (~-16%)
+            sel = _select_power(tape_ref[i], powers)
+            return _mont_core(acc, sel, pl_, pp)
+
+        acc = _select_power(tape_ref[0], powers)
+        o_ref[:] = jax.lax.fori_loop(1, n_digits, step, acc)
+
+    return megachain_kernel
 
 
 @functools.lru_cache(maxsize=64)
@@ -212,63 +238,83 @@ def _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2):
     return r0, r1
 
 
-def _make_fp2_window_kernel(w: int):
-    """Fp2 fixed-window step: acc^(2^w) * operand, one uniform kernel
-    (w=0 degenerates to a pure fp2 multiply — used to build the power
-    table).  Same static-digit design as _make_window_kernel: the
-    per-pattern variant compiled one program per 8-bit pattern, the
-    exact blowup that made composed traces pathological to compile.
+def _make_fp2_megachain_kernel(w: int, n_digits: int):
+    """Fp2 whole-chain program, same digit-tape design as
+    _make_megachain_kernel (the power table is built in-kernel with
+    2^w - 2 Karatsuba multiplies; powers[0] is the Montgomery one so a
+    0 digit is value-preserving).
 
-    Bounds: window entry is worst-case post-mul (<=3.2P, <=5.2P), which
-    _fp2_sqr_core's envelope admits; the final multiply's subtrahends
-    are Montgomery outputs (<1.2P) so the k=2 biases hold for any
-    in-envelope operand, including power-table entries."""
+    Bounds: table entries and the loop accumulator are worst-case
+    post-mul (<=3.2P, <=5.2P), which _fp2_sqr_core's envelope admits;
+    every multiply's subtrahends are Montgomery outputs (<1.2P) so the
+    k=2 biases hold for any in-envelope operand — the envelope closes
+    across fori_loop iterations exactly as it did across the old
+    stacked per-digit calls."""
 
-    def kernel(a0_ref, a1_ref, b0_ref, b1_ref, p_ref, pp_ref, b16_ref,
-               b2_ref, o0_ref, o1_ref):
+    def fp2_megachain_kernel(tape_ref, a0_ref, a1_ref, p_ref, pp_ref,
+                             b16_ref, b2_ref, one_ref, o0_ref, o1_ref):
         a0, a1 = a0_ref[:], a1_ref[:]
-        b0, b1 = b0_ref[:], b1_ref[:]
         pl_, pp = p_ref[:], pp_ref[:]
         b16, b2 = b16_ref[:], b2_ref[:]
-        for _ in range(w):
-            a0, a1 = _fp2_sqr_core(a0, a1, pl_, pp, b16)
-        a0, a1 = _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2)
-        o0_ref[:] = a0
-        o1_ref[:] = a1
+        powers = [(one_ref[:], jnp.zeros_like(a0)), (a0, a1)]
+        for _ in range(2, 1 << w):
+            p0, p1 = powers[-1]
+            powers.append(_fp2_mul_core(p0, p1, a0, a1, pl_, pp, b2))
+        pow0 = [p[0] for p in powers]
+        pow1 = [p[1] for p in powers]
 
-    return kernel
+        def step(i, carry):
+            c0, c1 = carry
+            for _ in range(w):
+                c0, c1 = _fp2_sqr_core(c0, c1, pl_, pp, b16)
+            d = tape_ref[i]
+            return _fp2_mul_core(c0, c1, _select_power(d, pow0),
+                                 _select_power(d, pow1), pl_, pp, b2)
+
+        d0 = tape_ref[0]
+        acc = (_select_power(d0, pow0), _select_power(d0, pow1))
+        o0_ref[:], o1_ref[:] = jax.lax.fori_loop(1, n_digits, step, acc)
+
+    return fp2_megachain_kernel
 
 
 @functools.lru_cache(maxsize=32)
-def _fp2_chain_call(n_padded: int, tile: int, w: int, interpret: bool):
+def _fp2_megachain_call(n_padded: int, tile: int, w: int, n_digits: int,
+                        interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    grid = (n_padded // tile,)
-    spec = pl.BlockSpec((26, tile), lambda i: (0, i),
+    spec = pl.BlockSpec((26, tile), lambda i, tape: (0, i),
                         memory_space=pltpu.VMEM)
-    const_spec = pl.BlockSpec((26, tile), lambda i: (0, 0),
+    const_spec = pl.BlockSpec((26, tile), lambda i, tape: (0, 0),
                               memory_space=pltpu.VMEM)
     out_shape = jax.ShapeDtypeStruct((26, n_padded), jnp.uint32)
-    return pl.pallas_call(
-        _make_fp2_window_kernel(w),
-        out_shape=(out_shape, out_shape),
-        grid=grid,
-        in_specs=[spec, spec, spec, spec, const_spec, const_spec,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_padded // tile,),
+        in_specs=[spec, spec, const_spec, const_spec, const_spec,
                   const_spec, const_spec],
         out_specs=(spec, spec),
+    )
+    return pl.pallas_call(
+        _make_fp2_megachain_kernel(w, n_digits),
+        out_shape=(out_shape, out_shape),
+        grid_spec=grid_spec,
         interpret=interpret,
     )
 
 
 def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
-                  w: int = CHAIN_WINDOW, interpret: bool = False):
+                  w: int = CHAIN_WINDOW, interpret: bool | None = None):
     """(a0 + a1·u)^e for static MSB-first bits (leading bit must be 1);
-    inputs reduced (bound <= 2).  Fixed-window like pow_chain_limbs:
-    one uniform kernel + a power table built with the w=0 (pure-mul)
-    variant.  Returns raw limb pair (exit bounds <= (3.2P, 5.2P);
-    callers re-reduce)."""
+    inputs reduced (bound <= 2).  ONE pallas dispatch: the digit tape is
+    a scalar-prefetch operand, power table and window walk live in the
+    kernel.  Returns raw limb pair (exit bounds <= (3.2P, 5.2P); callers
+    re-reduce).  interpret=None resolves by backend (interpret off-TPU),
+    so forced device paths still execute on CPU."""
     assert bits and bits[0] == 1
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     n = a0_limbs.shape[-1]
     tile = pick_tile(n)
     n_padded = -(-n // tile) * tile
@@ -280,45 +326,39 @@ def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
         jnp.broadcast_to(jnp.asarray(c, dtype=jnp.uint32), (26, tile))
         for c in (_P_COLS, _PP_COLS, _BIAS16_COLS, _BIAS2_COLS)
     ]
-    digits = _window_digits(
-        "".join("1" if b else "0" for b in bits), w)
-
     one0 = jnp.broadcast_to(
         jnp.asarray(np.asarray(F.int_to_limbs(F.R1_INT)).reshape(26, 1),
-                    dtype=jnp.uint32), (26, n_padded))
-    zero1 = jnp.zeros((26, n_padded), dtype=jnp.uint32)
-    mul = _fp2_chain_call(n_padded, tile, 0, interpret)
-    powers = [(one0, zero1), (a0_limbs, a1_limbs)]
-    for _ in range(2, 1 << w):
-        p0, p1 = powers[-1]
-        powers.append(mul(p0, p1, a0_limbs, a1_limbs, *consts))
-
-    call = _fp2_chain_call(n_padded, tile, w, interpret)
-    acc0, acc1 = powers[digits[0]]
-    for d in digits[1:]:
-        b0, b1 = powers[d]
-        acc0, acc1 = call(acc0, acc1, b0, b1, *consts)
+                    dtype=jnp.uint32), (26, tile))
+    digits = _window_digits(
+        "".join("1" if b else "0" for b in bits), w)
+    tape = jnp.asarray(digits, dtype=jnp.int32)
+    call = _fp2_megachain_call(n_padded, tile, w, len(digits), interpret)
+    acc0, acc1 = call(tape, a0_limbs, a1_limbs, *consts, one0)
     if n_padded != n:
         return acc0[:, :n], acc1[:, :n]
     return acc0, acc1
 
 
-@functools.lru_cache(maxsize=256)
-def _chain_call(n_padded: int, tile: int, w: int, interpret: bool):
+@functools.lru_cache(maxsize=64)
+def _megachain_call(n_padded: int, tile: int, w: int, n_digits: int,
+                    interpret: bool):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    grid = (n_padded // tile,)
-    spec = pl.BlockSpec((26, tile), lambda i: (0, i),
+    spec = pl.BlockSpec((26, tile), lambda i, tape: (0, i),
                         memory_space=pltpu.VMEM)
-    const_spec = pl.BlockSpec((26, tile), lambda i: (0, 0),
+    const_spec = pl.BlockSpec((26, tile), lambda i, tape: (0, 0),
                               memory_space=pltpu.VMEM)
-    return pl.pallas_call(
-        _make_window_kernel(w),
-        out_shape=jax.ShapeDtypeStruct((26, n_padded), jnp.uint32),
-        grid=grid,
-        in_specs=[spec, spec, const_spec, const_spec],
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_padded // tile,),
+        in_specs=[spec, const_spec, const_spec, const_spec],
         out_specs=spec,
+    )
+    return pl.pallas_call(
+        _make_megachain_kernel(w, n_digits),
+        out_shape=jax.ShapeDtypeStruct((26, n_padded), jnp.uint32),
+        grid_spec=grid_spec,
         interpret=interpret,
     )
 
@@ -331,20 +371,26 @@ def _window_digits(bitstr: str, w: int) -> list[int]:
     return [int(bitstr[i:i + w], 2) for i in range(0, len(bitstr), w)]
 
 
-def pow_chain_limbs(base_limbs, exponent: int, interpret: bool = False,
-                    w: int = CHAIN_WINDOW):
-    """base^exponent (Montgomery domain) via fixed-window in-kernel
-    chains: MSB-first base-2^w digits; per digit one uniform kernel runs
-    w squares + one multiply by the statically-selected precomputed
-    power (digit 0 multiplies by the Montgomery one — value-preserving,
-    keeps the kernel uniform).  For the 381-bit Fermat exponent this is
-    ~475 in-kernel products vs ~610 for sparse square-and-multiply AND
-    one compiled program instead of ~24.
+def pow_chain_limbs(base_limbs, exponent: int,
+                    interpret: bool | None = None, w: int = CHAIN_WINDOW):
+    """base^exponent (Montgomery domain) as ONE pallas dispatch: the
+    MSB-first base-2^w digit tape is a scalar-prefetch operand, the
+    power table is built in-kernel, and a fori_loop runs w squares + one
+    table-selected multiply per digit (digit 0 multiplies by the
+    Montgomery one — value-preserving, keeps the loop body uniform).
+    For the 381-bit Fermat exponent this is ~475 in-kernel products in
+    one program/dispatch, vs ~96 stacked dispatches over ~24 distinct
+    programs for the old per-window design.
 
     base must be strict/quasi limbs of a value bounded < 4.3P (mont
     outputs and reduced values qualify: every in-kernel product is then
-    strict×strict, far under the bound-product ceiling)."""
+    strict×strict, far under the bound-product ceiling).  interpret=None
+    resolves by backend (interpret off-TPU), so forced device paths
+    still execute on CPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     digits = _window_digits(bin(exponent)[2:], w)
+    tape = jnp.asarray(digits, dtype=jnp.int32)
 
     n = base_limbs.shape[-1]
     tile = pick_tile(n)
@@ -357,21 +403,13 @@ def pow_chain_limbs(base_limbs, exponent: int, interpret: bool = False,
     pp_tile = jnp.broadcast_to(
         jnp.asarray(_PP_COLS, dtype=jnp.uint32), (26, tile)
     )
-    # power table base^0..base^(2^w - 1) via the shared mont kernel
     one = jnp.broadcast_to(
         jnp.asarray(
             np.asarray(F.int_to_limbs(F.R1_INT)).reshape(26, 1),
             dtype=jnp.uint32),
-        (26, n_padded))
-    powers = [one, base_limbs]
-    mont = _mont_call(n_padded, tile, interpret)
-    for _ in range(2, 1 << w):
-        powers.append(mont(powers[-1], base_limbs, p_tile, pp_tile))
-
-    call = _chain_call(n_padded, tile, w, interpret)
-    acc = powers[digits[0]]  # leading digit initializes the accumulator
-    for d in digits[1:]:
-        acc = call(acc, powers[d], p_tile, pp_tile)
+        (26, tile))
+    call = _megachain_call(n_padded, tile, w, len(digits), interpret)
+    acc = call(tape, base_limbs, p_tile, pp_tile, one)
     return acc[:, :n] if n_padded != n else acc
 
 

@@ -183,10 +183,14 @@ def _der_read_tlv(data: bytes, pos: int) -> tuple[int, bytes, int]:
 
 def make_libp2p_cert(
     identity_key: ec.EllipticCurvePrivateKey,
+    not_before: datetime.datetime | None = None,
+    not_after: datetime.datetime | None = None,
 ) -> tuple[bytes, ec.EllipticCurvePrivateKey]:
     """Self-signed P-256 certificate binding the secp256k1 libp2p identity.
 
-    Returns (certificate DER, certificate private key).
+    Returns (certificate DER, certificate private key).  ``not_before`` /
+    ``not_after`` override the default window (now-1h .. now+3650d) —
+    used by the clock-skew regression tests.
     """
     cert_key = ec.generate_private_key(ec.SECP256R1())
     spki = cert_key.public_key().public_bytes(
@@ -214,8 +218,8 @@ def make_libp2p_cert(
         .issuer_name(name)
         .public_key(cert_key.public_key())
         .serial_number(x509.random_serial_number())
-        .not_valid_before(now - datetime.timedelta(hours=1))
-        .not_valid_after(now + datetime.timedelta(days=3650))
+        .not_valid_before(not_before or now - datetime.timedelta(hours=1))
+        .not_valid_after(not_after or now + datetime.timedelta(days=3650))
         .add_extension(
             x509.UnrecognizedExtension(LIBP2P_CERT_OID, signed_key),
             critical=True,
@@ -225,15 +229,42 @@ def make_libp2p_cert(
     return cert.public_bytes(serialization.Encoding.DER), cert_key
 
 
+# Clock-skew tolerance on the certificate validity window.  The libp2p TLS
+# spec deliberately de-emphasizes X.509 validity (identity comes from the
+# SignedKey extension, not a CA chain), so a strict `not_before <= now`
+# check only manufactures handshake failures against peers with skewed
+# clocks — spec-conformant implementations tolerate skew.
+CERT_VALIDITY_SKEW = datetime.timedelta(hours=2)
+
+
 def verify_libp2p_cert(cert_der: bytes) -> tuple[bytes, ec.EllipticCurvePublicKey]:
     """Validate the libp2p extension; returns (peer_id, cert public key).
 
     The cert public key is what CertificateVerify must be checked
     against; the peer id is the authenticated libp2p identity.
+
+    Checks (libp2p TLS spec): the certificate's own self-signature (it is
+    self-signed — a cert whose signature does not verify under its own
+    public key is structurally invalid even though impersonation is
+    independently blocked by CertificateVerify + the SignedKey identity
+    signature), the skew-tolerant validity window, and the SignedKey
+    extension's identity signature over the cert public key.
     """
     cert = x509.load_der_x509_certificate(cert_der)
+    try:
+        cert.public_key().verify(
+            cert.signature,
+            cert.tbs_certificate_bytes,
+            ec.ECDSA(cert.signature_hash_algorithm),
+        )
+    except Exception:
+        raise TlsError("certificate self-signature invalid") from None
     now = datetime.datetime.now(datetime.timezone.utc)
-    if not (cert.not_valid_before_utc <= now <= cert.not_valid_after_utc):
+    if not (
+        cert.not_valid_before_utc - CERT_VALIDITY_SKEW
+        <= now
+        <= cert.not_valid_after_utc + CERT_VALIDITY_SKEW
+    ):
         raise TlsError("certificate outside validity window")
     try:
         ext = cert.extensions.get_extension_for_oid(LIBP2P_CERT_OID)

@@ -163,6 +163,48 @@ TASKS_ABANDONED = Counter(
     ("name",),
 )
 
+# ---------------------------------------------------------------------------
+# Pipelined verify path (PipelinedVerifier, beacon/processor.py): the
+# marshal/device overlap surface.  Marshal and device seconds are cumulative
+# busy time per stage; occupancy is the device stage's share of the last
+# stream's wall time (100% == the device never waited on the host).
+# ---------------------------------------------------------------------------
+
+PIPELINE_MARSHAL_SECONDS = Gauge(
+    "pipeline_marshal_seconds_total",
+    "Cumulative host marshal busy time in the pipelined verify path",
+)
+PIPELINE_DEVICE_SECONDS = Gauge(
+    "pipeline_device_seconds_total",
+    "Cumulative device dispatch+wait busy time in the pipelined verify path",
+)
+PIPELINE_OCCUPANCY = Gauge(
+    "pipeline_device_occupancy_percent",
+    "Device busy time as a percent of wall time over the last verify stream "
+    "(100 == perfect marshal/device overlap)",
+)
+PIPELINE_FALLBACKS = Counter(
+    "pipeline_resilient_fallbacks_total",
+    "Pipelined batches handed to the ResilientVerifier ladder (device "
+    "verdict False, dispatch failure, or marshal failure)",
+)
+
+# Per-config Pallas dispatch accounting (tools/dispatch_audit.py): distinct
+# lowered programs and stacked pallas_call dispatches in the traced verify
+# composition, labelled by backend config string (e.g. "chains+miller+h2c").
+DISPATCH_PROGRAMS = Gauge(
+    "dispatch_distinct_pallas_programs",
+    "Distinct lowered Pallas programs in the traced verify composition, "
+    "by backend config",
+    ("config",),
+)
+DISPATCH_CALLS = Gauge(
+    "dispatch_stacked_pallas_calls",
+    "Stacked pallas_call dispatches (static call sites, scan bodies "
+    "counted once) in the traced verify composition, by backend config",
+    ("config",),
+)
+
 
 def render() -> str:
     """Prometheus text exposition of every registered metric."""

@@ -277,3 +277,132 @@ class TestAdviceR4Fixes:
         assert out == payload
         # the padding frame belongs to THIS stream: consumed past it
         assert (stream + padding + tail)[consumed:] == tail
+
+
+class TestLibp2pCertHardening:
+    """ADVICE r5: verify_libp2p_cert must check the X.509 self-signature
+    and tolerate clock skew on the validity window (libp2p TLS spec —
+    identity comes from the SignedKey extension, not CA validity)."""
+
+    @pytest.fixture(autouse=True)
+    def _require_cryptography(self):
+        pytest.importorskip("cryptography")
+
+    @staticmethod
+    def _identity():
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        return ec.generate_private_key(ec.SECP256K1())
+
+    def test_valid_cert_roundtrips(self):
+        from lighthouse_tpu.network.noise import peer_id_from_pubkey
+        from lighthouse_tpu.network.tls13 import (
+            make_libp2p_cert,
+            verify_libp2p_cert,
+        )
+        from cryptography.hazmat.primitives import serialization
+
+        identity = self._identity()
+        cert_der, _ = make_libp2p_cert(identity)
+        peer_id, _ = verify_libp2p_cert(cert_der)
+        pub = identity.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint,
+        )
+        assert peer_id == peer_id_from_pubkey(pub)
+
+    def test_self_signature_must_verify(self):
+        """A cert SIGNED by a different key than the embedded public key
+        (structurally invalid self-signed cert) must be rejected, even
+        though its SignedKey extension is internally consistent."""
+        import datetime
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+
+        from lighthouse_tpu.network import tls13
+        from lighthouse_tpu.network.noise import marshal_identity_pubkey
+
+        identity = self._identity()
+        cert_key = ec.generate_private_key(ec.SECP256R1())
+        rogue_key = ec.generate_private_key(ec.SECP256R1())
+        spki = cert_key.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        identity_sig = identity.sign(
+            tls13.LIBP2P_CERT_PREFIX + spki, ec.ECDSA(hashes.SHA256())
+        )
+        identity_pub = identity.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint,
+        )
+        signed_key = tls13._der_seq(
+            tls13._der_octet_string(marshal_identity_pubkey(identity_pub))
+            + tls13._der_octet_string(identity_sig)
+        )
+        name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, "lighthouse-tpu")]
+        )
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(cert_key.public_key())  # embedded key: cert_key
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(hours=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(
+                x509.UnrecognizedExtension(tls13.LIBP2P_CERT_OID, signed_key),
+                critical=True,
+            )
+            .sign(rogue_key, hashes.SHA256())  # signature: rogue_key
+        )
+        with pytest.raises(tls13.TlsError, match="self-signature"):
+            tls13.verify_libp2p_cert(
+                cert.public_bytes(serialization.Encoding.DER)
+            )
+
+    def test_validity_window_tolerates_clock_skew(self):
+        """A peer whose clock is slightly ahead issues a cert whose
+        not_before is in OUR future; within CERT_VALIDITY_SKEW it must
+        still be accepted (strictness here only breaks handshakes)."""
+        import datetime
+
+        from lighthouse_tpu.network.tls13 import (
+            CERT_VALIDITY_SKEW,
+            make_libp2p_cert,
+            verify_libp2p_cert,
+        )
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        ahead = now + CERT_VALIDITY_SKEW / 2
+        cert_der, _ = make_libp2p_cert(
+            self._identity(),
+            not_before=ahead,
+            not_after=ahead + datetime.timedelta(days=365),
+        )
+        verify_libp2p_cert(cert_der)  # must not raise
+
+    def test_validity_window_still_enforced_beyond_skew(self):
+        import datetime
+
+        from lighthouse_tpu.network.tls13 import (
+            CERT_VALIDITY_SKEW,
+            TlsError,
+            make_libp2p_cert,
+            verify_libp2p_cert,
+        )
+
+        now = datetime.datetime.now(datetime.timezone.utc)
+        expired = now - CERT_VALIDITY_SKEW * 2
+        cert_der, _ = make_libp2p_cert(
+            self._identity(),
+            not_before=expired - datetime.timedelta(days=1),
+            not_after=expired,
+        )
+        with pytest.raises(TlsError, match="validity"):
+            verify_libp2p_cert(cert_der)

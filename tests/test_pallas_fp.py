@@ -105,6 +105,123 @@ def test_flag_routes_mont_mul():
         np.asarray(got.limbs)
     )
 
+# ---------------------------------------------------------------------------
+# Zero-sized-vector regression guard (the i=25 _wide_square bug class)
+# ---------------------------------------------------------------------------
+#
+# Interpret mode silently tolerates zero-row intermediates (p[1:] at the
+# last unrolled square iteration), but real Mosaic lowering rejects them
+# with "vector types must have positive constant sizes" — a failure only
+# visible on hardware.  These tests abstract-eval the kernels (trace
+# only, nothing executes) and walk every equation of every staged jaxpr
+# — including pallas_call sub-jaxprs, scan/fori bodies, and each
+# unrolled chain/square iteration — asserting no zero-sized shape is
+# ever emitted.
+
+
+def _iter_sub_jaxprs(val):
+    core = jax.core
+    if isinstance(val, core.ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, core.Jaxpr):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for item in val:
+            yield from _iter_sub_jaxprs(item)
+
+
+def _collect_zero_dim_avals(jaxpr, seen, bad):
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", None)
+            if shape and 0 in shape:
+                bad.append(f"{eqn.primitive.name}: {aval}")
+        for val in eqn.params.values():
+            for sub in _iter_sub_jaxprs(val):
+                _collect_zero_dim_avals(sub, seen, bad)
+
+
+def _assert_no_zero_dims(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    bad: list = []
+    _collect_zero_dim_avals(closed.jaxpr, set(), bad)
+    assert not bad, (
+        "zero-sized vector shapes staged (Mosaic rejects these even "
+        "though interpret mode tolerates them): " + "; ".join(bad[:5])
+    )
+
+
+def test_square_and_product_emit_no_zero_sized_vectors():
+    """Every unrolled iteration of the wide square/product cores — the
+    exact site of the i=25 bug (p[1:] was a zero-row vector)."""
+    a = jnp.zeros((26, 128), dtype=jnp.uint32)
+    _assert_no_zero_dims(PF._wide_square, a)
+    _assert_no_zero_dims(lambda x: PF._wide_product(x, x), a)
+    _assert_no_zero_dims(
+        lambda x: PF._mont_core(x, x, x, x), a
+    )
+
+
+def test_megachain_kernels_emit_no_zero_sized_vectors():
+    """The consolidated chain programs, traced end-to-end through
+    pallas_call (small w / digit count — zero-shape emission is a
+    structural property of the kernel body, not of the tape length)."""
+    tape = jnp.zeros((3,), dtype=jnp.int32)
+    op = jnp.zeros((26, 128), dtype=jnp.uint32)
+    call = PF._megachain_call(128, 128, 2, 3, True)
+    _assert_no_zero_dims(call, tape, op, op, op, op)
+    fcall = PF._fp2_megachain_call(128, 128, 2, 3, True)
+    _assert_no_zero_dims(fcall, tape, op, op, op, op, op, op, op)
+
+
+def test_mont_kernel_emits_no_zero_sized_vectors():
+    a = jnp.zeros((26, 128), dtype=jnp.uint32)
+    call = PF._mont_call(128, 128, True)
+    _assert_no_zero_dims(call, a, a, a, a)
+
+
+# ---------------------------------------------------------------------------
+# Full-exponent megachain proofs (the chains the verify path really runs)
+# ---------------------------------------------------------------------------
+
+
+@_CHAINS_OPTIN
+@pytest.mark.slow  # one XLA:CPU interpret compile of the 96-digit program
+def test_fermat_inversion_chain():
+    """The affinization inversion: a^(P-2) as ONE megachain program
+    (96 base-16 digits) == the pow oracle, bit-identical."""
+    a = _rand_lfp(2)
+    got = PF.pow_chain_limbs(a.limbs, F.P_INT - 2, interpret=True)
+    a_std = F.decode_mont(a)
+    got_std = F.decode_mont(F.LFp(got, 2.0))
+    assert got_std == [pow(x, F.P_INT - 2, F.P_INT) for x in a_std]
+
+
+@_CHAINS_OPTIN
+@pytest.mark.slow  # one XLA:CPU interpret compile of the 191-digit program
+def test_sqrt_chain_fp2():
+    """The device-h2c candidate-sqrt chain: a^((P^2+7)/16) as ONE
+    megachain program (191 base-16 digits) == the Fp2 oracle."""
+    from lighthouse_tpu.crypto.bls.fields import Fp2
+
+    e = (F.P_INT * F.P_INT + 7) // 16
+    c0s = [rng.randrange(F.P_INT) for _ in range(2)]
+    c1s = [rng.randrange(F.P_INT) for _ in range(2)]
+    a0 = jnp.asarray(F.ints_to_limbs([x * F.R_INT % F.P_INT for x in c0s]))
+    a1 = jnp.asarray(F.ints_to_limbs([x * F.R_INT % F.P_INT for x in c1s]))
+    bits = tuple(int(c) for c in bin(e)[2:])
+    r0, r1 = PF.fp2_pow_chain(a0, a1, bits, interpret=True)
+    got0 = F.decode_mont(F.LFp(r0, 6.0))
+    got1 = F.decode_mont(F.LFp(r1, 6.0))
+    for j in range(2):
+        want = Fp2(c0s[j], c1s[j]).pow(e)
+        assert (got0[j] % F.P_INT, got1[j] % F.P_INT) == (want.c0, want.c1)
+
+
 # suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
-# deselect with -m 'not compile' for the sub-minute consensus tier
+# deselect with -m 'not compile' for the fast consensus/network tier
 pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

@@ -1,0 +1,280 @@
+#!/usr/bin/env python3
+"""Static dispatch audit: count distinct Pallas programs per backend config.
+
+The chains+miller composition failed on hardware not because any kernel
+was wrong but because the OLD chain design asked Mosaic to compile ~21
+chain-segment programs plus ~24 Fermat window variants alongside the
+fused Miller programs — a >6,700 s pathological compile (session2
+06:52Z).  The megachain consolidation (pallas_fp.py) makes the program
+count a budgeted, auditable quantity: this tool traces the exact device
+kernel each config would run (`jax.make_jaxpr` — trace only, nothing is
+Mosaic-compiled), walks the jaxpr for `pallas_call` equations, and
+fingerprints each by (kernel name & source line, operand avals, grid).
+
+Two numbers per config:
+
+* ``programs`` — distinct fingerprints ≈ distinct Mosaic compiles the
+  config pays on first run (the compile-time axis).
+* ``calls`` — static ``pallas_call`` equation count ≈ stacked dispatches
+  per batch (the dispatch-overhead axis).  A pallas_call under a
+  ``lax.scan``/``fori_loop`` counts once here even though it dispatches
+  per iteration: this is the *static* composition, which is exactly what
+  Mosaic compile cost scales with.
+
+Budget enforcement (the acceptance criterion): any config with chains
+enabled must stage at most ``--budget`` (default 6) distinct megachain
+programs.  Violations — or a watchdog timeout while tracing a
+budget-critical config — exit nonzero.
+
+Usage:
+    tools/pyrun tools/dispatch_audit.py            # default matrix
+    tools/pyrun tools/dispatch_audit.py --quick    # budget-critical only
+    tools/pyrun tools/dispatch_audit.py --full     # + slow stacked-op trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# Config matrix
+# ---------------------------------------------------------------------------
+
+# (name, pallas, chains, miller, wsm, device_h2c, budget_critical)
+MATRIX = [
+    # default TPU composition today: pallas + fused miller
+    ("pallas+miller", True, False, True, False, False, False),
+    # full fused stack without chains
+    ("pallas+miller+wsm", True, False, True, True, False, False),
+    # THE composition the budget exists for: chains + fused miller
+    ("pallas+chains+miller", True, True, True, False, False, True),
+    # same with device h2c — the sqrt chains live here
+    ("pallas+chains+miller+h2c", True, True, True, False, True, True),
+]
+
+# per-op stacked path (no fusion): thousands of pallas_call eqns, each
+# re-tracing the Montgomery kernel — minutes of trace time on one core,
+# so opt-in via --full
+SLOW_MATRIX = [
+    ("pallas", True, False, False, False, False, False),
+]
+
+
+class TraceTimeout(Exception):
+    pass
+
+
+def _alarm(_sig, _frm):
+    raise TraceTimeout()
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr walk
+# ---------------------------------------------------------------------------
+
+
+def _iter_jaxprs(obj):
+    """Yield every Jaxpr reachable from a params value (ClosedJaxpr,
+    Jaxpr, or containers thereof)."""
+    import jax.core as jcore
+
+    if isinstance(obj, jcore.ClosedJaxpr):
+        yield obj.jaxpr
+    elif isinstance(obj, jcore.Jaxpr):
+        yield obj
+    elif isinstance(obj, (list, tuple)):
+        for item in obj:
+            yield from _iter_jaxprs(item)
+
+
+def _fingerprint(eqn):
+    """Identity of one staged Pallas program: kernel name + source line
+    (``name_and_src_info`` reprs as ``_mont_kernel at .../pallas_fp.py:135``),
+    operand avals, grid.  Two eqns with equal fingerprints lower to one
+    Mosaic program (the compile cache keys on the same data)."""
+    params = eqn.params
+    nsi = str(params.get("name_and_src_info", params.get("name", "?")))
+    gm = params.get("grid_mapping")
+    grid = tuple(getattr(gm, "grid", ()) or ())
+    avals = tuple(str(v.aval) for v in eqn.invars)
+    return (nsi, grid, avals)
+
+
+def _walk(jaxpr, seen_jaxprs, programs, counts):
+    if id(jaxpr) in seen_jaxprs:
+        return
+    seen_jaxprs.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            fp = _fingerprint(eqn)
+            programs.setdefault(fp, 0)
+            programs[fp] += 1
+            counts[0] += 1
+        for val in eqn.params.values():
+            for sub in _iter_jaxprs(val):
+                _walk(sub, seen_jaxprs, programs, counts)
+
+
+def audit_jaxpr(closed):
+    programs: dict[tuple, int] = {}
+    counts = [0]
+    _walk(closed.jaxpr, set(), programs, counts)
+    return programs, counts[0]
+
+
+def _is_chain_program(fp) -> bool:
+    """Chain programs are the megachain kernels (pallas_fp.py); the
+    budget bounds how many DISTINCT ones a composition stages."""
+    return "megachain_kernel" in fp[0]
+
+
+# ---------------------------------------------------------------------------
+# Per-config trace
+# ---------------------------------------------------------------------------
+
+
+def _build_signature_sets(n: int):
+    from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet
+
+    sets = []
+    for i in range(n):
+        sk = SecretKey(200 + i)
+        msg = bytes([i % 256]) * 32
+        sets.append(SignatureSet(sk.sign(msg), [sk.public_key()], msg))
+    return sets
+
+
+def trace_config(name, pallas, chains, miller, wsm, device_h2c, sets,
+                 timeout_s):
+    import jax
+
+    from lighthouse_tpu.crypto.bls.jax_backend import backend as B
+    from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+
+    F.set_force_device_paths(True)
+    F.set_pallas(pallas)
+    F.set_chains(chains)
+    F.set_miller(miller)
+    F.set_wsm(wsm)
+
+    bk = B.JaxBackend(min_batch=8, device_h2c=device_h2c)
+    mb = bk.marshal_sets(sets)
+    if mb.invalid:
+        raise RuntimeError("marshal of synthetic sets failed")
+    fn = B._verify_kernel_h2c if device_h2c else B._verify_kernel
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(timeout_s)
+    t0 = time.perf_counter()
+    try:
+        closed = jax.make_jaxpr(fn)(*mb.args)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+        F.set_force_device_paths(False)
+    trace_s = time.perf_counter() - t0
+
+    programs, n_calls = audit_jaxpr(closed)
+    # distinct chain PROGRAMS = distinct full fingerprints: two chains of
+    # different digit count share the kernel def line but lower to
+    # different Mosaic programs (the tape aval differs)
+    chain_fps = [fp for fp in programs if _is_chain_program(fp)]
+    return {
+        "config": name,
+        "programs": len(programs),
+        "calls": n_calls,
+        "chain_programs": len(chain_fps),
+        "chain_kernels": sorted({fp[0] for fp in chain_fps}),
+        "trace_seconds": round(trace_s, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Reporting
+# ---------------------------------------------------------------------------
+
+
+def _record_history(rows, budget, ok):
+    path = os.path.join(ROOT, "BENCH_HISTORY.jsonl")
+    entry = {
+        "kind": "dispatch_audit",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "budget_chain_programs": budget,
+        "pass": ok,
+        "configs": rows,
+    }
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sets", type=int, default=2,
+                    help="synthetic signature sets per batch (padded to 8)")
+    ap.add_argument("--budget", type=int, default=6,
+                    help="max distinct chain programs per composition")
+    ap.add_argument("--timeout", type=int, default=900,
+                    help="per-config trace watchdog seconds")
+    ap.add_argument("--quick", action="store_true",
+                    help="budget-critical configs only")
+    ap.add_argument("--full", action="store_true",
+                    help="also trace the slow per-op stacked path")
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append an audit row to BENCH_HISTORY.jsonl")
+    args = ap.parse_args()
+
+    matrix = list(MATRIX)
+    if args.quick:
+        matrix = [c for c in matrix if c[6]]
+    if args.full:
+        matrix += SLOW_MATRIX
+
+    from lighthouse_tpu.utils import metrics as M
+
+    sets = _build_signature_sets(args.sets)
+    rows, ok = [], True
+    for name, pallas, chains, miller, wsm, h2c, critical in matrix:
+        try:
+            row = trace_config(name, pallas, chains, miller, wsm, h2c,
+                               sets, args.timeout)
+        except TraceTimeout:
+            row = {"config": name, "timeout": True,
+                   "timeout_seconds": args.timeout}
+            if critical:
+                ok = False
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            continue
+        M.DISPATCH_PROGRAMS.set(row["programs"], (name,))
+        M.DISPATCH_CALLS.set(row["calls"], (name,))
+        if chains and row["chain_programs"] > args.budget:
+            row["budget_violation"] = True
+            ok = False
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    if not args.no_history:
+        _record_history(rows, args.budget, ok)
+
+    verdict = "PASS" if ok else "FAIL"
+    print(f"dispatch_audit: {verdict} "
+          f"(budget: <= {args.budget} chain programs per composition)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Next-window TPU session: megachain composition A/B + pipelined marshal.
+
+The megachain consolidation (pallas_fp.py) replaced the per-window /
+per-pattern chain programs (~21 chain segments + ~24 Fermat variants —
+the >6,700 s pathological Mosaic compile of session2) with digit-tape
+kernels: the chains+miller composition now stages exactly TWO chain
+programs (Fermat-96 Fp + sqrt-191 Fp2; tools/dispatch_audit.py enforces
+the <= 6 budget statically).  This session measures what the audit can
+only bound:
+
+  1. dispatch audit row for the ledger (static, pre-hardware): program
+     and stacked-call counts per config into BENCH_HISTORY.jsonl.
+  2. B=512 chains=1 miller=1 — the consolidated composition's compile
+     time and steady-state rate vs the ledger's best B=512.
+  3. Same with BENCH_DEVICE_H2C=1 — the sqrt chains (device h2c) that
+     motivated the +137 ms/batch overhead attack.
+  4. BENCH_PIPELINE=1 on the best config found — serial
+     verify_signature_sets vs PipelinedVerifier.verify_stream
+     (marshal/device overlap; wall should approach max, not sum).
+  5. B=8192 headline in the best config + entry() warm for the
+     driver's graft check.
+
+Every bench child appends to BENCH_HISTORY.jsonl via bench.py; stage
+results also land in TPU_SESSION_r05.jsonl like the predecessors.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_session import LOG, ROOT, log, ok, run_bench_child  # noqa: E402
+
+
+def best_b512() -> float:
+    """Best successful non-h2c B=512 verify rate in the ledger."""
+    best = 0.0
+    try:
+        with open(LOG) as f:
+            for line in f:
+                d = json.loads(line)
+                r = d.get("result") or {}
+                if (isinstance(r, dict) and r.get("batch") == 512
+                        and r.get("value", 0) > best
+                        and not r.get("device_h2c")
+                        and "TPU" in str(r.get("device", ""))):
+                    best = r["value"]
+    except OSError:
+        pass
+    return best
+
+
+def run_dispatch_audit(timeout: float = 1800) -> None:
+    """Static program-count audit (CPU trace only, no Mosaic): the
+    BENCH_HISTORY row the acceptance criterion reads."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "dispatch_audit.py"),
+             "--quick"],
+            cwd=ROOT, capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        out = (proc.stdout + proc.stderr)[-500:]
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        out, rc = f"timeout {timeout}s", -1
+    log({"stage": "dispatch audit (static)", "rc": rc,
+         "wall_sec": round(time.time() - t0, 1), "tail": out})
+
+
+def run_pipeline_ab(chains: bool, timeout: float = 6000) -> dict | None:
+    """B=2048 with BENCH_PIPELINE=1: the serial-vs-pipelined A/B rides
+    in the bench child's result row."""
+    try:
+        os.environ["BENCH_PIPELINE"] = "1"
+        return run_bench_child(2048, chains=chains, miller=True,
+                               timeout=timeout)
+    finally:
+        os.environ.pop("BENCH_PIPELINE", None)
+
+
+def run_entry_warm(timeout: float = 5500) -> None:
+    """Compile-run entry() exactly as the driver's graft check does."""
+    code = (
+        "import __graft_entry__ as G, jax; "
+        "G._enable_compile_cache(jax); "
+        "fn, args = G.entry(); "
+        "import time; t0=time.time(); "
+        "r = jax.jit(fn)(*args); "
+        "getattr(r, 'block_until_ready', lambda: r)(); "
+        "print('entry warm ok in %.1fs' % (time.time()-t0))"
+    )
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=ROOT, capture_output=True,
+            text=True, timeout=timeout,
+        )
+        out = (proc.stdout + proc.stderr)[-300:]
+    except subprocess.TimeoutExpired:
+        out = f"timeout {timeout}s"
+    log({"stage": "entry warm (B=4 h2c, production defaults)",
+         "wall_sec": round(time.time() - t0, 1), "tail": out})
+
+
+def main() -> None:
+    base = best_b512()
+    log({"stage": "session4 start (megachain + pipeline)",
+         "pid": os.getpid(), "best_b512": base})
+
+    run_dispatch_audit()
+
+    # 2. the composition that could not compile pre-consolidation:
+    #    watch compile_sec — the whole point of the megachain rewrite
+    comp = run_bench_child(512, chains=True, miller=True, timeout=6000)
+    comp_win = ok(comp) and comp["value"] > base
+    log({"stage": "megachain chains+miller verdict",
+         "composed": (comp or {}).get("value"),
+         "compile_sec": (comp or {}).get("compile_sec"),
+         "base": base, "comp_win": comp_win})
+
+    # 3. device-h2c composition: the sqrt megachains
+    h2c = run_bench_child(512, chains=True, miller=True, device_h2c=True,
+                          timeout=6000)
+    log({"stage": "megachain h2c composition",
+         "value": (h2c or {}).get("value"),
+         "compile_sec": (h2c or {}).get("compile_sec")})
+
+    # 4. pipelined marshal A/B on the winning chain setting
+    pipe = run_pipeline_ab(chains=comp_win)
+    log({"stage": "pipeline A/B",
+         "pipeline": (pipe or {}).get("pipeline")})
+
+    # 5. headline + warm
+    run_bench_child(8192, chains=comp_win, miller=True, timeout=7000)
+    run_entry_warm()
+    log({"stage": "session4 done", "chains_default": comp_win})
+
+
+if __name__ == "__main__":
+    main()
