@@ -439,6 +439,10 @@ class BeaconChain:
         )
         self.store.put_block(block_root, signed_block)
         self.store.put_state(state.root(), state)
+        # durability point: a block counts as imported only once its
+        # records are fsync'd — a SIGKILL after this line cannot lose the
+        # head (MemoryStore flush is a no-op, SlabStore is a real fsync)
+        self.store.flush()
         self._states[block_root] = state
         self._observed_blocks.add(block_root)
         self.pubkey_cache.update(state)

@@ -62,6 +62,13 @@ class HotColdDB:
         self.db = store if store is not None else MemoryStore()
         self.types = types_family
         self.slots_per_restore_point = slots_per_restore_point
+        # a store that truncated a torn tail on open may have lost the
+        # suffix of the log: re-anchor the head indexes to what survived
+        # BEFORE anything reads them (the open-after-SIGKILL contract)
+        report = getattr(self.db, "recovery_report", None)
+        self.last_recovery = report
+        if report is not None and not report.clean:
+            self.re_anchor()
         raw = self.db.get(DBColumn.BEACON_META, SCHEMA_KEY)
         if raw is None:
             self.db.put(
@@ -93,6 +100,48 @@ class HotColdDB:
     def split(self) -> Split:
         raw = self.db.get(DBColumn.BEACON_META, SPLIT_KEY)
         return Split.decode(raw) if raw else Split(0, bytes(32))
+
+    # ---------------------------------------------------- crash recovery
+
+    def re_anchor(self) -> dict:
+        """Restore block/index consistency after torn-tail recovery.
+
+        Truncation drops a *suffix* of the log, so two shapes of damage are
+        possible: a slot→root index entry whose block record was cut (the
+        entry itself survived an earlier record), or a block whose index
+        entry was cut (put_block writes block first, index second).  Drop
+        the former, backfill the latter, and report the resulting head —
+        the highest indexed slot whose block actually loads.
+        """
+        dropped = backfilled = 0
+        for slot_key in list(self.db.keys(DBColumn.BEACON_BLOCK_ROOTS)):
+            root = self.db.get(DBColumn.BEACON_BLOCK_ROOTS, slot_key)
+            if root is not None and not self.block_exists(root):
+                self.db.delete(DBColumn.BEACON_BLOCK_ROOTS, slot_key)
+                dropped += 1
+        for col in (DBColumn.BEACON_BLOCK, DBColumn.COLD_BLOCK):
+            for root in self.db.keys(col):
+                raw = self.db.get(col, root)
+                slot = self._block_slot(raw) if raw else None
+                if slot is None:
+                    continue
+                key = slot.to_bytes(8, "big")
+                if self.db.get(DBColumn.BEACON_BLOCK_ROOTS, key) is None:
+                    self.db.put(DBColumn.BEACON_BLOCK_ROOTS, key, root)
+                    backfilled += 1
+        head_slot, head_root = 0, None
+        for slot_key in self.db.keys(DBColumn.BEACON_BLOCK_ROOTS):
+            slot = int.from_bytes(slot_key, "big")
+            if slot >= head_slot:
+                head_slot = slot
+                head_root = self.db.get(DBColumn.BEACON_BLOCK_ROOTS, slot_key)
+        self.db.flush()
+        return {
+            "head_slot": head_slot,
+            "head_root": head_root,
+            "index_dropped": dropped,
+            "index_backfilled": backfilled,
+        }
 
     # ------------------------------------------------------------- blocks
 
@@ -252,6 +301,10 @@ class HotColdDB:
 
     def get_item(self, column: DBColumn, key: bytes) -> bytes | None:
         return self.db.get(column, key)
+
+    def flush(self):
+        """Durability point: on a SlabStore backend this is a real fsync."""
+        self.db.flush()
 
     def close(self):
         self.db.close()

@@ -4,12 +4,29 @@ Twin of beacon_node/store/src/lib.rs: the `KeyValueStore`/`ItemStore` trait
 surface (:53,153,318) and `DBColumn` column families (:218).  Two backends,
 matching the reference's LevelDB + MemoryStore pair: the C++ slabdb engine
 (lighthouse_tpu/native/slabdb.cpp) for disk, a dict for tests.
+
+Crash-safety surface (PR 3): every SlabStore open yields a
+:class:`~.wal.RecoveryReport` describing what replay kept/dropped from a
+torn or corrupt tail; `flush` is a real fsync; and the `store.open` /
+`store.put` / `store.flush` FaultInjector sites make disk failures and torn
+writes deterministically injectable (utils/faults.py `io-error` /
+`torn-write` kinds).
 """
 
 from __future__ import annotations
 
 import ctypes
+import os
 from enum import Enum
+
+from ..utils import faults as _faults
+from ..utils.metrics import (
+    STORE_BYTES_TRUNCATED,
+    STORE_CRC_FAILURES,
+    STORE_RECORDS_DROPPED,
+    STORE_TORN_TAIL_RECOVERIES,
+)
+from .wal import TAG_PUT, RecoveryReport, encode_record
 
 
 class DBColumn(Enum):
@@ -81,11 +98,19 @@ class MemoryStore(KeyValueStore):
 
 
 class SlabStore(KeyValueStore):
-    """Disk store over the native C++ slabdb engine (ctypes ABI)."""
+    """Disk store over the native C++ slabdb engine (ctypes ABI).
+
+    Opening replays the CRC32-C-framed log; ``recovery_report`` records
+    what a torn/corrupt tail cost (always present; ``.clean`` on a healthy
+    open).  A ``torn-write`` fault at ``store.put`` appends a truncated
+    frame and leaves the store closed — the process "died" mid-write, and
+    only a reopen (which runs recovery) brings the data back.
+    """
 
     def __init__(self, path: str):
         from ..native import load
 
+        _faults.fire("store.open", path)
         lib = load("slabdb")
         lib.slab_open.restype = ctypes.c_void_p
         lib.slab_open.argtypes = [ctypes.c_char_p]
@@ -117,10 +142,32 @@ class SlabStore(KeyValueStore):
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_uint64),
         ]
+        for fn in ("slab_recovery_kept", "slab_recovery_dropped",
+                   "slab_recovery_truncated"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.slab_recovery_flags.restype = ctypes.c_int
+        lib.slab_recovery_flags.argtypes = [ctypes.c_void_p]
         self._lib = lib
+        self._path = path
         self._h = lib.slab_open(path.encode())
         if not self._h:
             raise IOError(f"slabdb failed to open {path}")
+        flags = lib.slab_recovery_flags(self._h)
+        self.recovery_report = RecoveryReport(
+            records_kept=lib.slab_recovery_kept(self._h),
+            records_dropped=lib.slab_recovery_dropped(self._h),
+            bytes_truncated=lib.slab_recovery_truncated(self._h),
+            tail_torn=bool(flags & 1),
+            migrated=bool(flags & 2),
+            crc_mismatch=bool(flags & 4),
+        )
+        if self.recovery_report.tail_torn:
+            STORE_TORN_TAIL_RECOVERIES.inc()
+            STORE_RECORDS_DROPPED.inc(self.recovery_report.records_dropped)
+            STORE_BYTES_TRUNCATED.inc(self.recovery_report.bytes_truncated)
+        if self.recovery_report.crc_mismatch:
+            STORE_CRC_FAILURES.inc()
 
     def _k(self, column: DBColumn, key: bytes) -> bytes:
         return column.value + key
@@ -144,8 +191,30 @@ class SlabStore(KeyValueStore):
     def put(self, column, key, value):
         k = self._k(column, key)
         v = bytes(value)
+        try:
+            _faults.fire("store.put", (column, key))
+        except _faults.TornWrite as tw:
+            self._tear(k, v, tw.fraction)
+            raise _faults.StorageFault(
+                f"injected torn write: crashed mid-append of a "
+                f"{len(v)}-byte value"
+            ) from tw
         if self._lib.slab_put(self._handle(), k, len(k), v, len(v)) != 0:
             raise IOError("slabdb put failed")
+
+    def _tear(self, k: bytes, v: bytes, fraction: float) -> None:
+        """Simulate a SIGKILL mid-``fwrite``: flush and abandon the engine
+        handle (the 'crashed' process held it), then append only a prefix
+        of the framed record.  The store is unusable afterwards; a reopen
+        runs torn-tail recovery."""
+        h, self._h = self._h, None
+        self._lib.slab_close(h)
+        frame = encode_record(TAG_PUT, k, v)
+        keep = min(len(frame) - 1, max(1, int(len(frame) * fraction)))
+        with open(self._path, "ab") as f:
+            f.write(frame[:keep])
+            f.flush()
+            os.fsync(f.fileno())
 
     def delete(self, column, key):
         k = self._k(column, key)
@@ -181,7 +250,9 @@ class SlabStore(KeyValueStore):
             raise IOError("slabdb compact failed")
 
     def flush(self):
-        self._lib.slab_flush(self._handle())
+        _faults.fire("store.flush", self._path)
+        if self._lib.slab_flush(self._handle()) != 0:
+            raise IOError("slabdb flush (fsync) failed")
 
     def close(self):
         if self._h:

@@ -8,6 +8,8 @@ from .faults import (  # noqa: F401
     FaultError,
     FaultInjector,
     InjectedCrash,
+    StorageFault,
+    TornWrite,
 )
 from .logging import TimeLatch, get_logger, log_with, recent_logs  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, render  # noqa: F401

@@ -14,6 +14,10 @@ the stack:
                             overflow
   ``processor.verify``      ResilientVerifier's device call (L6)
   ``executor.task.<name>``  each (re)start of a supervised task (L1)
+  ``store.open``            SlabStore open (L2) — disk gone at startup
+  ``store.put``             each SlabStore append (L2) — I/O errors and
+                            torn writes (crash mid-``fwrite``)
+  ``store.flush``           SlabStore fsync (L2) — failed durability point
 
 A site that nothing armed costs one dict lookup (an unarmed ``fire`` is a
 no-op), so production paths keep the hooks compiled in — the same sites
@@ -29,6 +33,11 @@ Fault kinds:
 * ``overflow`` ``check`` reports the site as saturated (queue-full analog)
 * ``crash``    raise :class:`InjectedCrash` — task-death analog; the
                supervisor, not the breaker, owns this one
+* ``io-error``   raise (default :class:`StorageFault`, an ``OSError``) —
+                 the disk failed the operation
+* ``torn-write`` raise :class:`TornWrite` carrying ``fraction`` — the site
+                 must append only that fraction of the framed record (what
+                 a SIGKILL mid-write leaves) and then fail the operation
 
 Arming is bounded: ``times=N`` auto-disarms after N firings (the breaker
 recovery tests ride this), ``probability`` makes soak tests stochastic.
@@ -56,7 +65,23 @@ class InjectedCrash(FaultError):
     """Injected task death (a service coroutine raising unexpectedly)."""
 
 
-_KINDS = ("error", "slow", "corrupt", "overflow", "crash")
+class StorageFault(FaultError, OSError):
+    """Injected storage I/O failure (also an OSError so generic disk-error
+    handlers catch it)."""
+
+
+class TornWrite(FaultError):
+    """Injected torn write: the armed site must append only ``fraction`` of
+    the framed record — exactly what a SIGKILL mid-``fwrite`` leaves on
+    disk — and then fail the operation as a crash would."""
+
+    def __init__(self, msg: str = "injected torn write", fraction: float = 0.5):
+        super().__init__(msg)
+        self.fraction = fraction
+
+
+_KINDS = ("error", "slow", "corrupt", "overflow", "crash", "io-error",
+          "torn-write")
 
 
 @dataclass
@@ -67,6 +92,7 @@ class Fault:
     mutate: Callable[[Any], Any] | None = None
     remaining: int | None = None  # None = until disarmed
     probability: float = 1.0
+    fraction: float = 0.5  # torn-write: share of the record that hits disk
 
 
 class FaultInjector:
@@ -99,6 +125,7 @@ class FaultInjector:
         mutate: Callable[[Any], Any] | None = None,
         times: int | None = None,
         probability: float = 1.0,
+        fraction: float = 0.5,
     ) -> None:
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; have {_KINDS}")
@@ -109,10 +136,12 @@ class FaultInjector:
             exc = lambda: DeviceFault(f"injected device fault at {site}")  # noqa: E731
         if exc is None and kind == "crash":
             exc = lambda: InjectedCrash(f"injected crash at {site}")  # noqa: E731
+        if exc is None and kind == "io-error":
+            exc = lambda: StorageFault(f"injected storage fault at {site}")  # noqa: E731
         with self._lock:
             self._armed[site] = Fault(
                 kind=kind, exc=exc, delay=delay, mutate=mutate,
-                remaining=times, probability=probability,
+                remaining=times, probability=probability, fraction=fraction,
             )
 
     def disarm(self, site: str | None = None) -> None:
@@ -130,12 +159,14 @@ class FaultInjector:
     def arm_from_spec(self, spec: str) -> None:
         """Parse a CLI arming spec: ``site=kind[:arg][xN]``.
 
-        ``arg`` is the delay in seconds for ``slow`` faults; ``xN`` bounds
-        the arm to N firings.  Examples::
+        ``arg`` is the delay in seconds for ``slow`` faults and the on-disk
+        fraction for ``torn-write`` faults; ``xN`` bounds the arm to N
+        firings.  Examples::
 
             bls.device_verify=error x3   ->  "bls.device_verify=errorx3"
             bls.device_verify=slow:0.5
             executor.task.gossip=crashx1
+            store.put=torn-write:0.4x1
         """
         site, _, rest = spec.partition("=")
         if not site or not rest:
@@ -145,8 +176,11 @@ class FaultInjector:
             rest, _, n = rest.rpartition("x")
             times = int(n)
         kind, _, arg = rest.partition(":")
+        kind = kind.strip()
         delay = float(arg) if (arg and kind == "slow") else 0.0
-        self.arm(site.strip(), kind.strip(), delay=delay, times=times)
+        fraction = float(arg) if (arg and kind == "torn-write") else 0.5
+        self.arm(site.strip(), kind, delay=delay, times=times,
+                 fraction=fraction)
 
     # -- firing ------------------------------------------------------------
 
@@ -177,7 +211,9 @@ class FaultInjector:
             return payload
         if f.kind == "corrupt":
             return f.mutate(payload) if f.mutate is not None else payload
-        if f.kind in ("error", "crash"):
+        if f.kind == "torn-write":
+            raise TornWrite(fraction=f.fraction)
+        if f.kind in ("error", "crash", "io-error"):
             raise f.exc()
         return payload  # "overflow" is a check()-site kind; fire is a no-op
 

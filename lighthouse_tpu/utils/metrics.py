@@ -164,6 +164,30 @@ TASKS_ABANDONED = Counter(
 )
 
 # ---------------------------------------------------------------------------
+# Storage durability (slabdb crash recovery, store/kv.py): written at store
+# open when replay truncates a torn/corrupt tail, and by the offline
+# `db verify` scan.  The persistence-path analog of the compute counters
+# above.
+# ---------------------------------------------------------------------------
+
+STORE_TORN_TAIL_RECOVERIES = Counter(
+    "store_torn_tail_recoveries_total",
+    "Store opens that detected and truncated a torn or corrupt log tail",
+)
+STORE_RECORDS_DROPPED = Counter(
+    "store_records_dropped_total",
+    "Log record frames lost past the valid prefix in torn-tail recovery",
+)
+STORE_BYTES_TRUNCATED = Counter(
+    "store_bytes_truncated_total",
+    "Bytes cut from the log tail by torn-tail recovery",
+)
+STORE_CRC_FAILURES = Counter(
+    "store_crc_failures_total",
+    "CRC32-C record mismatches detected (engine replay + offline verify)",
+)
+
+# ---------------------------------------------------------------------------
 # Pipelined verify path (PipelinedVerifier, beacon/processor.py): the
 # marshal/device overlap surface.  Marshal and device seconds are cumulative
 # busy time per stage; occupancy is the device stage's share of the last

@@ -38,6 +38,25 @@ def test_db_tools(tmp_path, capsys):
     assert main(["db", "compact", path]) == 0
 
 
+def test_db_verify(tmp_path, capsys):
+    from lighthouse_tpu.store import DBColumn, SlabStore
+
+    path = str(tmp_path / "v.slab")
+    s = SlabStore(path)
+    s.put(DBColumn.BEACON_BLOCK, b"k", b"v" * 100)
+    s.flush()
+    s.close()
+    assert main(["db", "verify", path]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] and rep["per_column"]["BEACON_BLOCK"]["live"] == 1
+
+    with open(path, "ab") as f:  # torn tail → exit 1 with a recovery report
+        f.write(b"\x01\xff\xff")
+    assert main(["db", "verify", path]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert not rep["ok"] and rep["recovery"]["tail_torn"]
+
+
 def test_bn_short_run(capsys):
     rc = main([
         "--spec", "minimal", "bn", "--validators", "16", "--http-port", "0",

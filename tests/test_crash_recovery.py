@@ -1,0 +1,311 @@
+"""Crash-recovery tests: kill -9 restart cycles, injected storage faults,
+slashing-protection crash ordering, and HotColdDB re-anchoring.
+
+The subprocess tests drive tools/crash_harness.py (the same harness the
+acceptance smoke run uses) at deterministic kill points; the in-process
+tests exercise the `store.open` / `store.put` / `store.flush` fault sites
+and the recovery surfaces directly.
+"""
+
+import importlib.util
+import os
+import struct
+import sys
+
+import pytest
+
+from lighthouse_tpu.store import HotColdDB, SlabStore
+from lighthouse_tpu.store.kv import DBColumn
+from lighthouse_tpu.utils import faults
+from lighthouse_tpu.utils.faults import INJECTOR, StorageFault
+from lighthouse_tpu.utils.metrics import (
+    STORE_RECORDS_DROPPED,
+    STORE_TORN_TAIL_RECOVERIES,
+)
+from lighthouse_tpu.validator.slashing_protection import (
+    SlashingDatabase,
+    SlashingProtectionError,
+)
+
+pytestmark = pytest.mark.chaos
+
+_HARNESS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "crash_harness.py",
+)
+
+
+def _load_harness():
+    spec = importlib.util.spec_from_file_location("crash_harness", _HARNESS_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["crash_harness"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    INJECTOR.disarm()
+
+
+# ------------------------------------------------------------- kill -9 cycles
+
+
+@pytest.mark.parametrize("kill_after", [1, 5, 13])
+def test_kill_restart_deterministic_points(tmp_path, kill_after):
+    """SIGKILL right after the Nth fsync'd commit: everything committed
+    must survive the restart, and the pre-kill double-sign stays refused."""
+    harness = _load_harness()
+    datadir = tmp_path / f"kill-{kill_after}"
+    datadir.mkdir()
+    result = harness.run_iteration(
+        seed=kill_after * 7919, datadir=str(datadir), kill_after=kill_after
+    )
+    assert result["commits"] >= kill_after
+    assert result["double_sign_refused"]
+
+
+def test_kill_restart_randomized(tmp_path):
+    harness = _load_harness()
+    datadir = tmp_path / "kill-rand"
+    datadir.mkdir()
+    result = harness.run_iteration(seed=20260805, datadir=str(datadir), kill_after=9)
+    assert result["commits"] >= 9
+    assert result["double_sign_refused"]
+
+
+# -------------------------------------------------------- injected torn write
+
+
+def test_torn_write_recovers_on_reopen(tmp_path):
+    """A torn-write fault appends a truncated frame and kills the store;
+    reopening truncates the torn tail (dropping exactly the in-flight
+    record) and keeps everything fsync'd before it."""
+    path = str(tmp_path / "torn.db")
+    s = SlabStore(path)
+    s.put(DBColumn.BEACON_BLOCK, b"a" * 32, b"\x01" * 100)
+    s.put(DBColumn.BEACON_BLOCK, b"b" * 32, b"\x02" * 100)
+    s.flush()
+
+    faults.arm("store.put", "torn-write", fraction=0.5, times=1)
+    with pytest.raises(StorageFault):
+        s.put(DBColumn.BEACON_BLOCK, b"c" * 32, b"\x03" * 100)
+    # the store is dead — the "process" crashed mid-write
+    with pytest.raises(IOError):
+        s.get(DBColumn.BEACON_BLOCK, b"a" * 32)
+
+    s2 = SlabStore(path)
+    rep = s2.recovery_report
+    assert rep.tail_torn
+    assert rep.records_dropped == 1  # exactly the in-flight record
+    assert rep.bytes_truncated > 0
+    assert s2.get(DBColumn.BEACON_BLOCK, b"a" * 32) == b"\x01" * 100
+    assert s2.get(DBColumn.BEACON_BLOCK, b"b" * 32) == b"\x02" * 100
+    assert s2.get(DBColumn.BEACON_BLOCK, b"c" * 32) is None
+    s2.close()
+
+    # third open: the tail was truncated away, so the log is clean again
+    s3 = SlabStore(path)
+    assert s3.recovery_report.clean
+    s3.close()
+
+
+def test_torn_write_fraction_from_spec(tmp_path):
+    path = str(tmp_path / "tornspec.db")
+    s = SlabStore(path)
+    s.put(DBColumn.OP_POOL, b"k1", b"v1")
+    s.flush()
+    faults.arm_from_spec("store.put=torn-write:0.9x1")
+    with pytest.raises(StorageFault):
+        s.put(DBColumn.OP_POOL, b"k2", b"v" * 1000)
+    s2 = SlabStore(path)
+    assert s2.recovery_report.tail_torn
+    assert s2.get(DBColumn.OP_POOL, b"k1") == b"v1"
+    s2.close()
+
+
+# ----------------------------------------------------------- injected io-error
+
+
+def test_io_error_on_open(tmp_path):
+    faults.arm("store.open", "io-error", times=1)
+    with pytest.raises(StorageFault):
+        SlabStore(str(tmp_path / "noopen.db"))
+    # next open (fault consumed) succeeds
+    s = SlabStore(str(tmp_path / "noopen.db"))
+    assert s.recovery_report.clean
+    s.close()
+
+
+def test_io_error_on_flush_surfaces(tmp_path):
+    s = SlabStore(str(tmp_path / "noflush.db"))
+    s.put(DBColumn.OP_POOL, b"k", b"v")
+    faults.arm("store.flush", "io-error", times=1)
+    with pytest.raises(OSError):
+        s.flush()
+    # the store survives a failed fsync; the data is still readable and a
+    # later flush succeeds
+    assert s.get(DBColumn.OP_POOL, b"k") == b"v"
+    s.flush()
+    s.close()
+
+
+def test_io_error_on_put(tmp_path):
+    s = SlabStore(str(tmp_path / "noput.db"))
+    faults.arm("store.put", "io-error", times=1)
+    with pytest.raises(StorageFault):
+        s.put(DBColumn.OP_POOL, b"k", b"v")
+    # io-error (unlike torn-write) leaves the store usable
+    s.put(DBColumn.OP_POOL, b"k", b"v")
+    assert s.get(DBColumn.OP_POOL, b"k") == b"v"
+    s.close()
+
+
+def test_recovery_metrics_counters(tmp_path):
+    path = str(tmp_path / "metrics.db")
+    s = SlabStore(path)
+    s.put(DBColumn.OP_POOL, b"k", b"v")
+    s.flush()
+    faults.arm("store.put", "torn-write", times=1)
+    with pytest.raises(StorageFault):
+        # value big enough that half the frame still contains the full
+        # header — the dropped in-flight record is countable (dropped=1)
+        s.put(DBColumn.OP_POOL, b"k2", b"v" * 200)
+    before_rec = STORE_TORN_TAIL_RECOVERIES.value()
+    before_drop = STORE_RECORDS_DROPPED.value()
+    s2 = SlabStore(path)
+    assert STORE_TORN_TAIL_RECOVERIES.value() == before_rec + 1
+    assert STORE_RECORDS_DROPPED.value() == before_drop + 1
+    s2.close()
+
+
+# ------------------------------------------------- slashing crash ordering
+
+
+def test_slashing_crash_before_insert_leaves_no_record(tmp_path, monkeypatch):
+    """A crash inside the check-and-insert transaction must roll back: no
+    half-recorded proposal that would brick the validator on restart."""
+    path = str(tmp_path / "sp.sqlite")
+    db = SlashingDatabase(path)
+    pk = b"\xBB" * 48
+    db.register_validator(pk)
+
+    def _boom(vid, slot, signing_root):
+        raise RuntimeError("crash between check and insert")
+
+    monkeypatch.setattr(db, "_record_block", _boom)
+    with pytest.raises(RuntimeError):
+        db.check_and_insert_block_proposal(pk, 7, b"\x01" * 32)
+    monkeypatch.undo()
+
+    # nothing recorded — restart (fresh connection) sees an empty table
+    # and the same proposal is signable
+    db2 = SlashingDatabase(path)
+    n = db2.conn.execute("SELECT COUNT(*) FROM signed_blocks").fetchone()[0]
+    assert n == 0
+    db2.check_and_insert_block_proposal(pk, 7, b"\x01" * 32)
+    db2.close()
+    db.close()
+
+
+def test_slashing_insert_before_sign_survives_crash(tmp_path):
+    """insert-before-sign: once check_and_insert returns, the record is
+    durable — a fresh connection (the restarted process) refuses the
+    conflicting sign and permits the identical re-sign."""
+    path = str(tmp_path / "sp2.sqlite")
+    db = SlashingDatabase(path)
+    pk = b"\xCC" * 48
+    db.register_validator(pk)
+    db.check_and_insert_block_proposal(pk, 11, b"\x0A" * 32)
+    # simulate the kill: never close, just reopen a second handle
+    db2 = SlashingDatabase(path)
+    with pytest.raises(SlashingProtectionError):
+        db2.check_and_insert_block_proposal(pk, 11, b"\x0B" * 32)
+    db2.check_and_insert_block_proposal(pk, 11, b"\x0A" * 32)
+    db2.close()
+    db.close()
+
+
+def test_slashing_interchange_import_is_atomic(tmp_path):
+    path = str(tmp_path / "sp3.sqlite")
+    db = SlashingDatabase(path)
+    bad = {
+        "metadata": {"interchange_format_version": "5",
+                     "genesis_validators_root": "0x" + "00" * 32},
+        "data": [
+            {"pubkey": "0x" + "dd" * 48,
+             "signed_blocks": [{"slot": "3", "signing_root": "0x" + "01" * 32}],
+             "signed_attestations": []},
+            {"pubkey": "0x" + "ee" * 48,
+             "signed_blocks": [{"slot": "not-a-number"}],  # fails mid-import
+             "signed_attestations": []},
+        ],
+    }
+    with pytest.raises(ValueError):
+        db.import_interchange(bad)
+    # the first entry must NOT have been half-applied
+    n = db.conn.execute("SELECT COUNT(*) FROM validators").fetchone()[0]
+    assert n == 0
+    db.close()
+
+
+# ------------------------------------------------------ HotColdDB re-anchor
+
+
+def _fake_block_bytes(slot: int, payload: bytes = b"") -> bytes:
+    return struct.pack("<I", 100) + b"\x00" * 96 + struct.pack("<Q", slot) + payload
+
+
+def test_re_anchor_drops_dangling_index(tmp_path):
+    """An index entry whose block record was truncated away is dropped."""
+    from lighthouse_tpu.store.kv import MemoryStore
+
+    store = MemoryStore()
+    db = HotColdDB(store=store)
+    db.put_item(DBColumn.BEACON_BLOCK, b"r" * 32, _fake_block_bytes(4))
+    db.put_item(DBColumn.BEACON_BLOCK_ROOTS, (4).to_bytes(8, "big"), b"r" * 32)
+    # dangling: index points at a block that never made it to disk
+    db.put_item(DBColumn.BEACON_BLOCK_ROOTS, (5).to_bytes(8, "big"), b"x" * 32)
+    result = db.re_anchor()
+    assert result["index_dropped"] == 1
+    assert result["head_slot"] == 4
+    assert result["head_root"] == b"r" * 32
+    assert db.get_item(DBColumn.BEACON_BLOCK_ROOTS, (5).to_bytes(8, "big")) is None
+
+
+def test_re_anchor_backfills_missing_index(tmp_path):
+    """put_block writes block-then-index, so truncation can leave a block
+    without its index entry: re-anchor rebuilds it."""
+    from lighthouse_tpu.store.kv import MemoryStore
+
+    store = MemoryStore()
+    db = HotColdDB(store=store)
+    db.put_item(DBColumn.BEACON_BLOCK, b"q" * 32, _fake_block_bytes(6))
+    result = db.re_anchor()
+    assert result["index_backfilled"] == 1
+    assert db.get_item(DBColumn.BEACON_BLOCK_ROOTS, (6).to_bytes(8, "big")) == b"q" * 32
+    assert result["head_slot"] == 6
+
+
+def test_dirty_open_auto_re_anchors(tmp_path):
+    """Opening a HotColdDB over a store that recovered a torn tail runs
+    re_anchor automatically (the open-after-SIGKILL contract)."""
+    path = str(tmp_path / "dirty.db")
+    s = SlabStore(path)
+    s.put(DBColumn.BEACON_BLOCK, b"a" * 32, _fake_block_bytes(3))
+    s.put(DBColumn.BEACON_BLOCK_ROOTS, (3).to_bytes(8, "big"), b"a" * 32)
+    s.flush()
+    faults.arm("store.put", "torn-write", times=1)
+    with pytest.raises(StorageFault):
+        # the torn record is the slot-9 block: its index entry never lands
+        s.put(DBColumn.BEACON_BLOCK, b"z" * 32, _fake_block_bytes(9))
+
+    s2 = SlabStore(path)
+    assert s2.recovery_report.tail_torn
+    db = HotColdDB(store=s2)
+    assert db.last_recovery is not None and not db.last_recovery.clean
+    # slot 3 fully intact and indexed; the torn slot-9 block is simply gone
+    assert db.get_item(DBColumn.BEACON_BLOCK_ROOTS, (3).to_bytes(8, "big")) == b"a" * 32
+    assert not db.block_exists(b"z" * 32)
+    db.close()

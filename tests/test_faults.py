@@ -136,6 +136,36 @@ class TestFaultInjector:
         with pytest.raises(ValueError):
             inj.arm_from_spec("nonsense")
 
+    def test_io_error_kind(self):
+        from lighthouse_tpu.utils.faults import StorageFault
+
+        inj = FaultInjector()
+        inj.arm("store.put", "io-error", times=1)
+        with pytest.raises(StorageFault) as ei:
+            inj.fire("store.put", b"payload")
+        assert isinstance(ei.value, OSError)  # generic disk handlers catch it
+        assert inj.fire("store.put", b"payload") == b"payload"  # consumed
+
+    def test_torn_write_kind_carries_fraction(self):
+        from lighthouse_tpu.utils.faults import TornWrite
+
+        inj = FaultInjector()
+        inj.arm("store.put", "torn-write", fraction=0.25)
+        with pytest.raises(TornWrite) as ei:
+            inj.fire("store.put")
+        assert ei.value.fraction == 0.25
+
+    def test_torn_write_spec_fraction(self):
+        from lighthouse_tpu.utils.faults import TornWrite
+
+        inj = FaultInjector()
+        inj.arm_from_spec("store.put=torn-write:0.4x1")
+        f = inj._armed["store.put"]
+        assert f.kind == "torn-write" and f.fraction == 0.4 and f.remaining == 1
+        with pytest.raises(TornWrite):
+            inj.fire("store.put")
+        assert not inj.armed("store.put")
+
 
 # ---------------------------------------------------------------------------
 # CircuitBreaker

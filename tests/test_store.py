@@ -168,6 +168,169 @@ def test_restore_point_summaries_survive_migration():
     assert db.state_slot(roots[3]) is None  # dropped intermediate
 
 
+class TestCorruptRecords:
+    """Byte-flip and truncation fixtures over every DBColumn: recovery
+    must keep exactly the CRC-valid prefix and account for the rest in the
+    RecoveryReport (PR 3)."""
+
+    @staticmethod
+    def _write_records(path, column, n=4):
+        s = SlabStore(path)
+        for i in range(n):
+            s.put(column, b"key%d" % i, b"val%d" % i * 50)
+        s.flush()
+        s.close()
+
+    @pytest.mark.parametrize("column", list(DBColumn), ids=lambda c: c.name)
+    def test_byte_flip_truncates_from_damage(self, tmp_path, column):
+        from lighthouse_tpu.store import wal
+
+        path = str(tmp_path / "flip.db")
+        self._write_records(path, column, n=4)
+        scan = wal.scan_file(path)
+        assert scan["records_kept"] == 4
+        # flip one byte inside the THIRD record's value region
+        off = scan["records"][2]["offset"]
+        flip_at = off + wal.HEADER_SIZE + 2
+        with open(path, "r+b") as f:
+            f.seek(flip_at)
+            b = f.read(1)
+            f.seek(flip_at)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+        s = SlabStore(path)
+        rep = s.recovery_report
+        assert rep.records_kept == 2  # the prefix before the damage
+        assert rep.records_dropped == 2  # damaged record + everything after
+        assert rep.crc_mismatch and rep.tail_torn
+        assert s.get(column, b"key0") == b"val0" * 50
+        assert s.get(column, b"key1") == b"val1" * 50
+        assert s.get(column, b"key2") is None
+        assert s.get(column, b"key3") is None
+        s.close()
+
+    @pytest.mark.parametrize("column", list(DBColumn), ids=lambda c: c.name)
+    def test_truncate_mid_value(self, tmp_path, column):
+        from lighthouse_tpu.store import wal
+
+        path = str(tmp_path / "trunc.db")
+        self._write_records(path, column, n=3)
+        scan = wal.scan_file(path)
+        off = scan["records"][2]["offset"]
+        # cut the file inside the third record's value
+        with open(path, "r+b") as f:
+            f.truncate(off + wal.HEADER_SIZE + 10)
+
+        s = SlabStore(path)
+        rep = s.recovery_report
+        assert rep.records_kept == 2
+        assert rep.records_dropped == 1  # only the in-flight record
+        assert rep.tail_torn and not rep.crc_mismatch
+        assert s.get(column, b"key1") == b"val1" * 50
+        assert s.get(column, b"key2") is None
+        s.close()
+
+    def test_python_scanner_agrees_with_engine(self, tmp_path):
+        """wal.scan_file (independent Python CRC verifier) and the C++
+        replay must report identical kept/dropped counts on damage."""
+        from lighthouse_tpu.store import wal
+
+        path = str(tmp_path / "agree.db")
+        self._write_records(path, DBColumn.BEACON_BLOCK, n=4)
+        scan = wal.scan_file(path)
+        off = scan["records"][1]["offset"]
+        with open(path, "r+b") as f:
+            f.seek(off + wal.HEADER_SIZE)
+            f.write(b"\xFF")
+
+        py = wal.scan_file(path)
+        s = SlabStore(path)
+        assert py["records_kept"] == s.recovery_report.records_kept == 1
+        assert py["records_dropped"] == s.recovery_report.records_dropped == 3
+        assert py["crc_failures"] >= 1
+        s.close()
+
+
+class TestLogFormat:
+    """The on-disk frame is pinned: the Python encoder in store/wal.py and
+    the C++ engine must produce byte-identical records."""
+
+    def test_engine_frame_matches_python_encoder(self, tmp_path):
+        from lighthouse_tpu.store import wal
+
+        path = str(tmp_path / "pin.db")
+        s = SlabStore(path)
+        s.put(DBColumn.BEACON_META, b"k", b"v")
+        s.flush()
+        s.close()
+        raw = open(path, "rb").read()
+        assert raw[:4] == wal.MAGIC_V2
+        assert raw[4:] == wal.encode_record(wal.TAG_PUT, b"m" + b"k", b"v")
+
+    def test_verify_file_healthy_and_damaged(self, tmp_path):
+        from lighthouse_tpu.store import wal
+
+        path = str(tmp_path / "verify.db")
+        s = SlabStore(path)
+        s.put(DBColumn.BEACON_BLOCK, b"a", b"x" * 100)
+        s.put(DBColumn.BEACON_STATE, b"b", b"y" * 100)
+        s.delete(DBColumn.BEACON_BLOCK, b"a")
+        s.flush()
+        s.close()
+        rep = wal.verify_file(path)
+        assert rep["ok"]
+        assert rep["per_column"]["BEACON_BLOCK"] == {"puts": 1, "dels": 1, "live": 0}
+        assert rep["per_column"]["BEACON_STATE"] == {"puts": 1, "dels": 0, "live": 1}
+
+        with open(path, "ab") as f:
+            f.write(b"\x01\xff")  # torn tail
+        rep2 = wal.verify_file(path)
+        assert not rep2["ok"]
+        assert rep2["recovery"]["tail_torn"]
+
+    def test_v1_log_migrates_to_v2_on_open(self, tmp_path):
+        """A legacy (pre-CRC) v1 log opens, migrates via the compaction
+        path, and lands on disk as a fully CRC-framed v2 file."""
+        import struct
+
+        from lighthouse_tpu.store import wal
+
+        path = str(tmp_path / "v1.db")
+        with open(path, "wb") as f:
+            f.write(wal.MAGIC_V1)
+            for key, val in ((b"m" + b"old", b"data"), (b"b" + b"blk", b"B" * 64)):
+                f.write(struct.pack("<BII", wal.TAG_PUT, len(key), len(val)))
+                f.write(key)
+                f.write(val)
+
+        s = SlabStore(path)
+        assert s.recovery_report.migrated
+        assert s.recovery_report.clean
+        assert s.get(DBColumn.BEACON_META, b"old") == b"data"
+        assert s.get(DBColumn.BEACON_BLOCK, b"blk") == b"B" * 64
+        s.close()
+        # the rewritten file is v2 and scan-clean
+        assert open(path, "rb").read(4) == wal.MAGIC_V2
+        scan = wal.scan_file(path)
+        assert scan["format"] == "v2" and scan["records_kept"] == 2
+
+    def test_compaction_is_atomic_and_durable(self, tmp_path):
+        """Compaction must leave either the old or the new file — the
+        rewrite goes to a temp file, fsyncs, then renames over."""
+        path = str(tmp_path / "compact.db")
+        s = SlabStore(path)
+        for i in range(20):
+            s.put(DBColumn.BEACON_STATE, b"samekey", b"x" * 500)
+        s.compact()
+        s.close()
+        assert not os.path.exists(path + ".compact")  # temp cleaned up
+        from lighthouse_tpu.store import wal
+
+        scan = wal.scan_file(path)
+        assert scan["records_kept"] == 1  # only the live version survived
+        assert scan["stop_reason"] is None  # clean end-of-log
+
+
 class TestLifecycle:
     """Round-4 store lifecycle: schema migrations, forward iterators, GC
     (store/src/{metadata,forwards_iter,garbage_collection}.rs)."""

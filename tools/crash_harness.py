@@ -1,0 +1,254 @@
+#!/usr/bin/env python3
+"""kill -9 crash-recovery harness for the storage stack.
+
+Drives a node-shaped workload (block import + op-pool persistence +
+slashing-protection writes) in a subprocess, SIGKILLs it at a randomized
+point, restarts, and asserts the crash-safety contract:
+
+  1. the store opens cleanly (torn-tail recovery, not a corrupt read);
+  2. every block the child reported as COMMITTED (printed only after the
+     fsync'd flush returned) is present after restart, with its slot->root
+     forward-index entry intact (HotColdDB re-anchors on a dirty open);
+  3. a second open reports a clean log (recovery truncated the tail);
+  4. the slashing database still refuses the double-sign the child
+     recorded BEFORE the kill.
+
+Usage:
+    python tools/crash_harness.py --iterations 3 [--seed 1234]
+
+Exit 0 iff every iteration is green.  The child protocol is line-based on
+stdout: READY, SIGNED, then one "COMMIT <i> <roothex>" per fsync'd block;
+the parent kills mid-stream.  tests/test_crash_recovery.py drives
+run_iteration() directly with deterministic kill points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import random
+import shutil
+import signal
+import struct
+import subprocess
+import sys
+import tempfile
+import types
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+PUBKEY = b"\xAA" * 48
+DOUBLE_SIGN_SLOT = 1
+SIGNED_ROOT = b"\x11" * 32
+CHAIN_DB = "chain.db"
+SLASHING_DB = "slashing.sqlite"
+DEFAULT_BLOCKS = 64
+
+
+class _FakeBlock:
+    """Minimal stand-in for SignedBeaconBlock: encodes so that
+    HotColdDB._block_slot (bytes[100:108] little-endian) reads the slot,
+    without importing the jax-backed container types in the child."""
+
+    def __init__(self, slot: int, payload: bytes = b""):
+        self.message = types.SimpleNamespace(slot=slot)
+        self._payload = payload
+
+    def encode(self) -> bytes:
+        return (
+            struct.pack("<I", 100)
+            + b"\x00" * 96
+            + struct.pack("<Q", self.message.slot)
+            + self._payload
+        )
+
+
+def block_root(slot: int, payload: bytes) -> bytes:
+    return hashlib.sha256(struct.pack("<Q", slot) + payload).digest()
+
+
+def block_payload(rng: random.Random, slot: int) -> bytes:
+    # vary frame sizes so the kill lands at different record offsets
+    return rng.randbytes(rng.randint(16, 4096))
+
+
+# --------------------------------------------------------------------- child
+
+
+def run_child(datadir: str, blocks: int, seed: int) -> int:
+    from lighthouse_tpu.store import HotColdDB, SlabStore
+    from lighthouse_tpu.store.kv import DBColumn
+    from lighthouse_tpu.validator.slashing_protection import SlashingDatabase
+
+    store = SlabStore(os.path.join(datadir, CHAIN_DB))
+    db = HotColdDB(store=store)
+    sp = SlashingDatabase(os.path.join(datadir, SLASHING_DB))
+    sp.register_validator(PUBKEY)
+    print("READY", flush=True)
+
+    # the pre-kill sign: recorded (fsync'd) before any block work — after
+    # the kill, signing anything else at this slot must still be refused
+    sp.check_and_insert_block_proposal(PUBKEY, DOUBLE_SIGN_SLOT, SIGNED_ROOT)
+    print("SIGNED", flush=True)
+
+    rng = random.Random(seed)
+    for i in range(1, blocks + 1):
+        payload = block_payload(rng, i)
+        root = block_root(i, payload)
+        db.put_block(root, _FakeBlock(i, payload))
+        # op-pool persistence rides the same log (persist_op_pool analog)
+        db.put_item(DBColumn.OP_POOL, struct.pack(">Q", i), payload[:64])
+        db.flush()
+        # only now is the block durable: the parent treats everything
+        # before this line as fair game for the kill to destroy
+        print(f"COMMIT {i} {root.hex()}", flush=True)
+    print("DONE", flush=True)
+    return 0
+
+
+# -------------------------------------------------------------- verification
+
+
+def verify_after_kill(datadir: str, commits: list[tuple[int, bytes]]) -> dict:
+    """Restart-side assertions.  Raises AssertionError on any violation."""
+    from lighthouse_tpu.store import HotColdDB, SlabStore
+    from lighthouse_tpu.store.kv import DBColumn
+    from lighthouse_tpu.validator.slashing_protection import (
+        SlashingDatabase,
+        SlashingProtectionError,
+    )
+
+    chain_path = os.path.join(datadir, CHAIN_DB)
+    store = SlabStore(chain_path)  # must not raise: torn tails recover
+    report = store.recovery_report
+    db = HotColdDB(store=store)
+
+    for slot, root in commits:
+        assert db.block_exists(root), f"committed block at slot {slot} lost"
+        idx = db.get_item(DBColumn.BEACON_BLOCK_ROOTS, struct.pack(">Q", slot))
+        assert idx == root, f"forward index for slot {slot} wrong after restart"
+        assert (
+            store.get(DBColumn.OP_POOL, struct.pack(">Q", slot)) is not None
+        ), f"op-pool entry for slot {slot} lost"
+
+    head = max((s for s, _ in commits), default=0)
+    if commits:
+        spine = list(db.forwards_block_roots_iterator(1, head))
+        assert len(spine) >= len(commits), "spine shorter than commit set"
+    db.close()
+
+    # a second open must be clean: recovery truncated the torn tail away
+    store2 = SlabStore(chain_path)
+    assert store2.recovery_report.clean, "recovery did not heal the log"
+    second_kept = store2.recovery_report.records_kept
+    store2.close()
+
+    sp = SlashingDatabase(os.path.join(datadir, SLASHING_DB))
+    refused = False
+    try:
+        sp.check_and_insert_block_proposal(
+            PUBKEY, DOUBLE_SIGN_SLOT, b"\x22" * 32
+        )
+    except SlashingProtectionError:
+        refused = True
+    assert refused, "double-sign NOT refused after crash"
+    # the identical root must still be allowed (re-sign semantics intact)
+    sp.check_and_insert_block_proposal(PUBKEY, DOUBLE_SIGN_SLOT, SIGNED_ROOT)
+    sp.close()
+
+    return {
+        "commits": len(commits),
+        "recovery": report.as_dict(),
+        "second_open_kept": second_kept,
+        "double_sign_refused": refused,
+    }
+
+
+# ------------------------------------------------------------------- parent
+
+
+def run_iteration(
+    seed: int, datadir: str, kill_after: int, blocks: int = DEFAULT_BLOCKS
+) -> dict:
+    """One kill/restart cycle: spawn the child, SIGKILL it right after its
+    ``kill_after``-th COMMIT line (so the kill lands inside the next
+    record's write window), then verify."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         "--datadir", datadir, "--blocks", str(blocks), "--seed", str(seed)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env, cwd=REPO_ROOT,
+    )
+    commits: list[tuple[int, bytes]] = []
+    signed = False
+    try:
+        for line in proc.stdout:
+            line = line.strip()
+            if line == "SIGNED":
+                signed = True
+            elif line.startswith("COMMIT "):
+                _, i, roothex = line.split()
+                commits.append((int(i), bytes.fromhex(roothex)))
+                if len(commits) >= kill_after:
+                    os.kill(proc.pid, signal.SIGKILL)
+                    break
+            elif line == "DONE":
+                break
+    finally:
+        proc.wait()
+        proc.stdout.close()
+    assert signed, "child died before the pre-kill sign"
+    assert len(commits) >= min(kill_after, blocks), (
+        f"child produced only {len(commits)} commits before dying"
+    )
+    result = verify_after_kill(datadir, commits)
+    result["kill_after"] = kill_after
+    result["seed"] = seed
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iterations", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--blocks", type=int, default=DEFAULT_BLOCKS)
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--datadir", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return run_child(args.datadir, args.blocks, args.seed)
+
+    rng = random.Random(args.seed)
+    failures = 0
+    for it in range(args.iterations):
+        datadir = tempfile.mkdtemp(prefix="crash-harness-")
+        kill_after = rng.randint(1, min(16, args.blocks))
+        seed = rng.randrange(1 << 30)
+        try:
+            result = run_iteration(seed, datadir, kill_after, args.blocks)
+        except AssertionError as exc:
+            failures += 1
+            print(f"[{it + 1}/{args.iterations}] FAIL: {exc}")
+        else:
+            rec = result["recovery"]
+            print(
+                f"[{it + 1}/{args.iterations}] OK  kill_after={kill_after} "
+                f"commits={result['commits']} "
+                f"tail_torn={rec['tail_torn']} "
+                f"dropped={rec['records_dropped']} "
+                f"truncated={rec['bytes_truncated']}B "
+                f"double_sign_refused={result['double_sign_refused']}"
+            )
+        finally:
+            shutil.rmtree(datadir, ignore_errors=True)
+    print(f"{args.iterations - failures}/{args.iterations} iterations green")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
