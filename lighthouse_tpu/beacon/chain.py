@@ -371,6 +371,80 @@ class BeaconChain:
             raise BlockError("block signature verification failed")
         return SignatureVerifiedBlock(gossip=gvb)
 
+    def collect_segment_signature_sets(self, blocks) -> list:
+        """The collection half of signature_verify_chain_segment
+        (block_verification.rs:572): walk a parent-linked run of blocks
+        from its anchor state, advancing a throwaway copy block by block,
+        and gather EVERY signature set of every block into one list — the
+        caller verifies them in a single bulk device pass and only then
+        imports the segment.
+
+        Blocks already imported are skipped (gossip may race an RPC
+        batch).  Raises :class:`BlockError` when the segment does not
+        anchor to a state we hold or a block fails the (signature-free)
+        state transition — either way the segment is not importable.
+        """
+        blocks = [
+            b for b in blocks if b.message.root() not in self._observed_blocks
+        ]
+        if not blocks:
+            return []
+        parent_state = self._states.get(bytes(blocks[0].message.parent_root))
+        if parent_state is None:
+            raise BlockError(
+                "segment anchor unknown: parent "
+                f"{bytes(blocks[0].message.parent_root).hex()}"
+            )
+        state = parent_state.copy()
+        all_sets: list = []
+        for signed in blocks:
+            block = signed.message
+            state = process_slots(state, block.slot, self.spec)
+            epoch = int(block.slot) // self.preset.slots_per_epoch
+            cache = self.committee_cache(state, epoch)
+            self.pubkey_cache.update(state)
+            verifier = BlockSignatureVerifier(state, self.get_pubkey, self.spec)
+            sync_parts = None
+            prev_root = None
+            if hasattr(block.body, "sync_aggregate"):
+                from .sync_committee import sync_committee_indices
+
+                idxs = sync_committee_indices(state)
+                sync_parts = [
+                    vi
+                    for bit, vi in zip(
+                        block.body.sync_aggregate.sync_committee_bits, idxs
+                    )
+                    if bit
+                ]
+                prev_root = bytes(
+                    state.block_roots[
+                        (block.slot - 1) % self.preset.slots_per_historical_root
+                    ]
+                )
+            cache_for = (
+                lambda e, _c=cache, _e=epoch, _s=state: _c if e == _e
+                else self.committee_cache(_s, e)
+            )
+            try:
+                verifier.include_all(
+                    signed, cache_for,
+                    sync_participants=sync_parts, block_root_at_prev=prev_root,
+                )
+            except sets.SignatureSetError as e:
+                raise BlockError(f"segment signatures undecodable: {e}") from None
+            all_sets.extend(verifier.sets)
+            try:
+                st_process_block(
+                    state, signed, self.spec, committee_cache=cache,
+                    verify_signatures=False, get_pubkey=self.get_pubkey,
+                )
+            except BlockProcessingError as e:
+                raise BlockError(
+                    f"state transition rejected segment block: {e}"
+                ) from None
+        return all_sets
+
     def import_verified_block(self, svb: "SignatureVerifiedBlock") -> bytes:
         """Rung 3+4 — ExecutionPending → import: state transition, EL
         verdict, data availability, fork choice, store, caches, events."""

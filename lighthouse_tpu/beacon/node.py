@@ -49,6 +49,7 @@ class BeaconNode:
         store=None,
         slasher: bool = False,
         execution=None,
+        injector=None,
     ):
         self.spec = spec
         self.fork = fork
@@ -105,6 +106,7 @@ class BeaconNode:
             rpc_mod.SUCCESS,
             rpc_mod.MetaData(seq_number=1, attnets=0, syncnets=0).encode(),
         )
+        self.host.rpc_handlers["goodbye"] = self._on_goodbye
         self.host.rpc_handlers["beacon_blocks_by_range"] = self._on_blocks_by_range
         self.host.rpc_handlers["beacon_blocks_by_root"] = self._on_blocks_by_root
         self.host.rpc_handlers["blob_sidecars_by_range"] = self._on_blobs_by_range
@@ -149,6 +151,26 @@ class BeaconNode:
             device_verify=lambda s: _bls_api.get_backend().verify_signature_sets(s),
             cpu_verify=lambda s: _bls_api.cpu_backend().verify_signature_sets(s),
             breaker=self.breaker,
+        )
+        # adversarial network boundary: the host's peer manager scores
+        # req/resp misbehavior too (not only gossip), and the SyncManager
+        # replaces the old single-peer trusting range-sync loop — validated
+        # batches, bulk segment verification through the ResilientVerifier
+        # ladder, peer rotation + penalties, STALLED instead of give-up.
+        # ``injector`` lets multi-node chaos tests arm faults on ONE node.
+        from ..utils import faults as faults_mod
+
+        self.injector = injector if injector is not None else faults_mod.INJECTOR
+        self.peer_manager = self.host.peer_manager
+        from .sync import SyncManager
+
+        self.sync = SyncManager(
+            self.chain,
+            fork=fork,
+            peer_manager=self.peer_manager,
+            verifier=self.verifier,
+            injector=self.injector,
+            chain_lock=self._chain_lock,
         )
         self.slot_timer = None
         self._running = False
@@ -225,6 +247,7 @@ class BeaconNode:
         if name != "base":
             self.fork = name
             self.block_cls = self.types.SignedBeaconBlock_BY_FORK[name]
+            self.sync.fork = name
         if self.discovery is not None:
             from ..network.enr import build_enr
 
@@ -365,70 +388,110 @@ class BeaconNode:
         )
 
     def _on_status(self, req: bytes, peer_id):
-        their = rpc_mod.StatusMessage.deserialize_value(req)
+        try:
+            their = rpc_mod.StatusMessage.deserialize_value(req)
+        except Exception:  # noqa: BLE001
+            self.peer_manager.on_behaviour_penalty(
+                peer_id.hex(), 2.0, "malformed-status"
+            )
+            return rpc_mod.INVALID_REQUEST, b""
         if bytes(their.fork_digest) != self.digest:
             return rpc_mod.INVALID_REQUEST, b""
+        if int(their.head_slot) > int(self.chain.head_state().slot):
+            # the inbound side of the handshake is a sync opportunity too;
+            # sync runs off-thread so the stream handler answers promptly
+            conn = self.host.connections.get(peer_id)
+            if conn is not None:
+                threading.Thread(
+                    target=self._sync_from_peer, args=(conn, their),
+                    name="sync-inbound", daemon=True,
+                ).start()
         return rpc_mod.SUCCESS, self._local_status().encode()
 
     def _status_handshake(self, conn) -> None:
         code, resp = conn.request("status", self._local_status().encode())
         if code != rpc_mod.SUCCESS:
             return
-        their = rpc_mod.StatusMessage.deserialize_value(resp)
-        if their.head_slot > self.chain.head_state().slot:
-            self._range_sync(conn, int(their.head_slot))
+        try:
+            their = rpc_mod.StatusMessage.deserialize_value(resp)
+        except Exception:  # noqa: BLE001
+            self.peer_manager.on_behaviour_penalty(
+                conn.peer_id.hex(), 2.0, "malformed-status"
+            )
+            return
+        self.sync.add_peer(self._sync_peer_for(conn, their))
+        self.sync.tick()
 
-    def _range_sync(self, conn, target_slot: int, batch: int = 16) -> None:
-        """Catch up over the wire: BlocksByRange in batches, importing in
-        order (sync/range_sync semantics, single-peer degenerate case)."""
-        while self._running:
-            start = int(self.chain.head_state().slot) + 1
-            if start > target_slot:
-                return
+    def _sync_from_peer(self, conn, their) -> None:
+        """Exception-isolated sync entry for inbound status handlers: a
+        misbehaving peer surfaces as score feedback, never as a crash."""
+        try:
+            self.sync.add_peer(self._sync_peer_for(conn, their))
+            self.sync.tick()
+        except Exception as exc:  # noqa: BLE001
+            log.debug("inbound-status sync: %s", exc)
+
+    def _sync_peer_for(self, conn, their):
+        """Wrap a connection as a SyncPeer: the requester decodes chunks
+        itself so the SyncManager can tell garbage (byzantine) from
+        transport failure (flaky)."""
+        from .sync import GarbageResponse, SyncPeer
+
+        def request_blocks(start_slot: int, count: int):
             req = rpc_mod.BlocksByRangeRequest(
-                start_slot=start,
-                count=min(batch, target_slot - start + 1),
-                step=1,
+                start_slot=start_slot, count=count, step=1
             )
-            chunks = conn.request_multi(
-                "beacon_blocks_by_range", req.encode(), timeout=15.0
+            body = conn._request_raw(
+                "beacon_blocks_by_range", req.encode(),
+                self.sync.request_timeout,
             )
-            imported = 0
-            for code, ssz in chunks:
-                if code != rpc_mod.SUCCESS:
-                    continue
-                block = self.block_cls.deserialize_value(ssz)
-                try:
-                    with self._chain_lock:
-                        self.chain.process_block(block)
-                    imported += 1
-                except Exception as exc:  # noqa: BLE001
-                    from .chain import AvailabilityPendingError
+            try:
+                return rpc_mod.decode_response_chunks(body)
+            except Exception as exc:  # noqa: BLE001
+                raise GarbageResponse(str(exc)) from exc
 
-                    if isinstance(exc, AvailabilityPendingError):
-                        # deneb: pull the committed blobs from the same
-                        # peer, then retry the import once
-                        if self._fetch_blobs_for_block(conn, block):
-                            try:
-                                with self._chain_lock:
-                                    self.chain.process_block(block)
-                                imported += 1
-                                continue
-                            except Exception as rexc:  # noqa: BLE001
-                                log.debug("post-blob import: %s", rexc)
-                    log.debug("range-sync import: %s", exc)
-            if imported == 0:
-                return  # peer has nothing more for us (or all invalid)
+        return SyncPeer(
+            peer_id=conn.peer_id.hex(),
+            head_slot=int(their.head_slot),
+            finalized_epoch=int(their.finalized_epoch),
+            request_blocks=request_blocks,
+            fetch_blobs=lambda block: self._fetch_blobs_for_block(conn, block),
+        )
+
+    def _on_goodbye(self, req: bytes, peer_id):
+        """Goodbye updates the peer record (reputation persists) — the
+        transport teardown follows from the peer's side."""
+        self.peer_manager.on_goodbye(peer_id.hex())
+        self.sync.remove_peer(peer_id.hex())
+        return rpc_mod.SUCCESS, b""
 
     def _on_blocks_by_range(self, req: bytes, peer_id):
         """Serve from the canonical chain, one coded chunk per block
         (sync.serve_blocks_by_range walks the store)."""
+        from ..utils.faults import FaultError
         from .sync import serve_blocks_by_range
 
-        r = rpc_mod.BlocksByRangeRequest.deserialize_value(req)
+        try:
+            r = rpc_mod.BlocksByRangeRequest.deserialize_value(req)
+        except Exception:  # noqa: BLE001
+            self.peer_manager.on_behaviour_penalty(
+                peer_id.hex(), 2.0, "malformed-request"
+            )
+            return rpc_mod.INVALID_REQUEST, b""
+        if int(r.count) > rpc_mod.MAX_REQUEST_BLOCKS:
+            self.peer_manager.on_behaviour_penalty(
+                peer_id.hex(), 2.0, "oversized-request"
+            )
+            return rpc_mod.INVALID_REQUEST, b""
         chunks = serve_blocks_by_range(self.chain, self.fork)(
             int(r.start_slot), min(int(r.count), 64)
         )
+        try:
+            # chaos site: byzantine/flaky RESPONSES (corrupt-chunk,
+            # wrong-blocks, extra-blocks, stall, drop) for soak tests
+            chunks = self.injector.fire("rpc.respond", chunks)
+        except FaultError:
+            return rpc_mod.RAW_CHUNKS, b""  # injected drop: respond nothing
         return rpc_mod.RAW_CHUNKS, b"".join(chunks)
 
     def _on_blocks_by_root(self, req: bytes, peer_id):
@@ -438,8 +501,15 @@ class BeaconNode:
         from ..consensus.ssz import SSZList
 
         roots_t = SSZList(Root, 1024)
+        try:
+            roots = roots_t.deserialize(req)
+        except Exception:  # noqa: BLE001
+            self.peer_manager.on_behaviour_penalty(
+                peer_id.hex(), 2.0, "malformed-request"
+            )
+            return rpc_mod.INVALID_REQUEST, b""
         out = b""
-        for root in roots_t.deserialize(req)[:64]:
+        for root in roots[:64]:
             blk = self.chain.store.get_block(bytes(root), self.block_cls)
             if blk is not None:
                 out += rpc_mod.encode_response_chunk(

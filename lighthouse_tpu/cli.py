@@ -42,7 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SITE=KIND[:ARG][xN]",
                     help="arm a fault before startup (repeatable), e.g. "
                          "bls.device_verify=errorx3 or "
-                         "bls.device_verify=slow:0.5 — see utils/faults.py")
+                         "bls.device_verify=slow:0.5; network byzantine "
+                         "kinds drop/stall/corrupt-chunk/wrong-blocks/"
+                         "extra-blocks arm the req/resp sites, e.g. "
+                         "rpc.respond=corrupt-chunk or "
+                         "sync.request=stall:3.0x2 — see utils/faults.py")
     bn.add_argument("--upnp", action="store_true",
                     help="attempt UPnP port mapping for p2p/discovery "
                          "(best-effort; nat.rs analog)")

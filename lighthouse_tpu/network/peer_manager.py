@@ -25,6 +25,8 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+from ..utils.metrics import PEER_BANS, PEER_PENALTIES
+
 GREYLIST_THRESHOLD = -16.0
 BAN_THRESHOLD = -40.0
 
@@ -163,12 +165,22 @@ class PeerManager:
     ) -> None:
         rec = self._rec(peer_id)
         rec.behaviour_penalty += amount
+        PEER_PENALTIES.inc(labels=(reason or "unspecified",))
         self._maybe_ban(peer_id, rec)
+
+    def on_goodbye(self, peer_id: str) -> None:
+        """Peer said goodbye: count it and mark the record disconnected
+        (reputation persists — a goodbye is not a reset)."""
+        rec = self._rec(peer_id)
+        rec.goodbyes += 1
+        rec.connected = False
+        rec.last_seen = time.monotonic()
 
     def _maybe_ban(self, peer_id: str, rec: PeerRecord) -> None:
         if rec.score() <= BAN_THRESHOLD and not rec.banned:
             rec.banned_until = time.monotonic() + self.ban_duration
             rec.connected = False
+            PEER_BANS.inc()
 
     # -- decay -------------------------------------------------------------
 

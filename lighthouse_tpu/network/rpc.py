@@ -105,6 +105,10 @@ def protocol_id(name: str) -> str:
     return f"{PROTOCOL_PREFIX}/{name}/{version}/ssz_snappy"
 
 
+# spec cap on BlocksByRange request size; a peer asking for more is
+# misbehaving, not just ambitious (p2p-interface.md MAX_REQUEST_BLOCKS)
+MAX_REQUEST_BLOCKS = 1024
+
 # result codes (RPCCodedResponse)
 SUCCESS = 0
 # handler-side sentinel: response is already a stream of coded chunks

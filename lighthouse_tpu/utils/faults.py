@@ -39,6 +39,21 @@ Fault kinds:
                  must append only that fraction of the framed record (what
                  a SIGKILL mid-write leaves) and then fail the operation
 
+Byzantine network kinds (armed at the req/resp sites ``sync.request``,
+client side on the decoded chunk list, and ``rpc.respond``, server side on
+the encoded chunk list — beacon/sync.py and beacon/node.py):
+
+* ``drop``          raise :class:`NetworkFault` — the request/response
+                    vanishes on the wire
+* ``stall``         sleep ``delay`` seconds, then pass — a hung peer; the
+                    requester's per-request timeout is what saves it
+* ``corrupt-chunk`` flip one byte mid-payload of the last chunk (a lying
+                    or bit-flipping peer; breaks snappy/SSZ/signatures)
+* ``wrong-blocks``  reverse the chunk list (right blocks, byzantine order
+                    — trips the strictly-increasing-slots validation)
+* ``extra-blocks``  append a duplicate of the last chunk (over-count /
+                    non-monotonic response)
+
 Arming is bounded: ``times=N`` auto-disarms after N firings (the breaker
 recovery tests ride this), ``probability`` makes soak tests stochastic.
 """
@@ -80,8 +95,45 @@ class TornWrite(FaultError):
         self.fraction = fraction
 
 
+class NetworkFault(FaultError):
+    """Injected network loss: the request or response never arrives."""
+
+
 _KINDS = ("error", "slow", "corrupt", "overflow", "crash", "io-error",
-          "torn-write")
+          "torn-write", "drop", "stall", "corrupt-chunk", "wrong-blocks",
+          "extra-blocks")
+
+
+# -- default mutators for the byzantine chunk-list kinds ---------------------
+# Both req/resp sites carry a list of chunks: encoded ``bytes`` on the server
+# side (rpc.respond), decoded ``(result_code, ssz)`` tuples on the client side
+# (sync.request).  The mutators handle either element shape so one arming
+# spec works at both ends.
+
+def _flip_mid_byte(b: bytes) -> bytes:
+    if not b:
+        return b
+    mid = len(b) // 2
+    return b[:mid] + bytes([b[mid] ^ 0xFF]) + b[mid + 1:]
+
+
+def _corrupt_last_chunk(chunks):
+    chunks = list(chunks)
+    if chunks:
+        last = chunks[-1]
+        if isinstance(last, tuple):
+            code, payload = last
+            chunks[-1] = (code, _flip_mid_byte(payload))
+        else:
+            chunks[-1] = _flip_mid_byte(last)
+    return chunks
+
+
+_NETWORK_MUTATORS = {
+    "corrupt-chunk": _corrupt_last_chunk,
+    "wrong-blocks": lambda chunks: list(reversed(list(chunks))),
+    "extra-blocks": lambda chunks: list(chunks) + list(chunks)[-1:],
+}
 
 
 @dataclass
@@ -138,6 +190,8 @@ class FaultInjector:
             exc = lambda: InjectedCrash(f"injected crash at {site}")  # noqa: E731
         if exc is None and kind == "io-error":
             exc = lambda: StorageFault(f"injected storage fault at {site}")  # noqa: E731
+        if exc is None and kind == "drop":
+            exc = lambda: NetworkFault(f"injected network drop at {site}")  # noqa: E731
         with self._lock:
             self._armed[site] = Fault(
                 kind=kind, exc=exc, delay=delay, mutate=mutate,
@@ -159,25 +213,30 @@ class FaultInjector:
     def arm_from_spec(self, spec: str) -> None:
         """Parse a CLI arming spec: ``site=kind[:arg][xN]``.
 
-        ``arg`` is the delay in seconds for ``slow`` faults and the on-disk
-        fraction for ``torn-write`` faults; ``xN`` bounds the arm to N
-        firings.  Examples::
+        ``arg`` is the delay in seconds for ``slow``/``stall`` faults and
+        the on-disk fraction for ``torn-write`` faults; ``xN`` bounds the
+        arm to N firings.  Examples::
 
             bls.device_verify=error x3   ->  "bls.device_verify=errorx3"
             bls.device_verify=slow:0.5
             executor.task.gossip=crashx1
             store.put=torn-write:0.4x1
+            rpc.respond=corrupt-chunk
+            sync.request=stall:3.0x2
         """
         site, _, rest = spec.partition("=")
         if not site or not rest:
             raise ValueError(f"bad fault spec {spec!r}; want site=kind[:arg][xN]")
         times = None
         if "x" in rest:
-            rest, _, n = rest.rpartition("x")
-            times = int(n)
+            # only a trailing all-digit suffix is a repeat count — kind
+            # names themselves may contain an "x" (extra-blocks)
+            head, _, n = rest.rpartition("x")
+            if n.isdigit():
+                rest, times = head, int(n)
         kind, _, arg = rest.partition(":")
         kind = kind.strip()
-        delay = float(arg) if (arg and kind == "slow") else 0.0
+        delay = float(arg) if (arg and kind in ("slow", "stall")) else 0.0
         fraction = float(arg) if (arg and kind == "torn-write") else 0.5
         self.arm(site.strip(), kind, delay=delay, times=times,
                  fraction=fraction)
@@ -206,14 +265,17 @@ class FaultInjector:
         f = self._take(site)
         if f is None:
             return payload
-        if f.kind == "slow":
+        if f.kind in ("slow", "stall"):
             time.sleep(f.delay)
             return payload
         if f.kind == "corrupt":
             return f.mutate(payload) if f.mutate is not None else payload
+        if f.kind in _NETWORK_MUTATORS:
+            fn = f.mutate or _NETWORK_MUTATORS[f.kind]
+            return fn(payload)
         if f.kind == "torn-write":
             raise TornWrite(fraction=f.fraction)
-        if f.kind in ("error", "crash", "io-error"):
+        if f.kind in ("error", "crash", "io-error", "drop"):
             raise f.exc()
         return payload  # "overflow" is a check()-site kind; fire is a no-op
 

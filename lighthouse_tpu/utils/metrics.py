@@ -213,6 +213,57 @@ PIPELINE_FALLBACKS = Counter(
     "verdict False, dispatch failure, or marshal failure)",
 )
 
+# ---------------------------------------------------------------------------
+# Multi-peer sync + peer scoring (beacon/sync.py SyncManager,
+# network/peer_manager.py): the adversarial network boundary.  Batch
+# counters tell whether sync is making progress and against what weather;
+# the peer counters are the score/ban feedback loop's observable half.
+# ---------------------------------------------------------------------------
+
+SYNC_BATCHES_REQUESTED = Counter(
+    "sync_batches_requested_total",
+    "BlocksByRange batch requests issued by the sync manager",
+)
+SYNC_BATCHES_IMPORTED = Counter(
+    "sync_batches_imported_total",
+    "Batches that validated, bulk-verified, and imported cleanly",
+)
+SYNC_BATCHES_INVALID = Counter(
+    "sync_batches_invalid_total",
+    "Batches rejected before import, by validation failure reason",
+    ("reason",),
+)
+SYNC_BATCH_RETRIES = Counter(
+    "sync_batch_retries_total",
+    "Batch attempts past the first (failed batches re-requested)",
+)
+SYNC_PEER_ROTATIONS = Counter(
+    "sync_peer_rotations_total",
+    "Batches moved to a different peer after a failed attempt",
+)
+SYNC_STALLS = Counter(
+    "sync_stalls_total",
+    "Times sync parked as STALLED (no viable peer / batch budget exhausted)",
+)
+SYNC_SEGMENT_SETS_VERIFIED = Counter(
+    "sync_segment_signature_sets_verified_total",
+    "Signature sets bulk-verified across whole sync segments (one device "
+    "batch per accepted range batch)",
+)
+SYNC_BLOCKS_IMPORTED = Counter(
+    "sync_blocks_imported_total",
+    "Blocks imported through the sync manager's validated batch path",
+)
+PEER_PENALTIES = Counter(
+    "peer_behaviour_penalties_total",
+    "Behaviour penalties applied by the peer manager, by reason",
+    ("reason",),
+)
+PEER_BANS = Counter(
+    "peer_bans_total",
+    "Peers banned after their score crossed BAN_THRESHOLD",
+)
+
 # Per-config Pallas dispatch accounting (tools/dispatch_audit.py): distinct
 # lowered programs and stacked pallas_call dispatches in the traced verify
 # composition, labelled by backend config string (e.g. "chains+miller+h2c").

@@ -1,0 +1,216 @@
+"""Network chaos soak: byzantine + flaky peers against the SyncManager.
+
+In-process soaks (real PeerManager, real FaultInjector sites, real bulk
+signature verification) run everywhere; the 4-node real-socket soak needs
+the noise transport's crypto dependency and skips cleanly without it.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon import BeaconChainHarness
+from lighthouse_tpu.beacon.sync import (
+    SyncManager,
+    SyncPeer,
+    SyncState,
+    serve_blocks_by_range,
+)
+from lighthouse_tpu.network import rpc
+from lighthouse_tpu.network.peer_manager import PeerManager
+from lighthouse_tpu.utils import metrics as M
+from lighthouse_tpu.utils.faults import FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+
+def tuple_server(chain, fork="altair"):
+    serve = serve_blocks_by_range(chain, fork)
+
+    def request_blocks(start_slot, count):
+        return [rpc.decode_response_chunk(c) for c in serve(start_slot, count)]
+
+    return request_blocks
+
+
+def test_chaos_soak_in_process():
+    """One honest node syncs 12 slots off a peer set containing a
+    byzantine reorderer, a flaky sleeper, a crasher, and one honest peer:
+    the chain completes gap-free, the byzantine peer is scored out, the
+    honest peer keeps a clean record."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(12)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=4,
+                      request_timeout=0.3)
+
+    honest_serve = tuple_server(ahead.chain)
+    flaky_calls = {"n": 0}
+
+    def serve_reversed(start_slot, count):
+        return list(reversed(honest_serve(start_slot, count)))
+
+    def serve_flaky(start_slot, count):
+        flaky_calls["n"] += 1
+        if flaky_calls["n"] <= 2:
+            time.sleep(1.0)  # beyond the request timeout
+        return honest_serve(start_slot, count)
+
+    def serve_crash(start_slot, count):
+        raise RuntimeError("connection reset by peer")
+
+    mgr.add_peer(SyncPeer(peer_id="a-byz", head_slot=12,
+                          request_blocks=serve_reversed))
+    mgr.add_peer(SyncPeer(peer_id="b-flaky", head_slot=12,
+                          request_blocks=serve_flaky))
+    mgr.add_peer(SyncPeer(peer_id="c-crash", head_slot=12,
+                          request_blocks=serve_crash))
+    mgr.add_peer(SyncPeer(peer_id="d-good", head_slot=12,
+                          request_blocks=honest_serve))
+
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert mgr.imported == 12
+    assert mgr.failed_batches >= 1
+    # gap-free: the freshly synced chain can serve the whole range back
+    assert len(serve_blocks_by_range(fresh.chain, "altair")(1, 12)) == 12
+    # byzantine content greylists on the first strike; honest stays clean
+    assert pm.greylisted("a-byz") and not pm.is_banned("a-byz")
+    assert pm.score("d-good") == 0.0
+
+
+def test_crashing_peer_is_isolated_and_flaky_scored():
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(4)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=4)
+
+    def serve_crash(start_slot, count):
+        raise RuntimeError("boom")
+
+    mgr.add_peer(SyncPeer(peer_id="a-crash", head_slot=4,
+                          request_blocks=serve_crash))
+    mgr.add_peer(SyncPeer(peer_id="b-good", head_slot=4,
+                          request_blocks=tuple_server(ahead.chain)))
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert pm.score("a-crash") == -(1.5 ** 2)  # flaky-grade, not byzantine
+
+
+def test_injector_drop_on_sync_request_site():
+    """`sync.request=drop` severs one request at the client boundary; the
+    retry completes and the serving peer eats only a flaky penalty."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(4)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    inj = FaultInjector()
+    inj.arm_from_spec("sync.request=dropx1")
+    mgr = SyncManager(fresh.chain, peer_manager=pm, injector=inj,
+                      batch_slots=4)
+    mgr.add_peer(SyncPeer(peer_id="good", head_slot=4,
+                          request_blocks=tuple_server(ahead.chain)))
+    stalls0 = M.SYNC_STALLS.value()
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert mgr.failed_batches == 1
+    assert pm.score("good") == -(1.5 ** 2)
+    assert M.SYNC_STALLS.value() == stalls0
+
+
+def test_injector_corrupt_chunk_on_sync_request_site():
+    """`sync.request=corrupt-chunk` flips a byte in the last chunk: some
+    rung of the validation ladder (SSZ decode, linkage, state transition,
+    or bulk signatures) rejects the batch as byzantine, then the clean
+    retry imports."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(4)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    inj = FaultInjector()
+    inj.arm("sync.request", "corrupt-chunk", times=1)
+    mgr = SyncManager(fresh.chain, peer_manager=pm, injector=inj,
+                      batch_slots=4)
+    reasons = ("undecodable", "broken-linkage", "slot-out-of-range",
+               "segment-rejected", "bad-signature", "import-rejected")
+    invalid0 = sum(M.SYNC_BATCHES_INVALID.value(labels=(r,)) for r in reasons)
+    mgr.add_peer(SyncPeer(peer_id="lone", head_slot=4,
+                          request_blocks=tuple_server(ahead.chain)))
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert sum(
+        M.SYNC_BATCHES_INVALID.value(labels=(r,)) for r in reasons
+    ) == invalid0 + 1
+    # the injected corruption was blamed on the serving peer (greylist),
+    # but as the only peer it stays pickable as a last resort
+    assert pm.greylisted("lone") and not pm.is_banned("lone")
+
+
+def test_four_node_byzantine_soak_over_sockets():
+    """The full wire soak: honest node vs one byzantine responder, one
+    flaky staller, and one honest server, over real TCP + noise + yamux.
+    The honest node reaches the good head gap-free, bans the byzantine
+    peer, and keeps the merely-flaky peer un-banned."""
+    pytest.importorskip("cryptography")
+    from lighthouse_tpu.beacon.node import BeaconNode
+    from lighthouse_tpu.consensus import spec as S
+    from lighthouse_tpu.consensus.testing import interop_state, phase0_spec
+
+    spec = phase0_spec(S.MINIMAL)
+    state, keypairs = interop_state(16, spec, fork="altair")
+    byz_inj, flaky_inj, honest_inj = (
+        FaultInjector(), FaultInjector(), FaultInjector(),
+    )
+    good = BeaconNode(spec, state, keypairs=keypairs)
+    byz = BeaconNode(spec, state, keypairs=keypairs, injector=byz_inj)
+    flaky = BeaconNode(spec, state, keypairs=keypairs, injector=flaky_inj)
+    honest = BeaconNode(spec, state, keypairs=keypairs, injector=honest_inj)
+    nodes = [good, byz, flaky, honest]
+
+    # the true chain lives on `good`; byz and flaky only hold a prefix
+    for slot in range(1, 13):
+        signed = good.chain.produce_block(slot, keypairs)
+        good.chain.process_block(signed, verify_signatures=False)
+        if slot <= 8:
+            byz.chain.process_block(signed, verify_signatures=False)
+            flaky.chain.process_block(signed, verify_signatures=False)
+
+    byz_inj.arm("rpc.respond", "corrupt-chunk")            # persistent
+    flaky_inj.arm("rpc.respond", "stall", delay=2.5, times=2)
+    honest_inj.arm("sync.request", "drop", times=1)        # one flaky drop
+    honest.sync.batch_slots = 4
+    honest.sync.request_timeout = 1.0
+
+    for n in nodes:
+        n.start()
+    try:
+        # dial worst-first so every rung of the ladder is exercised:
+        # byzantine → ban + stall, flaky → timeouts then progress,
+        # good → completes to head 12
+        for peer in (byz, flaky, good):
+            conn = honest.host.dial("127.0.0.1", peer.host.port)
+            honest._status_handshake(conn)
+        assert honest.sync.state == SyncState.SYNCED
+        assert honest.chain.head_root == good.chain.head_root
+        assert int(honest.chain.head_state().slot) == 12
+        # gap-free history
+        assert len(
+            serve_blocks_by_range(honest.chain, "altair")(1, 12)
+        ) == 12
+        # the byzantine responder climbed greylist → ban; the staller is
+        # penalized but never banned
+        assert honest.peer_manager.is_banned(byz.host.peer_id.hex())
+        assert not honest.peer_manager.is_banned(flaky.host.peer_id.hex())
+        assert honest.peer_manager.score(flaky.host.peer_id.hex()) < 0.0
+        # ban enforcement evicts the byzantine connection on heartbeat
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            byz.host.peer_id in honest.host.connections
+        ):
+            time.sleep(0.1)
+        assert byz.host.peer_id not in honest.host.connections
+    finally:
+        for n in nodes:
+            n.stop()

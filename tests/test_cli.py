@@ -65,6 +65,28 @@ def test_bn_short_run(capsys):
     assert rc == 0
 
 
+def test_bn_chaos_network_fault_kinds(capsys):
+    """`bn --chaos` accepts the byzantine network kinds and arms them on
+    the global injector (the req/resp sites fire them in a full node)."""
+    from lighthouse_tpu.utils import faults
+
+    try:
+        rc = main([
+            "--spec", "minimal", "bn", "--validators", "16",
+            "--http-port", "0", "--slots", "2", "--auto-propose",
+            "--chaos", "rpc.respond=extra-blocks",
+            "--chaos", "sync.request=stall:0.1x2",
+        ])
+        assert rc == 0
+        assert faults.INJECTOR.armed("rpc.respond")
+        assert faults.INJECTOR.armed("sync.request")
+        f = faults.INJECTOR._armed["sync.request"]
+        assert f.kind == "stall" and f.delay == 0.1 and f.remaining == 2
+        assert faults.INJECTOR._armed["rpc.respond"].kind == "extra-blocks"
+    finally:
+        faults.INJECTOR.disarm()
+
+
 def test_wallet_and_validator_manager(capsys):
     import json as _json
 

@@ -167,6 +167,63 @@ class TestFaultInjector:
         assert not inj.armed("store.put")
 
 
+class TestNetworkFaultKinds:
+    """Byzantine req/resp kinds for the sync.request / rpc.respond sites."""
+
+    def test_drop_raises_network_fault(self):
+        from lighthouse_tpu.utils.faults import NetworkFault
+
+        inj = FaultInjector()
+        inj.arm("sync.request", "drop", times=1)
+        with pytest.raises(NetworkFault):
+            inj.fire("sync.request", [b"chunk"])
+        assert inj.fire("sync.request", [b"chunk"]) == [b"chunk"]  # consumed
+
+    def test_stall_sleeps_then_passes(self):
+        import time as _time
+
+        inj = FaultInjector()
+        inj.arm("rpc.respond", "stall", delay=0.02, times=1)
+        t0 = _time.monotonic()
+        assert inj.fire("rpc.respond", [b"chunk"]) == [b"chunk"]
+        assert _time.monotonic() - t0 >= 0.015
+
+    def test_corrupt_chunk_flips_byte_both_shapes(self):
+        inj = FaultInjector()
+        # server side: encoded bytes elements
+        inj.arm("rpc.respond", "corrupt-chunk", times=1)
+        out = inj.fire("rpc.respond", [b"aaaa", b"bbbb"])
+        assert out[0] == b"aaaa"
+        assert out[1] != b"bbbb" and len(out[1]) == 4
+        # client side: decoded (result_code, ssz) tuples
+        inj.arm("sync.request", "corrupt-chunk", times=1)
+        out = inj.fire("sync.request", [(0, b"cccc")])
+        assert out[0][0] == 0 and out[0][1] != b"cccc"
+        # empty list is untouched, not an error
+        inj.arm("rpc.respond", "corrupt-chunk", times=1)
+        assert inj.fire("rpc.respond", []) == []
+
+    def test_wrong_blocks_reverses_and_extra_blocks_duplicates(self):
+        inj = FaultInjector()
+        inj.arm("rpc.respond", "wrong-blocks", times=1)
+        assert inj.fire("rpc.respond", [1, 2, 3]) == [3, 2, 1]
+        inj.arm("rpc.respond", "extra-blocks", times=1)
+        assert inj.fire("rpc.respond", [1, 2]) == [1, 2, 2]
+
+    def test_arm_from_spec_network_kinds(self):
+        inj = FaultInjector()
+        inj.arm_from_spec("sync.request=stall:3.0x2")
+        f = inj._armed["sync.request"]
+        assert f.kind == "stall" and f.delay == 3.0 and f.remaining == 2
+        # "extra-blocks" contains an "x": must not parse as a repeat count
+        inj.arm_from_spec("rpc.respond=extra-blocks")
+        f = inj._armed["rpc.respond"]
+        assert f.kind == "extra-blocks" and f.remaining is None
+        inj.arm_from_spec("rpc.respond=corrupt-chunkx1")
+        f = inj._armed["rpc.respond"]
+        assert f.kind == "corrupt-chunk" and f.remaining == 1
+
+
 # ---------------------------------------------------------------------------
 # CircuitBreaker
 # ---------------------------------------------------------------------------

@@ -96,6 +96,62 @@ def test_peerdb_retains_bans_across_disconnect():
     assert not rec.connected
 
 
+def test_ban_expiry_graft_gate_holds_under_decay():
+    """After a ban expires to greylist, decay ticks forgive the score
+    toward zero FROM BELOW — so the graft gate stays shut and the mesh
+    would prune the peer until it re-earns reputation via deliveries."""
+    pm = PeerManager(ban_duration=0.05)
+    for _ in range(4):
+        pm.on_invalid_message("bad", "t")
+    assert pm.is_banned("bad")
+    time.sleep(0.08)
+    pm.decay()  # lifts the ban, resumes at greylist-level manual score
+    assert not pm.is_banned("bad")
+    assert pm.score("bad") <= GREYLIST_THRESHOLD
+    for _ in range(50):
+        pm.decay()
+    # forgiven most of the way, but still negative: cold, not clean
+    assert GREYLIST_THRESHOLD < pm.score("bad") < 0.0
+    assert not pm.accept_graft("bad")
+    assert pm.mesh_prunable(["bad"]) == ["bad"]
+    # reputation is re-earned through first deliveries, not by waiting
+    for _ in range(10):
+        pm.on_first_delivery("bad", "t")
+    assert pm.accept_graft("bad")
+    assert pm.mesh_prunable(["bad"]) == []
+
+
+def test_prune_db_retains_banned_records():
+    """peerdb prune: overflowing the DB drops old disconnected records but
+    NEVER a banned one — a banned peer cannot flush its record by
+    churning connections."""
+    from lighthouse_tpu.network.peer_manager import MAX_DB_SIZE
+
+    pm = PeerManager()
+    for _ in range(4):
+        pm.on_invalid_message("villain", "t")
+    assert pm.is_banned("villain")
+    pm.disconnect("villain")
+    for i in range(MAX_DB_SIZE + 64):
+        pm.connect(f"churn{i}")
+        pm.disconnect(f"churn{i}")
+    assert "villain" in pm.peers
+    assert pm.is_banned("villain")
+    assert len(pm.peers) <= MAX_DB_SIZE + 2  # pruning did happen
+
+
+def test_goodbye_keeps_reputation():
+    pm = PeerManager()
+    pm.connect("p")
+    pm.on_behaviour_penalty("p", 2.0, "test")
+    score = pm.score("p")
+    pm.on_goodbye("p")
+    rec = pm.peers["p"]
+    assert rec.goodbyes == 1 and not rec.connected
+    assert pm.score("p") == score  # a goodbye is not a reset
+    assert not pm.is_banned("p")
+
+
 def test_wire_mesh_prunes_then_bans_misbehaving_peer():
     """VERDICT item-7 'done': over real sockets, a peer publishing
     invalid gossip is pruned from the mesh and then banned

@@ -1,14 +1,46 @@
 """Sync: range sync through the real req/resp codec between two in-process
-nodes; backfill linkage checks; stall on no peers."""
+nodes; SyncManager adversarial batch validation, rotation, and penalties;
+backfill linkage checks; stall on no peers."""
+
+import time
+
+import pytest
 
 from lighthouse_tpu.beacon import BeaconChainHarness
 from lighthouse_tpu.beacon.sync import (
     BackfillSync,
+    Batch,
+    BatchInvalid,
+    GarbageResponse,
     PeerSyncInfo,
     RangeSync,
+    SyncManager,
+    SyncPeer,
     SyncState,
     serve_blocks_by_range,
 )
+from lighthouse_tpu.network import rpc
+from lighthouse_tpu.network.peer_manager import PeerManager
+
+
+def tuple_server(chain, fork="altair"):
+    """Adapt serve_blocks_by_range (encoded chunks) to the SyncPeer
+    request contract (decoded (code, ssz) tuples)."""
+    serve = serve_blocks_by_range(chain, fork)
+
+    def request_blocks(start_slot, count):
+        return [rpc.decode_response_chunk(c) for c in serve(start_slot, count)]
+
+    return request_blocks
+
+
+def honest_peer(peer_id, harness, **kw):
+    return SyncPeer(
+        peer_id=peer_id,
+        head_slot=int(harness.head_state().slot),
+        request_blocks=tuple_server(harness.chain),
+        **kw,
+    )
 
 
 def test_range_sync_catches_up():
@@ -37,6 +69,275 @@ def test_sync_stalls_without_peers():
         "lighthouse_tpu.beacon.sync", fromlist=["Batch"]
     ).Batch(start_slot=1, count=8))
     assert sync.tick() == SyncState.IDLE
+
+
+# ---------------------------------------------------------------------------
+# SyncManager: adversarial batch validation, rotation, penalties, stalls
+# ---------------------------------------------------------------------------
+
+
+def decoded_blocks(harness, start, count, fork="altair"):
+    serve = serve_blocks_by_range(harness.chain, fork)
+    cls = harness.chain.types.SignedBeaconBlock_BY_FORK[fork]
+    return [
+        cls.deserialize_value(rpc.decode_response_chunk(c)[1])
+        for c in serve(start, count)
+    ]
+
+
+def test_sync_manager_syncs_from_honest_peer():
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(12)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=4)
+    mgr.add_peer(honest_peer("good", ahead))
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert mgr.imported == 12
+    assert mgr.failed_batches == 0
+    assert pm.score("good") == 0.0
+
+
+def test_sync_manager_validation_reasons():
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(6)
+    fresh = BeaconChainHarness(n_validators=16)
+    mgr = SyncManager(fresh.chain)
+    blocks = decoded_blocks(ahead, 1, 6)
+
+    with pytest.raises(BatchInvalid) as e:
+        mgr._validate(Batch(start_slot=1, count=2), blocks[:4])
+    assert e.value.reason == "over-count"
+
+    with pytest.raises(BatchInvalid) as e:
+        mgr._validate(Batch(start_slot=5, count=4), blocks[:4])
+    assert e.value.reason == "slot-out-of-range"
+
+    with pytest.raises(BatchInvalid) as e:
+        mgr._validate(Batch(start_slot=1, count=4), list(reversed(blocks[:4])))
+    assert e.value.reason == "non-increasing-slots"
+
+    with pytest.raises(BatchInvalid) as e:
+        mgr._validate(Batch(start_slot=1, count=4), [blocks[0], blocks[2]])
+    assert e.value.reason == "broken-linkage"
+
+    # a well-formed segment whose first block doesn't anchor to any state
+    # we hold (batch edge not linked to our chain)
+    with pytest.raises(BatchInvalid) as e:
+        mgr._validate(Batch(start_slot=2, count=4), blocks[1:5])
+    assert e.value.reason == "unknown-anchor"
+
+    # the honest segment passes
+    mgr._validate(Batch(start_slot=1, count=6), blocks)
+
+
+def test_sync_manager_rejects_tampered_signature_batch():
+    """Bulk segment verification: a block whose signature is a valid G2
+    point over the WRONG message fails the one-pass verify."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(4)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=4,
+                      max_batch_attempts=1)
+    tampered = decoded_blocks(ahead, 1, 4)
+    tampered[2].signature = bytes(tampered[1].signature)
+
+    def serve_tampered(start_slot, count):
+        return [(rpc.SUCCESS, b.encode()) for b in tampered]
+
+    mgr.add_peer(SyncPeer(peer_id="forger", head_slot=4,
+                          request_blocks=serve_tampered))
+    assert mgr.tick() == SyncState.STALLED
+    assert mgr.failed_batches == 1
+    assert fresh.chain.head_root != ahead.chain.head_root
+    assert pm.greylisted("forger")
+
+
+def test_sync_manager_rotates_off_byzantine_peer():
+    """Wrong-order blocks from one peer: penalized + greylisted on the
+    first strike, sync completes through the honest alternative."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(8)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=4)
+
+    honest_serve = tuple_server(ahead.chain)
+
+    def serve_reversed(start_slot, count):
+        return list(reversed(honest_serve(start_slot, count)))
+
+    # "a-byz" sorts first so deterministic rotation picks it initially
+    mgr.add_peer(SyncPeer(peer_id="a-byz", head_slot=8,
+                          request_blocks=serve_reversed))
+    mgr.add_peer(honest_peer("b-good", ahead))
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert mgr.failed_batches >= 1
+    assert pm.greylisted("a-byz") and not pm.is_banned("a-byz")
+    assert pm.score("b-good") == 0.0
+
+
+def test_sync_manager_bans_lone_byzantine_then_rearms():
+    """A lone garbage-serving peer climbs the whole ladder (greylist →
+    last-resort re-pick → ban), the batch parks as STALLED, and a new
+    honest peer re-arms the sync — the batch is never dropped."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(8)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=8)
+
+    def serve_garbage(start_slot, count):
+        raise GarbageResponse("undecodable stream bytes")
+
+    mgr.add_peer(SyncPeer(peer_id="byz", head_slot=8,
+                          request_blocks=serve_garbage))
+    assert mgr.tick() == SyncState.STALLED
+    # strike 1 greylists, strike 2 (last-resort re-pick) bans
+    assert mgr.failed_batches == 2
+    assert pm.is_banned("byz")
+    assert len(mgr.pending) == 1  # parked, not dropped
+
+    mgr.add_peer(honest_peer("good", ahead))
+    assert mgr.state == SyncState.SYNCING  # re-armed
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert mgr.imported == 8
+
+
+def test_sync_manager_timeout_penalizes_flaky_not_byzantine():
+    """A hanging peer costs a flaky-grade penalty (never a ban) and the
+    sync rotates to the alternative without wedging."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(4)
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=4,
+                      request_timeout=0.2)
+
+    def serve_hang(start_slot, count):
+        time.sleep(5.0)
+        return []
+
+    mgr.add_peer(SyncPeer(peer_id="a-hang", head_slot=4,
+                          request_blocks=serve_hang))
+    mgr.add_peer(honest_peer("b-good", ahead))
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert -16.0 < pm.score("a-hang") < 0.0  # penalized, not greylisted
+
+
+def test_sync_manager_empty_batch_is_not_penalized():
+    """A peer that serves nothing for a claimed range is retried without
+    penalty (slots can be empty) until the budget parks the batch."""
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    mgr = SyncManager(fresh.chain, peer_manager=pm, batch_slots=8,
+                      max_batch_attempts=2)
+    mgr.add_peer(SyncPeer(peer_id="hollow", head_slot=8,
+                          request_blocks=lambda s, c: []))
+    assert mgr.tick() == SyncState.STALLED
+    assert mgr.failed_batches == 2
+    assert pm.score("hollow") == 0.0
+    assert len(mgr.pending) == 1
+
+
+def test_sync_manager_extends_target_mid_sync():
+    """Satellite: a higher head arriving while SYNCING extends the batch
+    queue instead of being ignored."""
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(16)
+    fresh = BeaconChainHarness(n_validators=16)
+    mgr = SyncManager(fresh.chain, batch_slots=4)
+    first = honest_peer("first", ahead)
+    first.head_slot = 8  # claims only half the chain
+    mgr.add_peer(first)
+    assert sum(b.count for b in mgr.pending) == 8
+    mgr.add_peer(honest_peer("second", ahead))  # head 16 while SYNCING
+    assert sum(b.count for b in mgr.pending) == 16
+    assert mgr.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert mgr.imported == 16
+
+
+# ---------------------------------------------------------------------------
+# RangeSync satellites: _pick_peer rotation/exclusion, _start extension
+# ---------------------------------------------------------------------------
+
+
+def test_range_sync_pick_peer_excludes_failed_banned_greylisted():
+    fresh = BeaconChainHarness(n_validators=16)
+    pm = PeerManager()
+    sync = RangeSync(fresh.chain, peer_manager=pm)
+    for pid in ("a", "b", "c"):
+        sync.peers[pid] = PeerSyncInfo(peer_id=pid, head_slot=32,
+                                       finalized_epoch=0)
+    batch = Batch(start_slot=1, count=8, peer_id="b", attempts=1)
+    # the peer that just failed is never re-picked while alternatives exist
+    for _ in range(6):
+        assert sync._pick_peer(batch).peer_id != "b"
+    # banned and greylisted peers are excluded outright
+    pm.on_behaviour_penalty("a", 7.0, "test")  # -49 → banned
+    assert pm.is_banned("a")
+    pm.on_behaviour_penalty("c", 4.0, "test")  # -16 → greylisted
+    assert pm.greylisted("c") and not pm.is_banned("c")
+    picks = {sync._pick_peer(batch).peer_id for _ in range(6)}
+    assert picks == {"b"}  # sole eligible peer is re-picked as fallback
+    pm.on_behaviour_penalty("b", 7.0, "test")
+    assert sync._pick_peer(batch) is None
+
+
+def test_range_sync_rotation_is_deterministic():
+    fresh = BeaconChainHarness(n_validators=16)
+    sync = RangeSync(fresh.chain)
+    for pid in ("a", "b", "c"):
+        sync.peers[pid] = PeerSyncInfo(peer_id=pid, head_slot=32,
+                                       finalized_epoch=0)
+    batch = Batch(start_slot=1, count=8)
+    seq = [sync._pick_peer(batch).peer_id for _ in range(6)]
+    assert set(seq) == {"a", "b", "c"}  # cycles all peers
+    sync2 = RangeSync(fresh.chain)
+    sync2.peers = dict(sync.peers)
+    assert [sync2._pick_peer(batch).peer_id for _ in range(6)] == seq
+
+
+def test_range_sync_extends_target_mid_sync():
+    ahead = BeaconChainHarness(n_validators=16)
+    ahead.extend_chain(16)
+    fresh = BeaconChainHarness(n_validators=16)
+    sync = RangeSync(fresh.chain)
+    serve = serve_blocks_by_range(ahead.chain, "altair")
+    sync.add_peer(PeerSyncInfo(peer_id="first", head_slot=8,
+                               finalized_epoch=0, serve_blocks_by_range=serve))
+    assert sync.state == SyncState.SYNCING
+    assert sum(b.count for b in sync.pending) == 8
+    sync.add_peer(PeerSyncInfo(peer_id="second", head_slot=16,
+                               finalized_epoch=0, serve_blocks_by_range=serve))
+    assert sum(b.count for b in sync.pending) == 16
+    assert sync.tick() == SyncState.SYNCED
+    assert fresh.chain.head_root == ahead.chain.head_root
+    assert sync.imported == 16
+
+
+def test_serve_blocks_by_range_skips_empty_slots_without_dupes():
+    """Satellite: on empty slots state.block_roots repeats the previous
+    root — the server must not serve that block twice."""
+    h = BeaconChainHarness(n_validators=16)
+    h.add_block_at_slot(1)
+    h.add_block_at_slot(2)
+    h.add_block_at_slot(4)  # slot 3 stays empty
+    serve = serve_blocks_by_range(h.chain, "altair")
+    cls = h.chain.types.SignedBeaconBlock_BY_FORK["altair"]
+    chunks = serve(1, 6)
+    slots = []
+    for c in chunks:
+        code, payload = rpc.decode_response_chunk(c)
+        assert code == rpc.SUCCESS
+        slots.append(int(cls.deserialize_value(payload).message.slot))
+    assert slots == [1, 2, 4]
 
 
 def test_backfill_linkage():
