@@ -331,7 +331,7 @@ if __name__ == "__main__":
             run_measurement(force_cpu=(child == "cpu"))
         else:
             orchestrate()
-    except BaseException as exc:  # noqa: BLE001 — always emit a JSON line
+    except Exception as exc:  # noqa: BLE001 — always emit a JSON line
         import traceback
 
         traceback.print_exc(file=sys.stderr)

@@ -1,0 +1,269 @@
+"""Repo-wide static invariant analyzer.
+
+One entrypoint (``tools/pyrun tools/static_audit.py``) runs four lint
+families over the package and emits a JSON report, failing on any
+unwaivered violation:
+
+* ``lock_lint``     — lock-discipline race detector + lock-order graph
+* ``raise_lint``    — never-raise proofs + broad-except ban
+* ``registry_lint`` — metrics / fault-site / chaos-spec consistency
+* ``jaxpr_lint``    — dispatch hot-path host-sync ban (the jaxpr walk
+  and zero-dim guard live here too, but tracing is driven by
+  ``tools/dispatch_audit.py`` and the test suite, not by the audit —
+  the audit stays AST-only and finishes in seconds)
+
+Justified exceptions go in ``analysis/waivers.toml`` (see ``waivers``).
+Everything is configurable so the seeded-violation fixture corpus under
+``tests/fixtures/lint/`` can run the identical pipeline against its own
+tiny registries.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+from . import jaxpr_lint, lock_lint, raise_lint, registry_lint
+from .report import Violation
+from .waivers import Waiver, apply_waivers, load_waivers, parse_toml_subset
+
+__all__ = [
+    "AuditConfig", "AuditResult", "Violation", "Waiver",
+    "run_audit", "load_config", "discover_files", "load_waivers",
+    "jaxpr_lint", "lock_lint", "raise_lint", "registry_lint",
+]
+
+DEFAULT_NEVER_RAISE = (
+    "lighthouse_tpu/beacon/processor.py::ResilientVerifier.verify_batch",
+    "lighthouse_tpu/beacon/sync.py::SyncManager.tick",
+    "lighthouse_tpu/utils/faults.py::FaultInjector.maybe_fire",
+    "lighthouse_tpu/beacon/processor.py::BeaconProcessor.try_send",
+)
+
+ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr")
+
+
+@dataclass
+class AuditConfig:
+    # roots (files or directories, relative to the audit root) that form
+    # the python corpus
+    scan_roots: tuple = ("lighthouse_tpu", "tools", "tests", "bench.py")
+    # path prefixes eligible for the lock-discipline family (test classes
+    # carry no threading discipline; scanning them is pure noise)
+    lock_scan_include: tuple = ("lighthouse_tpu/",)
+    # never-raise proofs also only bind inside the package
+    never_raise: tuple = DEFAULT_NEVER_RAISE
+    safe_calls: tuple = ("BatchOutcome",)
+    metrics_defs: str = "lighthouse_tpu/utils/metrics.py"
+    faults_defs: str = "lighthouse_tpu/utils/faults.py"
+    docs: tuple = ("README.md", "STATUS.md")
+    hot_path: dict = field(
+        default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
+    )
+    site_scan_exclude: tuple = ("tests/",)
+    # prefixes dropped from the corpus entirely — the seeded-violation
+    # fixture corpus must not fail the live audit
+    exclude: tuple = ("tests/fixtures/lint/",)
+    families: tuple = ALL_FAMILIES
+
+
+@dataclass
+class AuditResult:
+    root: str
+    files_scanned: int
+    violations: list        # unwaivered [Violation]
+    waived: list            # [(Violation, reason)]
+    lock_edges: list        # [lock_lint.LockEdge]
+    elapsed_s: float
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def summary(self) -> dict:
+        counts: dict[str, int] = {}
+        for v in self.violations:
+            counts[v.rule] = counts.get(v.rule, 0) + 1
+        return counts
+
+    def to_dict(self) -> dict:
+        return {
+            "root": self.root,
+            "pass": self.ok,
+            "files_scanned": self.files_scanned,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "summary": self.summary(),
+            "violations": [v.to_dict() for v in self.violations],
+            "waived": [
+                dict(v.to_dict(), reason=reason) for v, reason in self.waived
+            ],
+            "lock_order_edges": sorted(
+                {(e.src, e.dst) for e in self.lock_edges}
+            ),
+        }
+
+
+def discover_files(root: str, scan_roots) -> list[str]:
+    """Repo-relative posix paths of every .py file under the roots."""
+    out = []
+    for entry in scan_roots:
+        full = os.path.join(root, entry)
+        if os.path.isfile(full) and entry.endswith(".py"):
+            out.append(entry.replace(os.sep, "/"))
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, fn), root
+                        )
+                        out.append(rel.replace(os.sep, "/"))
+    return sorted(set(out))
+
+
+def _read_corpus(root, rel_paths):
+    """[(rel_path, source)]; unreadable/unparsable files become
+    parse-error violations rather than crashing the audit."""
+    files, problems = [], []
+    for rel in rel_paths:
+        full = os.path.join(root, rel)
+        try:
+            with open(full, encoding="utf-8") as f:
+                src = f.read()
+            compile(src, rel, "exec", flags=0x400, dont_inherit=True)
+        except SyntaxError as exc:
+            problems.append(Violation(
+                rule="parse-error", path=rel, line=exc.lineno or 0,
+                symbol=rel, message=f"file does not parse: {exc.msg}",
+            ))
+            continue
+        except OSError as exc:
+            problems.append(Violation(
+                rule="parse-error", path=rel, line=0,
+                symbol=rel, message=f"unreadable: {exc}",
+            ))
+            continue
+        files.append((rel, src))
+    return files, problems
+
+
+def load_config(path: str) -> AuditConfig:
+    """Load an [audit] table (same TOML subset as waivers) into an
+    AuditConfig — used by the fixture corpus to re-point the registries."""
+    with open(path, encoding="utf-8") as f:
+        doc = parse_toml_subset(f.read(), path)
+    a = doc.get("audit", {})
+    cfg = AuditConfig()
+    if "scan_roots" in a:
+        cfg.scan_roots = tuple(a["scan_roots"])
+    if "lock_scan_include" in a:
+        cfg.lock_scan_include = tuple(a["lock_scan_include"])
+    if "never_raise" in a:
+        cfg.never_raise = tuple(a["never_raise"])
+    if "safe_calls" in a:
+        cfg.safe_calls = tuple(a["safe_calls"])
+    if "metrics_defs" in a:
+        cfg.metrics_defs = a["metrics_defs"]
+    if "faults_defs" in a:
+        cfg.faults_defs = a["faults_defs"]
+    if "docs" in a:
+        cfg.docs = tuple(a["docs"])
+    if "site_scan_exclude" in a:
+        cfg.site_scan_exclude = tuple(a["site_scan_exclude"])
+    if "exclude" in a:
+        cfg.exclude = tuple(a["exclude"])
+    if "families" in a:
+        cfg.families = tuple(a["families"])
+    if "hot_path" in a:
+        # entries are "relpath::fn" strings
+        hp: dict[str, list] = {}
+        for entry in a["hot_path"]:
+            p, _, fn = entry.partition("::")
+            hp.setdefault(p, []).append(fn)
+        cfg.hot_path = {p: tuple(fns) for p, fns in hp.items()}
+    return cfg
+
+
+def run_audit(
+    root: str,
+    config: AuditConfig | None = None,
+    waivers: list[Waiver] | str | None = None,
+) -> AuditResult:
+    t0 = time.perf_counter()
+    cfg = config or AuditConfig()
+    if isinstance(waivers, str):
+        waivers = load_waivers(waivers)
+    waivers = list(waivers or ())
+
+    rel_paths = discover_files(root, cfg.scan_roots)
+    if cfg.exclude:
+        rel_paths = [
+            p for p in rel_paths if not p.startswith(tuple(cfg.exclude))
+        ]
+    files, violations = _read_corpus(root, rel_paths)
+
+    lock_edges: list = []
+    if "lock" in cfg.families:
+        lock_files = [
+            (p, s) for p, s in files
+            if p.startswith(tuple(cfg.lock_scan_include))
+        ]
+        lock_violations, lock_edges = lock_lint.run(lock_files)
+        violations.extend(lock_violations)
+
+    if "raise" in cfg.families:
+        for p, s in files:
+            violations.extend(raise_lint.broad_except_violations(p, s))
+        package_files = [
+            (p, s) for p, s in files
+            if p.startswith(tuple(cfg.lock_scan_include))
+        ]
+        violations.extend(raise_lint.never_raise_violations(
+            package_files, cfg.never_raise, cfg.safe_calls
+        ))
+
+    if "registry" in cfg.families:
+        docs = []
+        for rel in cfg.docs:
+            full = os.path.join(root, rel)
+            try:
+                with open(full, encoding="utf-8") as f:
+                    docs.append((rel, f.read()))
+            except OSError:
+                violations.append(Violation(
+                    rule="parse-error", path=rel, line=0, symbol=rel,
+                    message="doc listed in audit config is unreadable",
+                ))
+        violations.extend(registry_lint.run(
+            files, docs, cfg.metrics_defs, cfg.faults_defs,
+            cfg.site_scan_exclude,
+        ))
+
+    if "jaxpr" in cfg.families:
+        violations.extend(jaxpr_lint.run(files, cfg.hot_path))
+
+    violations.sort(key=lambda v: (v.path, v.line, v.rule, v.symbol))
+    failing, waived = apply_waivers(violations, waivers)
+    for w in waivers:
+        if w.used == 0:
+            failing.append(Violation(
+                rule="stale-waiver", path="analysis/waivers.toml", line=0,
+                symbol=f"{w.rule}:{w.path}:{w.symbol}",
+                message=(
+                    "waiver matches nothing — the violation it excused is "
+                    "gone; delete the waiver"
+                ),
+            ))
+    return AuditResult(
+        root=root,
+        files_scanned=len(files),
+        violations=failing,
+        waived=waived,
+        lock_edges=lock_edges,
+        elapsed_s=time.perf_counter() - t0,
+    )

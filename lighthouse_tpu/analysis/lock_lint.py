@@ -1,0 +1,510 @@
+"""Lock-discipline race detector and lock-order graph (pure AST).
+
+Two checks, both lexical:
+
+**Guarded-attribute discipline.**  For every class, each ``self.<attr>``
+mutation site is classified as lock-held (lexically inside a
+``with self._lock:``-style block, or inside a method that takes a lock
+via ``self._lock.acquire()`` at its top) or bare.  An attribute whose
+mutations are *majority* lock-held is considered guarded by convention,
+and every bare mutation of it is a violation.  Bare *reads* are only
+flagged for attributes that are mutated through container operations
+(``d[k] = v``, ``.append``, ``.pop`` …) at ≥2 sites, all of them locked:
+plain rebinding of an int/reference is atomic under the GIL and flagging
+its reads would drown the signal, but iterating or len()-ing a dict that
+another thread resizes under a lock is a real race.
+
+**Lock-order graph.**  Acquiring ``self.B`` while lexically holding
+``self.A`` adds the edge ``Class.A -> Class.B``.  One level of
+intra-class calls is resolved: if a method calls ``self.m()`` while
+holding ``A`` and ``m`` acquires ``B``, the same edge is added.  A cycle
+in the union graph (including a self-edge on a non-reentrant lock) is a
+potential deadlock and fails the audit.
+
+``__init__`` bodies are skipped for discipline (construction happens
+before the object escapes); nested ``def``/``lambda`` bodies reset the
+held-lock context (they usually run on another thread later).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .report import Violation
+
+_LOCKISH = re.compile(r"(lock|mutex|_cv$|^cv$|cond)", re.IGNORECASE)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_REENTRANT_FACTORIES = {"RLock"}
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse", "rotate",
+}
+
+
+def is_lockish(name: str) -> bool:
+    return bool(_LOCKISH.search(name))
+
+
+@dataclass
+class _Site:
+    attr: str
+    method: str
+    line: int
+    locked: bool
+    container: bool = False
+
+
+@dataclass
+class LockEdge:
+    src: str          # "Class.attrA"
+    dst: str          # "Class.attrB"
+    path: str
+    line: int
+    via_call: str = ""
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    path: str
+    lock_attrs: set = field(default_factory=set)
+    reentrant: set = field(default_factory=set)
+    mutations: list = field(default_factory=list)   # [_Site]
+    reads: list = field(default_factory=list)       # [_Site]
+    # method name -> set of self-lock attrs it acquires anywhere
+    method_acquires: dict = field(default_factory=dict)
+    # (held_attr, called_method, line) pending one-level resolution
+    pending_calls: list = field(default_factory=list)
+    edges: list = field(default_factory=list)       # [LockEdge]
+
+
+def _self_attr(node) -> str | None:
+    """Return A for an ``self.A`` expression, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lock_factory_name(call: ast.expr) -> str | None:
+    """Return the factory name if `call` is threading.Lock()/RLock()/…"""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES:
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
+        return fn.id
+    return None
+
+
+class _ClassScanner:
+    """Single-class analysis: discipline sites + lock-order edges."""
+
+    def __init__(self, cls: ast.ClassDef, path: str):
+        self.info = _ClassInfo(name=cls.name, path=path)
+        self._cls = cls
+
+    def scan(self) -> _ClassInfo:
+        # pass 0: find self.<attr> = Lock()/RLock() assignments anywhere,
+        # plus Condition(self._lock)-style aliases.
+        for node in ast.walk(self._cls):
+            if isinstance(node, ast.Assign):
+                factory = _lock_factory_name(node.value)
+                if factory is None:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    self.info.lock_attrs.add(attr)
+                    if factory in _REENTRANT_FACTORIES:
+                        self.info.reentrant.add(attr)
+
+        # pass 1: per-method acquisition sets (for one-level call resolution)
+        for stmt in self._cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.info.method_acquires[stmt.name] = self._acquired_in(stmt)
+
+        # pass 2: walk each method tracking the lexically-held lock set
+        for stmt in self._cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                held = tuple(sorted(self._base_locks(stmt)))
+                self._walk_stmts(stmt.body, held, stmt.name,
+                                 in_init=stmt.name == "__init__")
+
+        # pass 3: resolve one level of intra-class calls made under a lock
+        for held_attr, callee, line in self.info.pending_calls:
+            for acquired in self.info.method_acquires.get(callee, ()):
+                if acquired != held_attr:
+                    self._add_edge(held_attr, acquired, line, via_call=callee)
+        return self.info
+
+    # -- helpers ---------------------------------------------------------
+
+    def _with_lock_attr(self, item: ast.withitem) -> str | None:
+        """Self lock attr acquired by a with-item, if any."""
+        ce = item.context_expr
+        attr = _self_attr(ce)
+        if attr is not None and (attr in self.info.lock_attrs or is_lockish(attr)):
+            self.info.lock_attrs.add(attr)
+            return attr
+        return None
+
+    def _with_is_lockish(self, item: ast.withitem) -> bool:
+        """Any lock-looking context manager (module lock, peer._lock, …)."""
+        ce = item.context_expr
+        name = None
+        if isinstance(ce, ast.Attribute):
+            name = ce.attr
+        elif isinstance(ce, ast.Name):
+            name = ce.id
+        return name is not None and is_lockish(name)
+
+    def _base_locks(self, fn) -> set:
+        """Locks a method holds for its whole body via ``self._x.acquire()``
+        as a top-level statement (the non-blocking-tick idiom)."""
+        out = set()
+        for stmt in fn.body:
+            target = None
+            if isinstance(stmt, ast.Expr):
+                target = stmt.value
+            elif isinstance(stmt, ast.Assign):
+                target = stmt.value
+            elif isinstance(stmt, ast.If):
+                # `if not self._x.acquire(blocking=False): return`
+                test = stmt.test
+                if isinstance(test, ast.UnaryOp):
+                    test = test.operand
+                target = test
+            if (
+                isinstance(target, ast.Call)
+                and isinstance(target.func, ast.Attribute)
+                and target.func.attr == "acquire"
+            ):
+                attr = _self_attr(target.func.value)
+                if attr is not None and is_lockish(attr):
+                    self.info.lock_attrs.add(attr)
+                    out.add(attr)
+        return out
+
+    def _acquired_in(self, fn) -> set:
+        """All self-lock attrs a method acquires anywhere in its body."""
+        out = set(self._base_locks(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = self._with_lock_attr(item)
+                    if attr is not None:
+                        out.add(attr)
+        return out
+
+    def _add_edge(self, src_attr, dst_attr, line, via_call=""):
+        self.info.edges.append(LockEdge(
+            src=f"{self.info.name}.{src_attr}",
+            dst=f"{self.info.name}.{dst_attr}",
+            path=self.info.path, line=line, via_call=via_call,
+        ))
+
+    # -- the context-carrying walk ---------------------------------------
+
+    def _walk_stmts(self, stmts, held, method, in_init):
+        for stmt in stmts:
+            self._walk_stmt(stmt, held, method, in_init)
+
+    def _walk_stmt(self, stmt, held, method, in_init):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later, possibly on another thread — the
+            # enclosing lock is NOT held when it executes.
+            self._walk_stmts(stmt.body, (), f"{method}.{stmt.name}", in_init)
+            return
+        if isinstance(stmt, ast.With):
+            new_held = list(held)
+            for item in stmt.items:
+                attr = self._with_lock_attr(item)
+                if attr is not None:
+                    for h in held:
+                        if h != attr:
+                            self._add_edge(h, attr, stmt.lineno)
+                        elif attr not in self.info.reentrant:
+                            self._add_edge(h, attr, stmt.lineno)  # self-edge
+                    new_held.append(attr)
+                elif self._with_is_lockish(item):
+                    new_held.append("")   # anonymous lock: guards, no node
+                else:
+                    self._walk_expr(item.context_expr, held, method, in_init)
+                if item.optional_vars is not None:
+                    self._walk_expr(item.optional_vars, held, method, in_init)
+            self._walk_stmts(stmt.body, tuple(new_held), method, in_init)
+            return
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                self._walk_target(tgt, held, method, in_init)
+            self._walk_expr(stmt.value, held, method, in_init)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._walk_target(stmt.target, held, method, in_init,
+                              aug=True)
+            self._walk_expr(stmt.value, held, method, in_init)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self._walk_target(stmt.target, held, method, in_init)
+            if stmt.value is not None:
+                self._walk_expr(stmt.value, held, method, in_init)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._walk_target(tgt, held, method, in_init)
+            return
+        # generic: walk child expressions, recurse into child statements
+        for fname, value in ast.iter_fields(stmt):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self._walk_stmts(value, held, method, in_init)
+                else:
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self._walk_expr(v, held, method, in_init)
+                        elif isinstance(v, ast.excepthandler):
+                            self._walk_stmts(v.body, held, method, in_init)
+            elif isinstance(value, ast.expr):
+                self._walk_expr(value, held, method, in_init)
+            elif isinstance(value, ast.stmt):
+                self._walk_stmt(value, held, method, in_init)
+
+    def _walk_target(self, tgt, held, method, in_init, aug=False):
+        """Assignment/Delete target: record self-attr mutations."""
+        attr = _self_attr(tgt)
+        if attr is not None:
+            if attr not in self.info.lock_attrs and not in_init:
+                self.info.mutations.append(_Site(
+                    attr, method, tgt.lineno, locked=bool(held),
+                    container=False,
+                ))
+            return
+        if isinstance(tgt, ast.Subscript):
+            base = _self_attr(tgt.value)
+            if base is not None:
+                if base not in self.info.lock_attrs and not in_init:
+                    self.info.mutations.append(_Site(
+                        base, method, tgt.lineno, locked=bool(held),
+                        container=True,
+                    ))
+                self._walk_expr(tgt.slice, held, method, in_init)
+                return
+            self._walk_expr(tgt.value, held, method, in_init)
+            self._walk_expr(tgt.slice, held, method, in_init)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._walk_target(elt, held, method, in_init, aug=aug)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._walk_target(tgt.value, held, method, in_init, aug=aug)
+            return
+        self._walk_expr(tgt, held, method, in_init)
+
+    def _walk_expr(self, expr, held, method, in_init):
+        if expr is None:
+            return
+        if isinstance(expr, (ast.Lambda,)):
+            self._walk_expr(expr.body, (), f"{method}.<lambda>", in_init)
+            return
+        if isinstance(expr, ast.Call):
+            fn = expr.func
+            if isinstance(fn, ast.Attribute):
+                base_attr = _self_attr(fn.value)
+                if base_attr is not None and fn.attr in _MUTATOR_METHODS:
+                    # self.X.append(...) — container mutation of X
+                    if base_attr not in self.info.lock_attrs and not in_init:
+                        self.info.mutations.append(_Site(
+                            base_attr, method, expr.lineno,
+                            locked=bool(held), container=True,
+                        ))
+                    for a in expr.args:
+                        self._walk_expr(a, held, method, in_init)
+                    for kw in expr.keywords:
+                        self._walk_expr(kw.value, held, method, in_init)
+                    return
+            callee = _self_attr(fn)
+            if callee is not None and held:
+                # self.m() while holding locks: queue for one-level
+                # lock-order resolution.
+                for h in held:
+                    if h:
+                        self.info.pending_calls.append(
+                            (h, callee, expr.lineno)
+                        )
+        # generic expression walk
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                attr = _self_attr(child)
+                if (
+                    attr is not None
+                    and isinstance(child.ctx, ast.Load)
+                    and attr not in self.info.lock_attrs
+                    and not in_init
+                ):
+                    self.info.reads.append(_Site(
+                        attr, method, child.lineno, locked=bool(held),
+                    ))
+                    continue
+                self._walk_expr(child, held, method, in_init)
+            elif isinstance(child, (ast.comprehension,)):
+                self._walk_expr(child.iter, held, method, in_init)
+                for cond in child.ifs:
+                    self._walk_expr(cond, held, method, in_init)
+            elif isinstance(child, ast.keyword):
+                self._walk_expr(child.value, held, method, in_init)
+            elif isinstance(child, ast.FormattedValue):
+                self._walk_expr(child.value, held, method, in_init)
+
+
+# -- file / corpus level -------------------------------------------------
+
+
+def scan_file(path: str, src: str, display_path: str | None = None):
+    """Analyze one file; returns (list[_ClassInfo], list[LockEdge])."""
+    tree = ast.parse(src, filename=path)
+    display = display_path or path
+    infos, edges = [], []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            info = _ClassScanner(node, display).scan()
+            infos.append(info)
+            edges.extend(info.edges)
+    return infos, edges
+
+
+def discipline_violations(info: _ClassInfo) -> list[Violation]:
+    out = []
+    by_attr: dict[str, list[_Site]] = {}
+    for site in info.mutations:
+        by_attr.setdefault(site.attr, []).append(site)
+    for attr, sites in sorted(by_attr.items()):
+        locked = [s for s in sites if s.locked]
+        bare = [s for s in sites if not s.locked]
+        if not locked or len(locked) <= len(bare):
+            continue  # not guarded by convention
+        for s in bare:
+            out.append(Violation(
+                rule="lock-discipline",
+                path=info.path,
+                line=s.line,
+                symbol=f"{info.name}.{attr}",
+                message=(
+                    f"mutation of {info.name}.{attr} outside a lock "
+                    f"({len(locked)}/{len(sites)} mutation sites are "
+                    f"lock-held, so the attribute is guarded by convention)"
+                ),
+            ))
+        # container attrs that are 100% lock-mutated at >=2 sites: bare
+        # reads race with concurrent resizes.
+        if (
+            not bare
+            and len(locked) >= 2
+            and any(s.container for s in locked)
+        ):
+            for r in info.reads:
+                if r.attr == attr and not r.locked:
+                    out.append(Violation(
+                        rule="lock-discipline",
+                        path=info.path,
+                        line=r.line,
+                        symbol=f"{info.name}.{attr}",
+                        message=(
+                            f"read of lock-guarded container "
+                            f"{info.name}.{attr} outside the lock (all "
+                            f"{len(locked)} mutation sites are lock-held)"
+                        ),
+                    ))
+    return out
+
+
+def find_cycles(edges: list[LockEdge]):
+    """Return a list of cycles; each cycle is a list of LockEdge forming
+    the loop.  Simple iterative DFS over the edge multigraph."""
+    graph: dict[str, list[LockEdge]] = {}
+    for e in edges:
+        graph.setdefault(e.src, []).append(e)
+    cycles, seen_keys = [], set()
+
+    def dfs(node, stack, stack_set, visited):
+        visited.add(node)
+        for e in graph.get(node, ()):
+            if e.dst in stack_set:
+                i = next(
+                    idx for idx, se in enumerate(stack) if se.src == e.dst
+                )
+                cyc = stack[i:] + [e]
+                key = tuple(sorted((c.src, c.dst) for c in cyc))
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(cyc)
+                continue
+            if e.dst not in visited:
+                stack.append(e)
+                stack_set.add(e.src)
+                dfs(e.dst, stack, stack_set, visited)
+                stack_set.discard(e.src)
+                stack.pop()
+
+    visited: set[str] = set()
+    for node in sorted(graph):
+        if node not in visited:
+            dfs(node, [], set(), visited)
+    # self-edges (with self._lock: ... with self._lock: on a plain Lock)
+    for e in edges:
+        if e.src == e.dst:
+            key = ((e.src, e.dst),)
+            if key not in seen_keys:
+                seen_keys.add(key)
+                cycles.append([e])
+    return cycles
+
+
+def cycle_violations(edges: list[LockEdge]) -> list[Violation]:
+    out = []
+    for cyc in find_cycles(edges):
+        loop = " -> ".join([e.src for e in cyc] + [cyc[0].src])
+        anchor = cyc[0]
+        detail = "self-acquisition of a non-reentrant lock" \
+            if len(cyc) == 1 and anchor.src == anchor.dst \
+            else "lock-order cycle (potential deadlock)"
+        out.append(Violation(
+            rule="lock-order",
+            path=anchor.path,
+            line=anchor.line,
+            symbol=anchor.src,
+            message=f"{detail}: {loop}",
+        ))
+    return out
+
+
+def run(files) -> tuple[list[Violation], list[LockEdge]]:
+    """files: iterable of (display_path, source). Returns (violations,
+    the full lock-order edge list for the runtime sanitizer to check
+    against)."""
+    violations, all_edges = [], []
+    for display, src in files:
+        infos, edges = scan_file(display, src, display)
+        all_edges.extend(edges)
+        for info in infos:
+            violations.extend(discipline_violations(info))
+    violations.extend(cycle_violations(all_edges))
+    return violations, all_edges
+
+
+def static_lock_order(files) -> set[tuple[str, str]]:
+    """The static edge set as (src, dst) pairs — the runtime lockcheck
+    sanitizer asserts its observed acquisition order is a subset."""
+    _, edges = run(files)
+    return {(e.src, e.dst) for e in edges}

@@ -1,0 +1,332 @@
+"""Never-raise proof + repo-wide broad-except ban.
+
+**Broad-except ban** (whole repo): a bare ``except:`` or
+``except BaseException`` handler swallows ``SystemExit`` and
+``KeyboardInterrupt``; it is only legal when the handler body re-raises
+(cleanup-then-propagate, e.g. the slashing-protection ROLLBACK path).
+Everything else must narrow to ``except Exception``.
+
+**Never-raise proof** (registry-driven): functions documented as never
+raising (``ResilientVerifier.verify_batch``, ``SyncManager.tick``,
+``FaultInjector.maybe_fire``, ``BeaconProcessor.try_send``) are proven
+so lexically: every statement in the body must be *dominated by* a
+``try`` whose handlers cannot re-raise, or be in the small whitelist of
+statements that cannot raise (``return None``, assignments of safe
+expressions, calls to known-total functions like ``len``/``log.debug``/
+``lock.release``).  A covering ``try`` must have at least one broad
+handler (``Exception`` or wider), no handler may contain ``raise``, and
+every handler body must itself consist only of safe statements — an
+exception raised *inside* a handler escapes the ladder.
+
+The proof is conservative: it can reject raise-free code (then you
+restructure or waive), it cannot accept raising code within the modeled
+semantics.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .report import Violation
+
+BROAD_TYPES = {"Exception", "BaseException"}
+
+DEFAULT_SAFE_NAME_CALLS = {
+    "len", "list", "tuple", "dict", "set", "frozenset", "bool", "str",
+    "repr", "isinstance", "min", "max", "abs", "sorted", "getattr",
+    "id", "type", "range", "enumerate", "print",
+}
+
+DEFAULT_SAFE_ATTR_CALLS = {
+    # locks / events
+    "release", "acquire", "locked", "is_set", "clear",
+    # containers (total ops only — no popleft/pop, those raise on empty)
+    "append", "appendleft", "add", "discard", "get", "items", "values",
+    "keys", "copy", "setdefault",
+    # metrics
+    "inc", "dec", "set", "observe",
+    # time
+    "monotonic", "perf_counter", "time", "sleep",
+    # logging (logging.Handler.handleError swallows formatting errors)
+    "debug", "info", "warning", "error", "exception", "log",
+    # the never-raise injector entrypoint itself
+    "maybe_fire",
+}
+
+_UNSAFE_BINOPS = (ast.Div, ast.FloorDiv, ast.Mod, ast.Pow, ast.MatMult)
+
+
+def _handler_names(handler: ast.ExceptHandler):
+    """Exception type names a handler catches ([] for bare except)."""
+    t = handler.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+        else:
+            out.append("<expr>")
+    return out
+
+
+def _contains_raise(node) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(node))
+
+
+# -- broad-except ban ----------------------------------------------------
+
+
+def broad_except_violations(path, src) -> list[Violation]:
+    tree = ast.parse(src, filename=path)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = _handler_names(node)
+        bare = node.type is None
+        if not bare and "BaseException" not in names:
+            continue
+        if any(_contains_raise(s) for s in node.body):
+            continue  # cleanup-then-propagate is legitimate
+        what = "bare `except:`" if bare else "`except BaseException`"
+        out.append(Violation(
+            rule="broad-except",
+            path=path,
+            line=node.lineno,
+            symbol=",".join(names) or "except:",
+            message=(
+                f"{what} without re-raise swallows SystemExit/"
+                f"KeyboardInterrupt; narrow to `except Exception`"
+            ),
+        ))
+    return out
+
+
+# -- never-raise proof ---------------------------------------------------
+
+
+class _Prover:
+    def __init__(self, safe_name_calls, safe_attr_calls):
+        self.safe_name_calls = safe_name_calls
+        self.safe_attr_calls = safe_attr_calls
+        self.problems: list[tuple[int, str]] = []
+
+    # expressions ---------------------------------------------------------
+
+    def safe_expr(self, e) -> bool:
+        if e is None or isinstance(e, ast.Constant):
+            return True
+        if isinstance(e, ast.Name):
+            return True
+        if isinstance(e, ast.Attribute):
+            return self.safe_expr(e.value)
+        if isinstance(e, ast.JoinedStr):
+            return all(self.safe_expr(v) for v in e.values)
+        if isinstance(e, ast.FormattedValue):
+            return self.safe_expr(e.value)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return all(self.safe_expr(v) for v in e.elts)
+        if isinstance(e, ast.Dict):
+            return all(self.safe_expr(k) for k in e.keys if k is not None) \
+                and all(self.safe_expr(v) for v in e.values)
+        if isinstance(e, ast.BoolOp):
+            return all(self.safe_expr(v) for v in e.values)
+        if isinstance(e, ast.UnaryOp):
+            return self.safe_expr(e.operand)
+        if isinstance(e, ast.Compare):
+            return self.safe_expr(e.left) and all(
+                self.safe_expr(c) for c in e.comparators
+            )
+        if isinstance(e, ast.IfExp):
+            return (
+                self.safe_expr(e.test)
+                and self.safe_expr(e.body)
+                and self.safe_expr(e.orelse)
+            )
+        if isinstance(e, ast.BinOp):
+            if isinstance(e.op, _UNSAFE_BINOPS):
+                return False  # ZeroDivisionError etc.
+            return self.safe_expr(e.left) and self.safe_expr(e.right)
+        if isinstance(e, ast.Call):
+            return self.safe_call(e)
+        return False  # Subscript (KeyError), Await, Yield, comprehensions…
+
+    def safe_call(self, call: ast.Call) -> bool:
+        args_ok = all(self.safe_expr(a) for a in call.args) and all(
+            kw.value is not None and self.safe_expr(kw.value)
+            for kw in call.keywords
+        )
+        if not args_ok:
+            return False
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return fn.id in self.safe_name_calls
+        if isinstance(fn, ast.Attribute):
+            return fn.attr in self.safe_attr_calls and self.safe_expr(fn.value)
+        return False
+
+    # statements ----------------------------------------------------------
+
+    def safe_or_covered(self, stmt) -> bool:
+        """True iff `stmt` cannot let an exception escape."""
+        if isinstance(stmt, ast.Try):
+            return self.covering_try(stmt)
+        if isinstance(stmt, (ast.Pass, ast.Break, ast.Continue,
+                             ast.Global, ast.Nonlocal)):
+            return True
+        if isinstance(stmt, ast.Return):
+            return self.safe_expr(stmt.value)
+        if isinstance(stmt, ast.Expr):
+            return self.safe_expr(stmt.value)
+        if isinstance(stmt, ast.Assign):
+            return all(self.safe_target(t) for t in stmt.targets) \
+                and self.safe_expr(stmt.value)
+        if isinstance(stmt, ast.AnnAssign):
+            return self.safe_target(stmt.target) and self.safe_expr(stmt.value)
+        if isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.op, _UNSAFE_BINOPS):
+                return False
+            return self.safe_target(stmt.target) and self.safe_expr(stmt.value)
+        if isinstance(stmt, ast.If):
+            return (
+                self.safe_expr(stmt.test)
+                and all(self.safe_or_covered(s) for s in stmt.body)
+                and all(self.safe_or_covered(s) for s in stmt.orelse)
+            )
+        if isinstance(stmt, ast.While):
+            return (
+                self.safe_expr(stmt.test)
+                and all(self.safe_or_covered(s) for s in stmt.body)
+                and all(self.safe_or_covered(s) for s in stmt.orelse)
+            )
+        if isinstance(stmt, ast.With):
+            return all(
+                self.safe_expr(i.context_expr) for i in stmt.items
+            ) and all(self.safe_or_covered(s) for s in stmt.body)
+        return False  # For (iterator may raise), Raise, Import, Assert, …
+
+    def safe_target(self, t) -> bool:
+        if isinstance(t, ast.Name):
+            return True
+        if isinstance(t, ast.Attribute):
+            return self.safe_expr(t.value)
+        return False  # Subscript / unpacking can raise
+
+    def covering_try(self, node: ast.Try) -> bool:
+        """A try covers its body iff its ladder cannot re-raise: one
+        broad handler, no `raise` in any handler, all handler bodies
+        built from safe statements, and orelse/finally themselves safe
+        (they run outside the handlers' protection)."""
+        has_broad = False
+        for h in node.handlers:
+            names = _handler_names(h)
+            if h.type is None or any(n in BROAD_TYPES for n in names):
+                has_broad = True
+            if any(_contains_raise(s) for s in h.body):
+                return False
+            if not all(self.safe_or_covered(s) for s in h.body):
+                return False
+        if not has_broad:
+            return False
+        return all(self.safe_or_covered(s) for s in node.orelse) and all(
+            self.safe_or_covered(s) for s in node.finalbody
+        )
+
+    def prove(self, fn) -> list[tuple[int, str]]:
+        problems = []
+        for stmt in fn.body:
+            if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant
+            ):
+                continue  # docstring
+            if not self.safe_or_covered(stmt):
+                kind = type(stmt).__name__
+                if isinstance(stmt, ast.Try):
+                    problems.append((
+                        stmt.lineno,
+                        "try block whose handler ladder can re-raise or "
+                        "whose handlers/finally contain unsafe statements",
+                    ))
+                else:
+                    problems.append((
+                        stmt.lineno,
+                        f"{kind} statement not dominated by a non-re-raising "
+                        f"try and not provably exception-free",
+                    ))
+        return problems
+
+
+def never_raise_violations(
+    files, registry, extra_safe_calls=(), extra_safe_attr_calls=()
+) -> list[Violation]:
+    """files: iterable of (display_path, source).  registry: iterable of
+    "relpath::Qual.name" strings.  Returns violations, including one per
+    registry entry whose function no longer exists (registry drift)."""
+    wanted: dict[tuple[str, str], bool] = {}
+    for entry in registry:
+        path, _, qual = entry.partition("::")
+        wanted[(path, qual)] = False
+
+    prover = _Prover(
+        DEFAULT_SAFE_NAME_CALLS | set(extra_safe_calls),
+        DEFAULT_SAFE_ATTR_CALLS | set(extra_safe_attr_calls),
+    )
+    out = []
+    for display, src in files:
+        quals = {
+            q for (p, q), _ in wanted.items() if p == display or p == "*"
+        }
+        if not quals:
+            continue
+        tree = ast.parse(src, filename=display)
+        for cls_or_fn, qual in _iter_functions(tree):
+            if qual not in quals:
+                continue
+            for p, q in list(wanted):
+                if q == qual and (p == display or p == "*"):
+                    wanted[(p, q)] = True
+            for line, why in prover.prove(cls_or_fn):
+                out.append(Violation(
+                    rule="never-raise",
+                    path=display,
+                    line=line,
+                    symbol=qual,
+                    message=f"never-raise contract not proven: {why}",
+                ))
+    for (path, qual), found in sorted(wanted.items()):
+        if not found:
+            out.append(Violation(
+                rule="never-raise",
+                path=path,
+                line=0,
+                symbol=qual,
+                message=(
+                    "registered never-raise function not found "
+                    "(registry drift — update the registry)"
+                ),
+            ))
+    return out
+
+
+def _iter_functions(tree):
+    """Yield (FunctionDef, qualname) for module- and class-level defs."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.name
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub, f"{node.name}.{sub.name}"
+
+
+def run(files, registry, extra_safe_calls=()) -> list[Violation]:
+    files = list(files)
+    out = []
+    for display, src in files:
+        out.extend(broad_except_violations(display, src))
+    out.extend(never_raise_violations(files, registry, extra_safe_calls))
+    return out

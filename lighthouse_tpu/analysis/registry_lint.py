@@ -1,0 +1,383 @@
+"""Registry-consistency checks: metrics, fault sites, chaos specs.
+
+Three cross-reference families, all driven off the canonical registries:
+
+* **metrics-registry** — every ``M.SOME_METRIC`` / ``from ..metrics
+  import SOME_METRIC`` reference anywhere in the scanned corpus must
+  resolve to a top-level definition in ``utils/metrics.py``; every
+  Counter/Gauge/Histogram *defined* there must be referenced somewhere
+  (no orphaned registrations); Prometheus names must be unique across
+  the whole corpus; and every ``*_total`` metric name quoted in the docs
+  must be a registered prom name.
+* **fault-sites** — every literal site string passed to
+  ``fire``/``check``/``maybe_fire`` must appear in the canonical
+  ``SITES`` registry in ``utils/faults.py`` (f-string sites must start
+  with a registered ``SITE_PREFIXES`` entry), and every registered site
+  must actually be fired somewhere.
+* **chaos-spec** — every ``--chaos <spec>`` example in README/STATUS
+  must parse under the real ``FaultInjector.arm_from_spec`` grammar and
+  name a registered site.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .report import Violation
+
+_METRIC_FACTORIES = {"Counter", "Gauge", "Histogram"}
+_FIRE_METHODS = {"fire", "check", "maybe_fire"}
+_UPPER = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_DOC_METRIC = re.compile(r"\b([a-z][a-z0-9_]*_total)\b")
+_DOC_SPEC = re.compile(r"--chaos[ =]+([^\s`'\")]+)")
+
+
+# -- metrics -------------------------------------------------------------
+
+
+def metric_defs(src: str, path: str):
+    """(metric name -> (prom_name, line), all top-level UPPER names)."""
+    tree = ast.parse(src, filename=path)
+    defs: dict[str, tuple[str, int]] = {}
+    upper_names: set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Name) and _UPPER.match(tgt.id)):
+                continue
+            upper_names.add(tgt.id)
+            v = node.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Name)
+                and v.func.id in _METRIC_FACTORIES
+                and v.args
+                and isinstance(v.args[0], ast.Constant)
+                and isinstance(v.args[0].value, str)
+            ):
+                defs[tgt.id] = (v.args[0].value, node.lineno)
+    return defs, upper_names
+
+
+def _metric_refs(src: str, path: str, defs_basename: str = "metrics"):
+    """References to registry members in one file:
+    [(name, line)] for both ``M.NAME`` and directly-imported ``NAME``."""
+    tree = ast.parse(src, filename=path)
+    module_aliases: set[str] = set()
+    direct: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").rsplit(".", 1)[-1]
+            if mod == defs_basename:
+                for alias in node.names:
+                    if _UPPER.match(alias.name):
+                        direct.add(alias.asname or alias.name)
+            else:
+                for alias in node.names:
+                    if alias.name == defs_basename:
+                        module_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.rsplit(".", 1)[-1] == defs_basename:
+                    module_aliases.add(
+                        alias.asname or alias.name.split(".", 1)[0]
+                    )
+    refs = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in module_aliases
+            and _UPPER.match(node.attr)
+        ):
+            refs.append((node.attr, node.lineno))
+        elif isinstance(node, ast.Name) and node.id in direct and isinstance(
+            node.ctx, ast.Load
+        ):
+            refs.append((node.id, node.lineno))
+    return refs
+
+
+def metrics_violations(files, metrics_defs_path, docs) -> list[Violation]:
+    files = dict(files)
+    out: list[Violation] = []
+    defs_src = files.get(metrics_defs_path)
+    if defs_src is None:
+        return [Violation(
+            rule="metrics-registry", path=metrics_defs_path, line=0,
+            symbol="utils/metrics.py",
+            message="metrics registry file not found in scan set",
+        )]
+    defs, known_names = metric_defs(defs_src, metrics_defs_path)
+    used: set[str] = set()
+    defs_basename = os.path.splitext(os.path.basename(metrics_defs_path))[0]
+
+    for display, src in files.items():
+        if display == metrics_defs_path:
+            continue
+        for name, line in _metric_refs(src, display, defs_basename):
+            if name in defs:
+                used.add(name)
+            elif name not in known_names:
+                out.append(Violation(
+                    rule="metrics-registry", path=display, line=line,
+                    symbol=name,
+                    message=(
+                        f"metric {name} referenced but not registered in "
+                        f"{metrics_defs_path}"
+                    ),
+                ))
+    for name, (prom, line) in sorted(defs.items()):
+        if name not in used:
+            out.append(Violation(
+                rule="metrics-registry", path=metrics_defs_path, line=line,
+                symbol=name,
+                message=(
+                    f"orphaned metric registration {name} ({prom!r}): "
+                    f"defined but never referenced"
+                ),
+            ))
+
+    # prom-name uniqueness across every factory call in the corpus
+    prom_sites: dict[str, list[tuple[str, int]]] = {}
+    for display, src in files.items():
+        for node in ast.walk(ast.parse(src, filename=display)):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _METRIC_FACTORIES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                prom_sites.setdefault(node.args[0].value, []).append(
+                    (display, node.lineno)
+                )
+    for prom, sites in sorted(prom_sites.items()):
+        if len(sites) > 1:
+            others = ", ".join(f"{p}:{ln}" for p, ln in sites[1:])
+            out.append(Violation(
+                rule="metrics-registry", path=sites[0][0],
+                line=sites[0][1], symbol=prom,
+                message=f"prometheus name {prom!r} registered twice "
+                        f"(also at {others})",
+            ))
+
+    # docs: every *_total token must be a registered prom name
+    registered_prom = {prom for prom, _ in defs.values()} | set(prom_sites)
+    for display, text in docs:
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for token in _DOC_METRIC.findall(line):
+                if token not in registered_prom:
+                    out.append(Violation(
+                        rule="metrics-registry", path=display, line=lineno,
+                        symbol=token,
+                        message=(
+                            f"doc references metric {token!r} which is not "
+                            f"a registered prometheus name"
+                        ),
+                    ))
+    return out
+
+
+# -- fault sites ---------------------------------------------------------
+
+
+def fault_site_defs(src: str, path: str):
+    """Parse SITES (dict/set/tuple of str) and SITE_PREFIXES from the
+    canonical registry module."""
+    tree = ast.parse(src, filename=path)
+    sites: dict[str, int] = {}
+    prefixes: list[str] = []
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        v = node.value
+        if "SITES" in names:
+            keys = []
+            if isinstance(v, ast.Dict):
+                keys = v.keys
+            elif isinstance(v, (ast.Set, ast.Tuple, ast.List)):
+                keys = v.elts
+            for k in keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    sites[k.value] = k.lineno
+        elif "SITE_PREFIXES" in names and isinstance(
+            v, (ast.Tuple, ast.List, ast.Set)
+        ):
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    prefixes.append(e.value)
+    return sites, tuple(prefixes)
+
+
+def _fire_call_sites(src: str, path: str):
+    """[(site_literal | f-string-prefix+"*", line, exact: bool)] for every
+    fire/check/maybe_fire call with a resolvable first argument."""
+    out = []
+    for node in ast.walk(ast.parse(src, filename=path)):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name not in _FIRE_METHODS:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.lineno, True))
+        elif isinstance(arg, ast.JoinedStr) and arg.values and isinstance(
+            arg.values[0], ast.Constant
+        ):
+            out.append((str(arg.values[0].value), node.lineno, False))
+    return out
+
+
+def fault_site_violations(
+    files, faults_defs_path, exclude_prefixes=("tests/",)
+) -> list[Violation]:
+    files = dict(files)
+    out: list[Violation] = []
+    defs_src = files.get(faults_defs_path)
+    if defs_src is None:
+        return [Violation(
+            rule="fault-sites", path=faults_defs_path, line=0,
+            symbol="utils/faults.py",
+            message="fault-site registry file not found in scan set",
+        )]
+    sites, prefixes = fault_site_defs(defs_src, faults_defs_path)
+    if not sites:
+        return [Violation(
+            rule="fault-sites", path=faults_defs_path, line=0,
+            symbol="SITES",
+            message="canonical SITES registry missing or empty",
+        )]
+    used: set[str] = set()
+    used_prefixes: set[str] = set()
+    for display, src in files.items():
+        if display == faults_defs_path or display.startswith(
+            tuple(exclude_prefixes)
+        ):
+            continue
+        for site, line, exact in _fire_call_sites(src, display):
+            if exact:
+                if site in sites:
+                    used.add(site)
+                elif any(site.startswith(p) for p in prefixes):
+                    used_prefixes.update(
+                        p for p in prefixes if site.startswith(p)
+                    )
+                else:
+                    out.append(Violation(
+                        rule="fault-sites", path=display, line=line,
+                        symbol=site,
+                        message=(
+                            f"fault site {site!r} fired but not in the "
+                            f"canonical SITES registry"
+                        ),
+                    ))
+            else:
+                if any(site.startswith(p) or p.startswith(site)
+                       for p in prefixes):
+                    used_prefixes.update(
+                        p for p in prefixes
+                        if site.startswith(p) or p.startswith(site)
+                    )
+                else:
+                    out.append(Violation(
+                        rule="fault-sites", path=display, line=line,
+                        symbol=site + "*",
+                        message=(
+                            f"dynamic fault site prefix {site!r} does not "
+                            f"match any registered SITE_PREFIXES entry"
+                        ),
+                    ))
+    for site, line in sorted(sites.items()):
+        if site not in used:
+            out.append(Violation(
+                rule="fault-sites", path=faults_defs_path, line=line,
+                symbol=site,
+                message=f"registered fault site {site!r} is never fired",
+            ))
+    for p in prefixes:
+        if p not in used_prefixes:
+            out.append(Violation(
+                rule="fault-sites", path=faults_defs_path, line=0,
+                symbol=p + "*",
+                message=f"registered site prefix {p!r} is never fired",
+            ))
+    return out
+
+
+# -- chaos specs ---------------------------------------------------------
+
+
+def _default_spec_validator(spec: str):
+    """Validate against the real arm_from_spec grammar on a scratch
+    injector.  Returns an error string or None."""
+    from lighthouse_tpu.utils.faults import FaultInjector
+
+    try:
+        FaultInjector().arm_from_spec(spec)
+    except Exception as exc:
+        return str(exc)
+    return None
+
+
+def chaos_spec_violations(
+    docs, known_sites, site_prefixes=(), spec_validator=None
+) -> list[Violation]:
+    validator = spec_validator or _default_spec_validator
+    out = []
+    for display, text in docs:
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for raw in _DOC_SPEC.findall(line):
+                if "<" in raw or "[" in raw:
+                    continue  # usage template, not a concrete example
+                err = validator(raw)
+                if err is not None:
+                    out.append(Violation(
+                        rule="chaos-spec", path=display, line=lineno,
+                        symbol=raw,
+                        message=f"--chaos example does not parse under "
+                                f"arm_from_spec: {err}",
+                    ))
+                    continue
+                for part in raw.split(","):
+                    site = part.split("=", 1)[0]
+                    if site in known_sites or any(
+                        site.startswith(p) for p in site_prefixes
+                    ):
+                        continue
+                    out.append(Violation(
+                        rule="chaos-spec", path=display, line=lineno,
+                        symbol=site,
+                        message=(
+                            f"--chaos example targets unregistered "
+                            f"site {site!r}"
+                        ),
+                    ))
+    return out
+
+
+def run(
+    files, docs, metrics_defs_path, faults_defs_path,
+    site_scan_exclude=("tests/",), spec_validator=None,
+) -> list[Violation]:
+    files = dict(files)
+    out = metrics_violations(files, metrics_defs_path, docs)
+    out.extend(
+        fault_site_violations(files, faults_defs_path, site_scan_exclude)
+    )
+    defs_src = files.get(faults_defs_path)
+    if defs_src is not None:
+        sites, prefixes = fault_site_defs(defs_src, faults_defs_path)
+        out.extend(chaos_spec_violations(
+            docs, set(sites), prefixes, spec_validator
+        ))
+    return out

@@ -1,0 +1,22 @@
+"""Shared finding type for the static invariant analyzer."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, asdict
+
+
+@dataclass
+class Violation:
+    rule: str      # lint family: lock-discipline | lock-order | never-raise
+    #                | broad-except | metrics-registry | fault-sites
+    #                | chaos-spec | jaxpr-hygiene
+    path: str      # repo-relative posix path
+    line: int
+    symbol: str    # Class.attr, Class.method, metric/site name, …
+    message: str
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    def __str__(self) -> str:  # human-readable one-liner for CLI output
+        return f"{self.path}:{self.line}: [{self.rule}] {self.symbol}: {self.message}"

@@ -1,0 +1,152 @@
+"""Waiver file handling for the static invariant analyzer.
+
+A waiver is a justified exception to a lint finding: the audit still
+computes the violation, but a matching waiver moves it from the failing
+``violations`` list to the reported-but-passing ``waived`` list.  Every
+waiver MUST carry a one-line ``reason`` — an unexplained waiver is itself
+a violation (the waiver file is part of the reviewed surface).
+
+Format (``analysis/waivers.toml``)::
+
+    [[waiver]]
+    rule = "lock-discipline"
+    path = "lighthouse_tpu/beacon/processor.py"
+    symbol = "BeaconProcessor.*"
+    reason = "single-threaded dispatch core by documented contract"
+
+``rule``, ``path`` and ``symbol`` are fnmatch patterns; ``symbol`` may be
+omitted (matches any).  The image's Python is 3.10 (no stdlib tomllib),
+so this module carries a deliberately tiny TOML-subset parser: tables
+(``[name]``), arrays of tables (``[[name]]``), and ``key = value`` where
+value is a quoted string, an array of quoted strings, an integer, or a
+bare boolean.  That subset is all the analyzer's config/waiver files use.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from fnmatch import fnmatchcase
+
+_KEY_RE = re.compile(r'^\s*(?:"([^"]+)"|([A-Za-z0-9_.-]+))\s*=\s*(.+?)\s*$')
+_STR_RE = re.compile(r'^"((?:[^"\\]|\\.)*)"$')
+
+
+class WaiverFormatError(ValueError):
+    """The waiver/config file does not parse under the supported subset."""
+
+
+def _parse_value(raw: str, path: str, lineno: int):
+    raw = raw.strip()
+    m = _STR_RE.match(raw)
+    if m:
+        return m.group(1).replace('\\"', '"').replace("\\\\", "\\")
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip()
+        if not inner:
+            return []
+        out = []
+        # split on commas outside quotes
+        for part in re.findall(r'"(?:[^"\\]|\\.)*"|[^,]+', inner):
+            part = part.strip()
+            if not part:
+                continue
+            out.append(_parse_value(part, path, lineno))
+        return out
+    if raw in ("true", "false"):
+        return raw == "true"
+    if re.fullmatch(r"-?\d+", raw):
+        return int(raw)
+    raise WaiverFormatError(
+        f"{path}:{lineno}: unsupported TOML value {raw!r} "
+        "(supported: quoted string, string array, integer, boolean)"
+    )
+
+
+def parse_toml_subset(text: str, path: str = "<toml>") -> dict:
+    """Parse the supported TOML subset into nested dicts / lists-of-dicts."""
+    root: dict = {}
+    current: dict = root
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped.startswith("[["):
+            if not stripped.endswith("]]"):
+                raise WaiverFormatError(f"{path}:{lineno}: bad table array")
+            name = stripped[2:-2].strip()
+            current = {}
+            root.setdefault(name, []).append(current)
+            continue
+        if stripped.startswith("["):
+            if not stripped.endswith("]"):
+                raise WaiverFormatError(f"{path}:{lineno}: bad table header")
+            name = stripped[1:-1].strip()
+            current = root.setdefault(name, {})
+            if not isinstance(current, dict):
+                raise WaiverFormatError(
+                    f"{path}:{lineno}: table {name!r} conflicts with an array"
+                )
+            continue
+        m = _KEY_RE.match(stripped)
+        if m is None:
+            raise WaiverFormatError(f"{path}:{lineno}: unparsable line {stripped!r}")
+        key = m.group(1) or m.group(2)
+        current[key] = _parse_value(m.group(3), path, lineno)
+    return root
+
+
+@dataclass
+class Waiver:
+    rule: str
+    path: str
+    reason: str
+    symbol: str = "*"
+    used: int = field(default=0, compare=False)
+
+    def matches(self, rule: str, path: str, symbol: str) -> bool:
+        return (
+            fnmatchcase(rule, self.rule)
+            and fnmatchcase(path, self.path)
+            and fnmatchcase(symbol or "", self.symbol)
+        )
+
+
+def load_waivers(path: str) -> list[Waiver]:
+    """Load ``waivers.toml``; a waiver missing rule/path/reason is rejected
+    loudly (a silent bad waiver would silently un-waive on edit)."""
+    with open(path, encoding="utf-8") as f:
+        doc = parse_toml_subset(f.read(), path)
+    out = []
+    for i, entry in enumerate(doc.get("waiver", [])):
+        missing = [k for k in ("rule", "path", "reason") if not entry.get(k)]
+        if missing:
+            raise WaiverFormatError(
+                f"{path}: waiver #{i + 1} missing required key(s): {missing}"
+            )
+        out.append(
+            Waiver(
+                rule=entry["rule"],
+                path=entry["path"],
+                reason=entry["reason"],
+                symbol=entry.get("symbol", "*"),
+            )
+        )
+    return out
+
+
+def apply_waivers(violations: list, waivers: list[Waiver]):
+    """Split violations into (failing, waived-with-reason)."""
+    failing, waived = [], []
+    for v in violations:
+        hit = None
+        for w in waivers:
+            if w.matches(v.rule, v.path, v.symbol):
+                hit = w
+                break
+        if hit is None:
+            failing.append(v)
+        else:
+            hit.used += 1
+            waived.append((v, hit.reason))
+    return failing, waived

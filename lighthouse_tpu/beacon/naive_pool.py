@@ -101,4 +101,5 @@ class NaiveAggregationPool:
             }
 
     def __len__(self) -> int:
-        return len(self._groups)
+        with self._lock:
+            return len(self._groups)

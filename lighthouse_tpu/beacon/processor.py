@@ -18,11 +18,16 @@ Twin of beacon_node/beacon_processor/src/lib.rs — manager + bounded queues
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum, auto
 from typing import Any, Callable
+
+from ..utils.logging import get_logger
+
+log = get_logger("processor")
 
 
 class WorkKind(Enum):
@@ -181,23 +186,30 @@ class BeaconProcessor:
         return self.breaker is not None and not self.breaker.is_closed
 
     def try_send(self, ev: WorkEvent) -> bool:
-        if self.injector.check("processor.enqueue"):
-            # injected queue overflow: the bound is "reached" regardless
-            # of actual occupancy — same drop accounting as a real one
-            self.queues[ev.kind].dropped += 1
-            self.journal.append(("dropped", ev.kind.name))
-            return False
-        if self.degraded and ev.kind in DEGRADED_SHED_KINDS:
-            from ..utils.metrics import PROCESSOR_SHED
+        try:
+            if self.injector.check("processor.enqueue"):
+                # injected queue overflow: the bound is "reached" regardless
+                # of actual occupancy — same drop accounting as a real one
+                self.queues[ev.kind].dropped += 1
+                self.journal.append(("dropped", ev.kind.name))
+                return False
+            if self.degraded and ev.kind in DEGRADED_SHED_KINDS:
+                from ..utils.metrics import PROCESSOR_SHED
 
-            PROCESSOR_SHED.inc(labels=(ev.kind.name,))
-            self.shed += 1
-            self.journal.append(("shed", ev.kind.name))
+                PROCESSOR_SHED.inc(labels=(ev.kind.name,))
+                self.shed += 1
+                self.journal.append(("shed", ev.kind.name))
+                return False
+            ok = self.queues[ev.kind].push(ev)
+            if not ok:
+                self.journal.append(("dropped", ev.kind.name))
+            return ok
+        except Exception as exc:  # noqa: BLE001 — ingress never raises
+            # Gossip/RPC callers treat False as "queue full"; an internal
+            # error must degrade to a drop, never propagate upward.
+            log.error("processor: try_send backstop caught %s: %s",
+                      type(exc).__name__, exc)
             return False
-        ok = self.queues[ev.kind].push(ev)
-        if not ok:
-            self.journal.append(("dropped", ev.kind.name))
-        return ok
 
     def dispatch_once(self) -> bool:
         """Pop the highest-priority available work (batch-assembled for
@@ -302,6 +314,11 @@ class CircuitBreaker:
         self.backoff_factor = backoff_factor
         self.max_backoff = max_backoff
         self.now = now
+        # One breaker is shared by every thread that verifies (the sync
+        # tick driver, gossip handler threads, pipeline workers); the
+        # check-then-transition sequences below are not atomic without it.
+        # Reentrant: record_failure → _open → _transition compose.
+        self._lock = threading.RLock()
         self.state = BreakerState.CLOSED
         self.consecutive_failures = 0
         self.trips = 0
@@ -309,9 +326,10 @@ class CircuitBreaker:
         self._opened_at: float | None = None
 
     def _transition(self, state: "BreakerState") -> None:
-        if state is self.state:
-            return
-        self.state = state
+        with self._lock:
+            if state is self.state:
+                return
+            self.state = state
         from ..utils.metrics import BREAKER_TRANSITIONS
 
         BREAKER_TRANSITIONS.inc(labels=(state.name,))
@@ -324,39 +342,43 @@ class CircuitBreaker:
         """May the next batch touch the device?  True while CLOSED; while
         OPEN, True exactly once per elapsed backoff window (the probe),
         flipping the breaker to HALF_OPEN."""
-        if self.state is BreakerState.CLOSED:
-            return True
-        if self.state is BreakerState.HALF_OPEN:
-            return False  # a probe is already in flight
-        if self._opened_at is not None and (
-            self.now() - self._opened_at >= self._backoff
-        ):
-            self._transition(BreakerState.HALF_OPEN)
-            return True
-        return False
+        with self._lock:
+            if self.state is BreakerState.CLOSED:
+                return True
+            if self.state is BreakerState.HALF_OPEN:
+                return False  # a probe is already in flight
+            if self._opened_at is not None and (
+                self.now() - self._opened_at >= self._backoff
+            ):
+                self._transition(BreakerState.HALF_OPEN)
+                return True
+            return False
 
     def record_success(self) -> None:
-        self.consecutive_failures = 0
-        self._backoff = self.reset_timeout
-        self._opened_at = None
-        self._transition(BreakerState.CLOSED)
+        with self._lock:
+            self.consecutive_failures = 0
+            self._backoff = self.reset_timeout
+            self._opened_at = None
+            self._transition(BreakerState.CLOSED)
 
     def record_failure(self) -> None:
-        self.consecutive_failures += 1
-        if self.state is BreakerState.HALF_OPEN:
-            # failed probe: back to OPEN with a longer wait
-            self._backoff = min(
-                self._backoff * self.backoff_factor, self.max_backoff
-            )
-            self._open()
-        elif (self.state is BreakerState.CLOSED
-              and self.consecutive_failures >= self.failure_threshold):
-            self.trips += 1
-            self._open()
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state is BreakerState.HALF_OPEN:
+                # failed probe: back to OPEN with a longer wait
+                self._backoff = min(
+                    self._backoff * self.backoff_factor, self.max_backoff
+                )
+                self._open()
+            elif (self.state is BreakerState.CLOSED
+                  and self.consecutive_failures >= self.failure_threshold):
+                self.trips += 1
+                self._open()
 
     def _open(self) -> None:
-        self._opened_at = self.now()
-        self._transition(BreakerState.OPEN)
+        with self._lock:
+            self._opened_at = self.now()
+            self._transition(BreakerState.OPEN)
 
 
 @dataclass
@@ -422,12 +444,22 @@ class ResilientVerifier:
         sets = list(sets)
         if not sets:
             return BatchOutcome(verdicts=[], device_calls=0)
-        budget = RetryBudget(
-            attempts=self.max_device_attempts,
-            deadline=self.now() + self.retry_deadline,
-        )
-        verdicts = self._device_or_cpu(sets, budget)
-        return BatchOutcome(verdicts=verdicts, device_calls=0)
+        try:
+            budget = RetryBudget(
+                attempts=self.max_device_attempts,
+                deadline=self.now() + self.retry_deadline,
+            )
+            verdicts = self._device_or_cpu(sets, budget)
+            return BatchOutcome(verdicts=verdicts, device_calls=0)
+        except Exception as exc:  # noqa: BLE001 — never-raise backstop
+            # The ladder already absorbs device faults; this catches a bug
+            # in the ladder itself (or a CPU-oracle crash).  Fail closed:
+            # every set gets a False verdict — a dropped batch would
+            # silently skip verification, a raised exception would take
+            # the caller down with it.
+            log.error("verify_batch backstop caught %s: %s",
+                      type(exc).__name__, exc)
+            return BatchOutcome(verdicts=[False] * len(sets), device_calls=0)
 
     # -- internals ---------------------------------------------------------
 

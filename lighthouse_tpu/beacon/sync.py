@@ -392,6 +392,11 @@ class SyncManager:
                     if self.pending and self.pending[0] is batch:
                         self.pending.pop(0)
                 M.SYNC_BATCHES_IMPORTED.inc()
+        except Exception as exc:  # noqa: BLE001 — the never-raise backstop
+            # Everything expected is classified above; this is the lexical
+            # proof obligation for the "tick never raises" contract.
+            log.error("sync: tick backstop caught %s: %s",
+                      type(exc).__name__, exc)
         finally:
             self._tick_lock.release()
         return self.state
@@ -434,7 +439,7 @@ class SyncManager:
             try:
                 chunks = peer.request_blocks(batch.start_slot, batch.count)
                 box["chunks"] = self.injector.fire("sync.request", chunks)
-            except BaseException as exc:  # noqa: BLE001 — isolated below
+            except Exception as exc:  # noqa: BLE001 — isolated below
                 box["error"] = exc
 
         t = threading.Thread(target=run, name="sync-request", daemon=True)
@@ -559,9 +564,13 @@ class SyncManager:
             self.peer_manager.on_behaviour_penalty(peer.peer_id, amount, reason)
 
     def _stall(self, why: str) -> None:
-        self.state = SyncState.STALLED
+        # state/pending are _lock-guarded; _stall is called off the tick
+        # thread while add_peer may be re-arming from a connection thread.
+        with self._lock:
+            self.state = SyncState.STALLED
+            n_pending = len(self.pending)
         M.SYNC_STALLS.inc()
-        log.warning("sync stalled: %s (pending=%d)", why, len(self.pending))
+        log.warning("sync stalled: %s (pending=%d)", why, n_pending)
 
 
 class BackfillSync:

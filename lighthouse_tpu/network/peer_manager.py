@@ -22,6 +22,7 @@ The mesh consumes scores through ``accept_graft`` / ``graft_candidates`` /
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -98,128 +99,149 @@ class PeerManager:
     def __init__(self, ban_duration: float = 60.0):
         self.peers: dict[str, PeerRecord] = {}
         self.ban_duration = ban_duration
+        # Reentrant: the SyncManager tick thread, connection handler
+        # threads, and the heartbeat decay all mutate the same records,
+        # and public methods compose (_rec → _prune_db, report →
+        # _maybe_ban) while holding it.
+        self._lock = threading.RLock()
         self._last_decay = time.monotonic()
 
     # -- db ----------------------------------------------------------------
 
     def _rec(self, peer_id: str) -> PeerRecord:
-        rec = self.peers.get(peer_id)
-        if rec is None:
-            if len(self.peers) > MAX_DB_SIZE:
-                self._prune_db()
-            rec = PeerRecord()
-            self.peers[peer_id] = rec
-        return rec
+        with self._lock:
+            rec = self.peers.get(peer_id)
+            if rec is None:
+                if len(self.peers) > MAX_DB_SIZE:
+                    self._prune_db()
+                rec = PeerRecord()
+                self.peers[peer_id] = rec
+            return rec
 
     def _prune_db(self) -> None:
         """Drop the oldest disconnected, non-banned records (peerdb.rs
         prune: banned peers are retained so bans stick)."""
-        removable = sorted(
-            (
-                (rec.last_seen, pid)
-                for pid, rec in self.peers.items()
-                if not rec.connected and not rec.banned
-            ),
-        )
-        for _, pid in removable[: max(len(self.peers) - MAX_DB_SIZE, 16)]:
-            del self.peers[pid]
+        with self._lock:
+            removable = sorted(
+                (
+                    (rec.last_seen, pid)
+                    for pid, rec in self.peers.items()
+                    if not rec.connected and not rec.banned
+                ),
+            )
+            for _, pid in removable[: max(len(self.peers) - MAX_DB_SIZE, 16)]:
+                del self.peers[pid]
 
     # -- lifecycle ---------------------------------------------------------
 
     def connect(self, peer_id: str) -> None:
-        rec = self._rec(peer_id)
-        if self.is_banned(peer_id):
-            raise PermissionError(f"peer {peer_id} is banned")
-        rec.connected = True
-        rec.last_seen = time.monotonic()
+        with self._lock:
+            rec = self._rec(peer_id)
+            if self.is_banned(peer_id):
+                raise PermissionError(f"peer {peer_id} is banned")
+            rec.connected = True
+            rec.last_seen = time.monotonic()
 
     def disconnect(self, peer_id: str) -> None:
-        rec = self.peers.get(peer_id)
-        if rec is not None:
-            rec.connected = False
-            rec.last_seen = time.monotonic()
+        with self._lock:
+            rec = self.peers.get(peer_id)
+            if rec is not None:
+                rec.connected = False
+                rec.last_seen = time.monotonic()
 
     # -- reputation events -------------------------------------------------
 
     def report(self, peer_id: str, delta: float, reason: str = "") -> None:
         """Legacy manual channel (protocol errors etc.); decays like the
         rest."""
-        rec = self._rec(peer_id)
-        rec.manual_score += delta
-        self._maybe_ban(peer_id, rec)
+        with self._lock:
+            rec = self._rec(peer_id)
+            rec.manual_score += delta
+            self._maybe_ban(peer_id, rec)
 
     def on_first_delivery(self, peer_id: str, topic: str) -> None:
-        rec = self._rec(peer_id)
-        ts = rec.topics.setdefault(topic, TopicScore())
-        ts.first_message_deliveries += 1.0
-        rec.last_seen = time.monotonic()
+        with self._lock:
+            rec = self._rec(peer_id)
+            ts = rec.topics.setdefault(topic, TopicScore())
+            ts.first_message_deliveries += 1.0
+            rec.last_seen = time.monotonic()
 
     def on_invalid_message(self, peer_id: str, topic: str) -> None:
-        rec = self._rec(peer_id)
-        ts = rec.topics.setdefault(topic, TopicScore())
-        ts.invalid_message_deliveries += 1.0
-        self._maybe_ban(peer_id, rec)
+        with self._lock:
+            rec = self._rec(peer_id)
+            ts = rec.topics.setdefault(topic, TopicScore())
+            ts.invalid_message_deliveries += 1.0
+            self._maybe_ban(peer_id, rec)
 
     def on_behaviour_penalty(
         self, peer_id: str, amount: float = 1.0, reason: str = ""
     ) -> None:
-        rec = self._rec(peer_id)
-        rec.behaviour_penalty += amount
-        PEER_PENALTIES.inc(labels=(reason or "unspecified",))
-        self._maybe_ban(peer_id, rec)
+        with self._lock:
+            rec = self._rec(peer_id)
+            rec.behaviour_penalty += amount
+            PEER_PENALTIES.inc(labels=(reason or "unspecified",))
+            self._maybe_ban(peer_id, rec)
 
     def on_goodbye(self, peer_id: str) -> None:
         """Peer said goodbye: count it and mark the record disconnected
         (reputation persists — a goodbye is not a reset)."""
-        rec = self._rec(peer_id)
-        rec.goodbyes += 1
-        rec.connected = False
-        rec.last_seen = time.monotonic()
+        with self._lock:
+            rec = self._rec(peer_id)
+            rec.goodbyes += 1
+            rec.connected = False
+            rec.last_seen = time.monotonic()
 
     def _maybe_ban(self, peer_id: str, rec: PeerRecord) -> None:
-        if rec.score() <= BAN_THRESHOLD and not rec.banned:
-            rec.banned_until = time.monotonic() + self.ban_duration
-            rec.connected = False
-            PEER_BANS.inc()
+        with self._lock:
+            if rec.score() <= BAN_THRESHOLD and not rec.banned:
+                rec.banned_until = time.monotonic() + self.ban_duration
+                rec.connected = False
+                PEER_BANS.inc()
 
     # -- decay -------------------------------------------------------------
 
     def decay(self) -> None:
         """One decay tick over every record; expired bans lift back to a
         greylist-level manual score (reputation is forgiven, slowly)."""
-        now = time.monotonic()
-        for rec in self.peers.values():
-            rec.decay()
-            if rec.banned_until is not None and now >= rec.banned_until:
-                rec.banned_until = None
-                # resume at greylist, not zero: recently-banned stays cold
-                rec.manual_score = min(rec.manual_score, GREYLIST_THRESHOLD)
-                rec.behaviour_penalty = 0.0
-                for ts in rec.topics.values():
-                    ts.invalid_message_deliveries = 0.0
+        with self._lock:
+            now = time.monotonic()
+            for rec in self.peers.values():
+                rec.decay()
+                if rec.banned_until is not None and now >= rec.banned_until:
+                    rec.banned_until = None
+                    # resume at greylist, not zero: recently-banned stays cold
+                    rec.manual_score = min(rec.manual_score,
+                                           GREYLIST_THRESHOLD)
+                    rec.behaviour_penalty = 0.0
+                    for ts in rec.topics.values():
+                        ts.invalid_message_deliveries = 0.0
 
     def maybe_decay(self) -> None:
         """Rate-limited decay for heartbeat call sites."""
-        now = time.monotonic()
-        if now - self._last_decay >= DECAY_INTERVAL:
-            self._last_decay = now
-            self.decay()
+        with self._lock:
+            now = time.monotonic()
+            if now - self._last_decay >= DECAY_INTERVAL:
+                self._last_decay = now
+                self.decay()
 
     # -- queries -----------------------------------------------------------
 
     def score(self, peer_id: str) -> float:
-        rec = self.peers.get(peer_id)
-        return rec.score() if rec is not None else 0.0
+        with self._lock:
+            rec = self.peers.get(peer_id)
+            return rec.score() if rec is not None else 0.0
 
     def is_banned(self, peer_id: str) -> bool:
-        rec = self.peers.get(peer_id)
-        return rec is not None and rec.banned
+        with self._lock:
+            rec = self.peers.get(peer_id)
+            return rec is not None and rec.banned
 
     def greylisted(self, peer_id: str) -> bool:
         return self.score(peer_id) <= GREYLIST_THRESHOLD
 
     def connected_peers(self) -> list[str]:
-        return [p for p, r in self.peers.items() if r.connected]
+        with self._lock:
+            return [p for p, r in self.peers.items() if r.connected]
 
     # -- mesh integration (scoring → GRAFT/PRUNE) --------------------------
 

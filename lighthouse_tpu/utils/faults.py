@@ -103,6 +103,25 @@ _KINDS = ("error", "slow", "corrupt", "overflow", "crash", "io-error",
           "torn-write", "drop", "stall", "corrupt-chunk", "wrong-blocks",
           "extra-blocks")
 
+# Canonical site registry.  Every literal site string fired anywhere in
+# the package must appear here (the static audit's fault-sites family
+# cross-references both directions); dynamic per-task sites are covered
+# by SITE_PREFIXES.  Keep the docstring table above in sync.
+SITES = {
+    "bls.device_verify": "jax backend batch entry (backend.py)",
+    "processor.enqueue": "BeaconProcessor.try_send queue admission",
+    "processor.verify": "ResilientVerifier / PipelinedVerifier device call",
+    "store.open": "SlabStore open",
+    "store.put": "SlabStore append",
+    "store.flush": "SlabStore fsync durability point",
+    "sync.request": "SyncManager client side, decoded chunk list",
+    "rpc.respond": "BeaconNode server side, encoded chunk list",
+}
+
+SITE_PREFIXES = (
+    "executor.task.",  # one dynamic site per supervised task (re)start
+)
+
 
 # -- default mutators for the byzantine chunk-list kinds ---------------------
 # Both req/resp sites carry a list of chunks: encoded ``bytes`` on the server
@@ -279,6 +298,18 @@ class FaultInjector:
             raise f.exc()
         return payload  # "overflow" is a check()-site kind; fire is a no-op
 
+    def maybe_fire(self, site: str, payload: Any = None) -> Any:
+        """Never-raise variant of :meth:`fire` for observability-grade
+        sites on never-raise paths (``tick``/``try_send``-style callers
+        that would immediately swallow an injected exception anyway).
+        Mutation and delay kinds still apply; raising kinds are absorbed
+        and the untouched payload returned — the injection is still
+        counted in ``faults_injected_total``."""
+        try:
+            return self.fire(site, payload)
+        except Exception:
+            return payload
+
     def check(self, site: str) -> bool:
         """Non-raising peek for saturation-style sites: True when an
         ``overflow`` fault fires at ``site`` (the site should then behave
@@ -297,4 +328,5 @@ INJECTOR = FaultInjector()
 arm = INJECTOR.arm
 disarm = INJECTOR.disarm
 fire = INJECTOR.fire
+maybe_fire = INJECTOR.maybe_fire
 arm_from_spec = INJECTOR.arm_from_spec

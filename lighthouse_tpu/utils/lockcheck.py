@@ -1,0 +1,181 @@
+"""Runtime lock-order sanitizer (opt-in, env-gated).
+
+The static race detector (``analysis/lock_lint.py``) derives a lock-order
+graph lexically; this module validates that graph against reality.  A
+``CheckedLock`` wraps any ``threading`` lock with a stable node name
+(``"SyncManager._lock"``) and records, per thread, the stack of names
+currently held — every acquisition while another named lock is held adds
+an observed edge.  After a chaos soak, ``LockOrderRecorder.verify()``
+asserts the observed edges are a subset of the static graph and acyclic:
+an edge the static analyzer never derived means the lexical model missed
+a real acquisition path.
+
+Opt-in: wrapping costs a dict op per acquire, so production code paths
+only get instrumented when ``LIGHTHOUSE_TPU_LOCKCHECK=1`` (or when a
+test passes ``force=True``).  Typical use::
+
+    rec = LockOrderRecorder()
+    instrument(mgr, {"_tick_lock": "SyncManager._tick_lock",
+                     "_lock": "SyncManager._lock",
+                     "_chain_lock": "SyncManager._chain_lock"}, rec,
+               force=True)
+    ... run the soak ...
+    rec.verify(static_edges)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+ENV_FLAG = "LIGHTHOUSE_TPU_LOCKCHECK"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") == "1"
+
+
+class LockOrderRecorder:
+    """Thread-safe collector of observed (outer, inner) acquisition pairs."""
+
+    def __init__(self):
+        self._local = threading.local()
+        self._edges_lock = threading.Lock()
+        self._edges: dict[tuple[str, str], int] = {}
+        self._acquisitions = 0
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def on_acquire(self, name: str, reentrant: bool):
+        st = self._stack()
+        if reentrant and name in st:
+            st.append(name)  # re-entry: no new edges
+            return
+        new_edges = [(held, name) for held in dict.fromkeys(st)]
+        st.append(name)
+        with self._edges_lock:
+            self._acquisitions += 1
+            for e in new_edges:
+                self._edges[e] = self._edges.get(e, 0) + 1
+
+    def on_release(self, name: str):
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                return
+
+    @property
+    def acquisitions(self) -> int:
+        with self._edges_lock:
+            return self._acquisitions
+
+    def edges(self) -> set:
+        with self._edges_lock:
+            return set(self._edges)
+
+    def verify(self, static_edges) -> None:
+        """Assert observed order ⊆ static graph, and observed acyclic."""
+        static_edges = set(static_edges)
+        observed = self.edges()
+        unknown = sorted(observed - static_edges)
+        if unknown:
+            raise AssertionError(
+                "lockcheck: runtime acquisition order not in the static "
+                f"lock-order graph: {unknown} (static analyzer missed an "
+                f"acquisition path — fix the model or the code)"
+            )
+        cyc = _find_cycle(observed)
+        if cyc:
+            raise AssertionError(
+                f"lockcheck: observed lock-order cycle {' -> '.join(cyc)}"
+            )
+
+
+def _find_cycle(edges) -> list:
+    graph: dict[str, list[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+
+    def dfs(node, path):
+        color[node] = GREY
+        path.append(node)
+        for nxt in graph.get(node, ()):
+            if color.get(nxt, WHITE) == GREY:
+                return path[path.index(nxt):] + [nxt]
+            if color.get(nxt, WHITE) == WHITE:
+                found = dfs(nxt, path)
+                if found:
+                    return found
+        path.pop()
+        color[node] = BLACK
+        return None
+
+    for node in sorted(graph):
+        if color[node] == WHITE:
+            found = dfs(node, [])
+            if found:
+                return found
+    return []
+
+
+class CheckedLock:
+    """Transparent named wrapper around a threading lock/RLock/Condition."""
+
+    def __init__(self, inner, name: str, recorder: LockOrderRecorder,
+                 reentrant: bool | None = None):
+        self._inner = inner
+        self._name = name
+        self._recorder = recorder
+        if reentrant is None:
+            reentrant = "RLock" in type(inner).__name__ or hasattr(
+                inner, "_is_owned"
+            )
+        self._reentrant = reentrant
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._recorder.on_acquire(self._name, self._reentrant)
+        return got
+
+    def release(self):
+        self._recorder.on_release(self._name)
+        return self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, attr):  # Condition.wait/notify, RLock internals
+        return getattr(self._inner, attr)
+
+
+def instrument(obj, attr_names: dict, recorder: LockOrderRecorder | None,
+               force: bool = False):
+    """Replace ``obj.<attr>`` locks with CheckedLocks named per
+    ``attr_names`` (attr -> graph node name).  No-op unless the env flag
+    is set or ``force`` is given.  Returns the recorder (or None when
+    disabled)."""
+    if not (force or enabled()):
+        return None
+    rec = recorder or LockOrderRecorder()
+    for attr, name in attr_names.items():
+        inner = getattr(obj, attr)
+        if isinstance(inner, CheckedLock):
+            continue
+        setattr(obj, attr, CheckedLock(inner, name, rec))
+    return rec

@@ -1,0 +1,4 @@
+# Seeded-violation corpus for tests/test_static_analysis.py.  Every file
+# here deliberately violates one lint family; the live audit excludes
+# this directory (AuditConfig.exclude) and lint.toml re-points the
+# registries so the identical pipeline runs against the corpus.

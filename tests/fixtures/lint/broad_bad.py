@@ -1,0 +1,29 @@
+"""Broad-except seeds: a bare ``except:`` (shape 1) and an
+``except BaseException`` swallow (shape 2).  A cleanup-then-propagate
+handler rides along to prove the re-raise exemption holds."""
+
+
+def _work():
+    return 1
+
+
+def swallow_everything():
+    try:
+        return _work()
+    except:  # noqa: E722  SEED: bare except without re-raise
+        return None
+
+
+def swallow_base():
+    try:
+        return _work()
+    except BaseException:  # SEED: BaseException without re-raise
+        return None
+
+
+def cleanup_then_propagate(conn):
+    try:
+        return _work()
+    except BaseException:  # legitimate: re-raises after cleanup
+        conn.rollback()
+        raise

@@ -1,0 +1,26 @@
+"""Jaxpr-hygiene seeds: host-syncing calls inside registered hot-path
+functions — ``block_until_ready`` (shape 1), ``np.asarray`` (shape 2),
+``float()`` on a non-constant (shape 3).  ``helper`` is NOT registered,
+so its sync call must stay unflagged."""
+
+
+class _np:
+    @staticmethod
+    def asarray(x):
+        return x
+
+
+np = _np()
+
+
+def dispatch(x):
+    x.block_until_ready()  # SEED: forced device sync on the hot path
+    return np.asarray(x)  # SEED: device->host copy on the hot path
+
+
+def resolve(x):
+    return float(x.sum())  # SEED: scalarization on the hot path
+
+
+def helper(x):
+    return x.item()  # fine: helper is not in the hot-path registry

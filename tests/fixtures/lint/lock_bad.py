@@ -1,0 +1,44 @@
+"""Lock-discipline seeds: a bare mutation of a convention-guarded attr
+(shape 1) and a bare read of a fully lock-guarded container (shape 2)."""
+
+import threading
+
+
+class BareMutation:
+    """_count is mutated under the lock at 2/3 sites -> guarded by
+    convention; the third, bare mutation must be flagged."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+    def sneak(self):
+        self._count += 1  # SEED: bare mutation of guarded attr
+
+
+class BareContainerRead:
+    """_items is container-mutated only under the lock at >=2 sites;
+    the unlocked len() read must be flagged."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drop_all(self):
+        with self._lock:
+            self._items.clear()
+
+    def size(self):
+        return len(self._items)  # SEED: bare read of locked container

@@ -1,0 +1,43 @@
+"""Lock-order seeds: a direct two-lock deadlock cycle (nested ``with``
+in opposite orders, shape 1) and the same cycle built through one level
+of intra-class call resolution (shape 2)."""
+
+import threading
+
+
+class NestedDeadlock:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:  # SEED: edge a -> b
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:  # SEED: edge b -> a completes the cycle
+                pass
+
+
+class CallDeadlock:
+    def __init__(self):
+        self._x_lock = threading.Lock()
+        self._y_lock = threading.Lock()
+
+    def outer(self):
+        with self._x_lock:
+            self.take_y()  # SEED: call-resolved edge x -> y
+
+    def take_y(self):
+        with self._y_lock:
+            pass
+
+    def rev_outer(self):
+        with self._y_lock:
+            self.take_x()  # SEED: call-resolved edge y -> x
+
+    def take_x(self):
+        with self._x_lock:
+            pass

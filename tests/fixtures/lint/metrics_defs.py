@@ -1,0 +1,17 @@
+"""Fixture metrics registry (stands in for utils/metrics.py).
+
+``FIXTURE_ORPHAN`` is registered but never referenced anywhere in the
+corpus — the orphaned-registration seed lives in this file itself."""
+
+
+class Counter:
+    def __init__(self, name, desc=""):
+        self.name = name
+        self.desc = desc
+
+    def inc(self, n=1):
+        pass
+
+
+FIXTURE_GOOD = Counter("fixture_good_total", "referenced by metrics_user")
+FIXTURE_ORPHAN = Counter("fixture_orphan_total", "SEED: never referenced")

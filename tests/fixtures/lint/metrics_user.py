@@ -1,0 +1,8 @@
+"""Metrics-registry seed: references an unregistered metric name."""
+
+from . import metrics_defs as M
+
+
+def record():
+    M.FIXTURE_GOOD.inc()
+    M.FIXTURE_GHOST.inc()  # SEED: not registered in metrics_defs.py

@@ -1,0 +1,22 @@
+"""Never-raise seeds: an unprotected raising statement (shape 1) and a
+covering try whose handler re-raises (shape 2).  Both functions are in
+the fixture registry (lint.toml [audit] never_raise)."""
+
+
+class Shaky:
+    def run(self, items):
+        total = len(items)
+        payload = items[0]  # SEED: Subscript outside any try can raise
+        return payload, total
+
+
+class Relay:
+    def __init__(self):
+        self.q = []
+
+    def send(self, msg):
+        try:
+            self.q.append(msg)
+            return True
+        except Exception:
+            raise  # SEED: handler re-raises -> try does not cover

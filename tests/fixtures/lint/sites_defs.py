@@ -1,0 +1,11 @@
+"""Fixture fault-site registry (stands in for utils/faults.py SITES).
+
+``fixture.orphan`` is registered but never fired (seed), and the
+``fixture.dyn.`` prefix is likewise registered-but-unfired (seed)."""
+
+SITES = {
+    "fixture.good": "fired by sites_user.py",
+    "fixture.orphan": "SEED: registered but never fired",
+}
+
+SITE_PREFIXES = ("fixture.dyn.",)

@@ -1,0 +1,14 @@
+"""Fault-site seed: fires a site missing from the canonical registry."""
+
+
+class _Injector:
+    def fire(self, site, payload=None):
+        return payload
+
+
+INJ = _Injector()
+
+
+def go(payload):
+    payload = INJ.fire("fixture.good", payload)
+    return INJ.fire("fixture.bogus", payload)  # SEED: unregistered site

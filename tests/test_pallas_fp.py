@@ -119,40 +119,11 @@ def test_flag_routes_mont_mul():
 # ever emitted.
 
 
-def _iter_sub_jaxprs(val):
-    core = jax.core
-    if isinstance(val, core.ClosedJaxpr):
-        yield val.jaxpr
-    elif isinstance(val, core.Jaxpr):
-        yield val
-    elif isinstance(val, (list, tuple)):
-        for item in val:
-            yield from _iter_sub_jaxprs(item)
-
-
-def _collect_zero_dim_avals(jaxpr, seen, bad):
-    if id(jaxpr) in seen:
-        return
-    seen.add(id(jaxpr))
-    for eqn in jaxpr.eqns:
-        for v in list(eqn.invars) + list(eqn.outvars):
-            aval = getattr(v, "aval", None)
-            shape = getattr(aval, "shape", None)
-            if shape and 0 in shape:
-                bad.append(f"{eqn.primitive.name}: {aval}")
-        for val in eqn.params.values():
-            for sub in _iter_sub_jaxprs(val):
-                _collect_zero_dim_avals(sub, seen, bad)
-
-
-def _assert_no_zero_dims(fn, *args):
-    closed = jax.make_jaxpr(fn)(*args)
-    bad: list = []
-    _collect_zero_dim_avals(closed.jaxpr, set(), bad)
-    assert not bad, (
-        "zero-sized vector shapes staged (Mosaic rejects these even "
-        "though interpret mode tolerates them): " + "; ".join(bad[:5])
-    )
+# the guard itself now lives in analysis/jaxpr_lint.py (shared with the
+# static-analysis subsystem); these tests drive it against the kernels
+from lighthouse_tpu.analysis.jaxpr_lint import (  # noqa: E402
+    assert_no_zero_dims as _assert_no_zero_dims,
+)
 
 
 def test_square_and_product_emit_no_zero_sized_vectors():

@@ -80,63 +80,16 @@ def _alarm(_sig, _frm):
 
 
 # ---------------------------------------------------------------------------
-# Jaxpr walk
+# Jaxpr walk — shared with the static analyzer (analysis/jaxpr_lint.py is
+# the single home of the walk, the fingerprints, and the budget default;
+# this tool is the tracing front-end)
 # ---------------------------------------------------------------------------
 
-
-def _iter_jaxprs(obj):
-    """Yield every Jaxpr reachable from a params value (ClosedJaxpr,
-    Jaxpr, or containers thereof)."""
-    import jax.core as jcore
-
-    if isinstance(obj, jcore.ClosedJaxpr):
-        yield obj.jaxpr
-    elif isinstance(obj, jcore.Jaxpr):
-        yield obj
-    elif isinstance(obj, (list, tuple)):
-        for item in obj:
-            yield from _iter_jaxprs(item)
-
-
-def _fingerprint(eqn):
-    """Identity of one staged Pallas program: kernel name + source line
-    (``name_and_src_info`` reprs as ``_mont_kernel at .../pallas_fp.py:135``),
-    operand avals, grid.  Two eqns with equal fingerprints lower to one
-    Mosaic program (the compile cache keys on the same data)."""
-    params = eqn.params
-    nsi = str(params.get("name_and_src_info", params.get("name", "?")))
-    gm = params.get("grid_mapping")
-    grid = tuple(getattr(gm, "grid", ()) or ())
-    avals = tuple(str(v.aval) for v in eqn.invars)
-    return (nsi, grid, avals)
-
-
-def _walk(jaxpr, seen_jaxprs, programs, counts):
-    if id(jaxpr) in seen_jaxprs:
-        return
-    seen_jaxprs.add(id(jaxpr))
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "pallas_call":
-            fp = _fingerprint(eqn)
-            programs.setdefault(fp, 0)
-            programs[fp] += 1
-            counts[0] += 1
-        for val in eqn.params.values():
-            for sub in _iter_jaxprs(val):
-                _walk(sub, seen_jaxprs, programs, counts)
-
-
-def audit_jaxpr(closed):
-    programs: dict[tuple, int] = {}
-    counts = [0]
-    _walk(closed.jaxpr, set(), programs, counts)
-    return programs, counts[0]
-
-
-def _is_chain_program(fp) -> bool:
-    """Chain programs are the megachain kernels (pallas_fp.py); the
-    budget bounds how many DISTINCT ones a composition stages."""
-    return "megachain_kernel" in fp[0]
+from lighthouse_tpu.analysis.jaxpr_lint import (  # noqa: E402
+    DEFAULT_CHAIN_BUDGET,
+    audit_jaxpr,
+    is_chain_program as _is_chain_program,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -225,7 +178,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--sets", type=int, default=2,
                     help="synthetic signature sets per batch (padded to 8)")
-    ap.add_argument("--budget", type=int, default=6,
+    ap.add_argument("--budget", type=int, default=DEFAULT_CHAIN_BUDGET,
                     help="max distinct chain programs per composition")
     ap.add_argument("--timeout", type=int, default=900,
                     help="per-config trace watchdog seconds")

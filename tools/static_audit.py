@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""Repo-wide static invariant audit (lighthouse_tpu.analysis front-end).
+
+Runs the four lint families — lock-discipline + lock-order graph,
+never-raise/broad-except, registry consistency (metrics / fault sites /
+--chaos specs), and jaxpr hygiene (dispatch hot-path host-sync ban) —
+and prints a JSON report.  Exit status is 0 iff every finding is covered
+by a justified waiver in ``analysis/waivers.toml``.
+
+The audit is pure AST + text: no jax import, no tracing, seconds not
+minutes.  The traced device-side checks (program budget, zero-dim guard)
+live in the same package (``analysis/jaxpr_lint.py``) but are driven by
+``tools/dispatch_audit.py`` and the test suite.
+
+Usage:
+    tools/pyrun tools/static_audit.py                 # whole repo
+    tools/pyrun tools/static_audit.py --quiet         # summary line only
+    tools/pyrun tools/static_audit.py --paths tests/fixtures/lint \\
+        --config tests/fixtures/lint/lint.toml        # fixture corpus
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from lighthouse_tpu.analysis import (  # noqa: E402
+    AuditConfig,
+    load_config,
+    load_waivers,
+    run_audit,
+)
+
+DEFAULT_WAIVERS = "lighthouse_tpu/analysis/waivers.toml"
+
+
+def _record_history(result, history_path):
+    entry = {
+        "kind": "static_audit",
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "pass": result.ok,
+        "files_scanned": result.files_scanned,
+        "violations": len(result.violations),
+        "waived": len(result.waived),
+        "summary": result.summary(),
+        "elapsed_s": round(result.elapsed_s, 3),
+    }
+    try:
+        with open(history_path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=ROOT,
+                    help="audit root (default: the repo)")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="override scan roots (files/dirs relative to "
+                         "--root), e.g. a fixture corpus")
+    ap.add_argument("--config", default=None,
+                    help="audit config TOML (fixture corpora ship their "
+                         "own lint.toml re-pointing the registries)")
+    ap.add_argument("--waivers", default=None,
+                    help=f"waiver file (default: {DEFAULT_WAIVERS} when "
+                         f"auditing the repo, none otherwise)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print only the verdict line, not the report")
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append an audit row to BENCH_HISTORY.jsonl")
+    args = ap.parse_args(argv)
+
+    if args.config is not None:
+        cfg = load_config(args.config)
+    else:
+        cfg = AuditConfig()
+    if args.paths is not None:
+        cfg.scan_roots = tuple(args.paths)
+        # a custom corpus scans everything it contains
+        cfg.lock_scan_include = tuple(
+            p if p.endswith((".py", "/")) else p + "/" for p in args.paths
+        )
+        if args.config is None:
+            cfg.exclude = ()  # explicit paths mean audit them, period
+
+    waivers_path = args.waivers
+    if waivers_path is None and args.config is None and args.paths is None:
+        default = os.path.join(args.root, DEFAULT_WAIVERS)
+        if os.path.exists(default):
+            waivers_path = default
+    waivers = load_waivers(waivers_path) if waivers_path else []
+
+    result = run_audit(args.root, cfg, waivers)
+    report = result.to_dict()
+    if not args.quiet:
+        print(json.dumps(report, indent=2))
+
+    if not args.no_history and args.config is None and args.paths is None:
+        _record_history(result, os.path.join(args.root, "BENCH_HISTORY.jsonl"))
+
+    verdict = "PASS" if result.ok else "FAIL"
+    counts = ", ".join(
+        f"{k}={v}" for k, v in sorted(result.summary().items())
+    ) or "clean"
+    print(
+        f"static_audit: {verdict} ({result.files_scanned} files, "
+        f"{len(result.violations)} violations [{counts}], "
+        f"{len(result.waived)} waived, {result.elapsed_s:.2f}s)",
+        file=sys.stderr if args.quiet else sys.stdout,
+    )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
