@@ -57,6 +57,7 @@ class AuditConfig:
     safe_calls: tuple = ("BatchOutcome",)
     metrics_defs: str = "lighthouse_tpu/utils/metrics.py"
     faults_defs: str = "lighthouse_tpu/utils/faults.py"
+    scenarios_defs: str = "lighthouse_tpu/scenario/spec.py"
     docs: tuple = ("README.md", "STATUS.md")
     hot_path: dict = field(
         default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
@@ -171,6 +172,8 @@ def load_config(path: str) -> AuditConfig:
         cfg.metrics_defs = a["metrics_defs"]
     if "faults_defs" in a:
         cfg.faults_defs = a["faults_defs"]
+    if "scenarios_defs" in a:
+        cfg.scenarios_defs = a["scenarios_defs"]
     if "docs" in a:
         cfg.docs = tuple(a["docs"])
     if "site_scan_exclude" in a:
@@ -242,6 +245,7 @@ def run_audit(
         violations.extend(registry_lint.run(
             files, docs, cfg.metrics_defs, cfg.faults_defs,
             cfg.site_scan_exclude,
+            scenarios_defs_path=cfg.scenarios_defs,
         ))
 
     if "jaxpr" in cfg.families:

@@ -17,6 +17,14 @@ Three cross-reference families, all driven off the canonical registries:
 * **chaos-spec** — every ``--chaos <spec>`` example in README/STATUS
   must parse under the real ``FaultInjector.arm_from_spec`` grammar and
   name a registered site.
+* **scenario-spec** — every ``--scenario <name>`` example in the docs
+  must name a key of the ``SCENARIOS`` registry (scenario/spec.py),
+  exactly the way chaos specs are validated; ``:key=val`` overrides are
+  stripped first.  The registry is AST-parsed, never imported, so it
+  must stay a literal dict.
+
+The docs cross-check covers ``*_total`` and ``*_seconds`` metric tokens
+(counters and histograms both).
 """
 
 from __future__ import annotations
@@ -30,8 +38,9 @@ from .report import Violation
 _METRIC_FACTORIES = {"Counter", "Gauge", "Histogram"}
 _FIRE_METHODS = {"fire", "check", "maybe_fire"}
 _UPPER = re.compile(r"^[A-Z][A-Z0-9_]*$")
-_DOC_METRIC = re.compile(r"\b([a-z][a-z0-9_]*_total)\b")
+_DOC_METRIC = re.compile(r"\b([a-z][a-z0-9_]*_(?:total|seconds))\b")
 _DOC_SPEC = re.compile(r"--chaos[ =]+([^\s`'\")]+)")
+_DOC_SCENARIO = re.compile(r"--scenario[ =]+([^\s`'\")]+)")
 
 
 # -- metrics -------------------------------------------------------------
@@ -365,9 +374,60 @@ def chaos_spec_violations(
     return out
 
 
+# -- scenario specs ------------------------------------------------------
+
+
+def scenario_defs(src: str, path: str) -> dict[str, int]:
+    """AST-parse the literal ``SCENARIOS`` dict's string keys (the
+    registry is never imported — it must stay a literal dict)."""
+    tree = ast.parse(src, filename=path)
+    names: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            targets = (
+                [node.target] if isinstance(node.target, ast.Name) else []
+            )
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        else:
+            continue
+        if not any(t.id == "SCENARIOS" for t in targets):
+            continue
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    names[k.value] = k.lineno
+    return names
+
+
+def scenario_spec_violations(docs, known_names) -> list[Violation]:
+    """Every concrete ``--scenario NAME[:key=val]`` doc example must name
+    a registered scenario (overrides are stripped before the check)."""
+    out = []
+    for display, text in docs:
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for raw in _DOC_SCENARIO.findall(line):
+                if "<" in raw or "[" in raw:
+                    continue  # usage template, not a concrete example
+                name = raw.split(":", 1)[0]
+                if name not in known_names:
+                    out.append(Violation(
+                        rule="scenario-spec", path=display, line=lineno,
+                        symbol=name,
+                        message=(
+                            f"--scenario example names unregistered "
+                            f"scenario {name!r}"
+                        ),
+                    ))
+    return out
+
+
 def run(
     files, docs, metrics_defs_path, faults_defs_path,
     site_scan_exclude=("tests/",), spec_validator=None,
+    scenarios_defs_path=None,
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
@@ -380,4 +440,11 @@ def run(
         out.extend(chaos_spec_violations(
             docs, set(sites), prefixes, spec_validator
         ))
+    if scenarios_defs_path is not None:
+        scn_src = files.get(scenarios_defs_path)
+        # absent in fixture corpora: skip the family rather than flag it
+        if scn_src is not None:
+            out.extend(scenario_spec_violations(
+                docs, scenario_defs(scn_src, scenarios_defs_path)
+            ))
     return out

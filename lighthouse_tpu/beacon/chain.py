@@ -35,11 +35,11 @@ from ..consensus.state_processing.forks import state_fork_name
 from ..consensus.state_processing.per_slot import process_slots
 from ..crypto.bls import api as bls
 from ..store import HotColdDB
-from ..utils import Counter, Histogram, get_logger, log_with
+from ..utils import Counter, get_logger, log_with
+from ..utils.metrics import BLOCK_IMPORT_LATENCY
 
 BLOCKS_IMPORTED = Counter("beacon_blocks_imported_total", "Blocks imported")
 ATTS_PROCESSED = Counter("beacon_attestations_processed_total", "Attestations")
-BLOCK_TIMES = Histogram("beacon_block_processing_seconds", "Block pipeline time")
 
 import logging
 
@@ -117,7 +117,8 @@ class ValidatorPubkeyCache:
 
 class BeaconChain:
     def __init__(self, spec: S.ChainSpec, genesis_state, store: HotColdDB | None,
-                 slot_clock=None, fork: str = "base", execution=None):
+                 slot_clock=None, fork: str = "base", execution=None,
+                 committee_caches: dict | None = None):
         self.spec = spec
         self.preset = spec.preset
         self.types = types_for(spec.preset)
@@ -194,7 +195,13 @@ class BeaconChain:
         )
         self.head_root = genesis_root
         self._states: dict[bytes, object] = {genesis_root: genesis_state}
-        self._committee_caches: dict[tuple[bytes, int], cm.CommitteeCache] = {}
+        # keyed by (state identity, epoch) — identical across every chain
+        # following the same history, so the multi-node simulator passes
+        # ONE shared dict to all its nodes (shuffling is the dominant
+        # per-node setup cost; sharing makes dozens of nodes cheap)
+        self._committee_caches: dict[tuple[bytes, int], cm.CommitteeCache] = (
+            committee_caches if committee_caches is not None else {}
+        )
         self.pubkey_cache = ValidatorPubkeyCache()
         self.pubkey_cache.update(genesis_state)
         # observed-gossip dedup (observed_attesters / observed_block_producers)
@@ -262,7 +269,7 @@ class BeaconChain:
         also run the rungs as separate pipeline stages.  Returns the block
         root.  ``from_rpc``: sync/RPC imports skip the gossip-tier clock
         check (the reference's gossip vs rpc block entry distinction)."""
-        with BLOCK_TIMES.timer():
+        with BLOCK_IMPORT_LATENCY.timer():
             # proposal signature rides the bulk batch (one device call for
             # the whole block) rather than the gossip tier's single verify
             gvb = self.gossip_verify_block(

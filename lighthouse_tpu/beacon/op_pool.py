@@ -83,11 +83,42 @@ class OperationPool:
         return packed
 
     def get_slashings_and_exits(self, state, preset):
-        """Bounded op lists for a block (FIFO-fair, validity filtered by
-        the caller's state transition)."""
-        ps = list(self.proposer_slashings.values())[: preset.max_proposer_slashings]
-        asl = self.attester_slashings[: preset.max_attester_slashings]
-        exits = list(self.voluntary_exits.values())[: preset.max_voluntary_exits]
+        """Bounded op lists for a block, validity-filtered against the
+        packing ``state`` (op_pool/src/lib.rs get_slashings: an op that
+        would fail the transition — e.g. a proposer already slashed by an
+        earlier inclusion — must not be packed, or the proposal itself
+        becomes invalid)."""
+        from ..consensus.testing import FAR_FUTURE_EPOCH
+
+        current = state.slot // preset.slots_per_epoch
+
+        def _slashable(idx: int) -> bool:
+            if idx >= len(state.validators):
+                return False
+            v = state.validators[idx]
+            return (
+                not v.slashed
+                and v.activation_epoch <= current < v.withdrawable_epoch
+            )
+
+        ps = [
+            s for s in self.proposer_slashings.values()
+            if _slashable(int(s.signed_header_1.message.proposer_index))
+        ][: preset.max_proposer_slashings]
+        asl = [
+            s for s in self.attester_slashings
+            if any(
+                _slashable(int(i))
+                for i in set(s.attestation_1.attesting_indices)
+                & set(s.attestation_2.attesting_indices)
+            )
+        ][: preset.max_attester_slashings]
+        exits = [
+            e for e in self.voluntary_exits.values()
+            if int(e.message.validator_index) < len(state.validators)
+            and state.validators[int(e.message.validator_index)].exit_epoch
+            == FAR_FUTURE_EPOCH
+        ][: preset.max_voluntary_exits]
         return ps, asl, exits
 
     # ---------------------------------------------------------------- prune

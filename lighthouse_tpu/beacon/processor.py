@@ -445,12 +445,15 @@ class ResilientVerifier:
         if not sets:
             return BatchOutcome(verdicts=[], device_calls=0)
         try:
-            budget = RetryBudget(
-                attempts=self.max_device_attempts,
-                deadline=self.now() + self.retry_deadline,
-            )
-            verdicts = self._device_or_cpu(sets, budget)
-            return BatchOutcome(verdicts=verdicts, device_calls=0)
+            from ..utils.metrics import VERIFY_BATCH_LATENCY
+
+            with VERIFY_BATCH_LATENCY.timer():
+                budget = RetryBudget(
+                    attempts=self.max_device_attempts,
+                    deadline=self.now() + self.retry_deadline,
+                )
+                verdicts = self._device_or_cpu(sets, budget)
+                return BatchOutcome(verdicts=verdicts, device_calls=0)
         except Exception as exc:  # noqa: BLE001 — never-raise backstop
             # The ladder already absorbs device faults; this catches a bug
             # in the ladder itself (or a CPU-oracle crash).  Fail closed:

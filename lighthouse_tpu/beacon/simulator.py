@@ -26,7 +26,9 @@ from .harness import BeaconChainHarness
 
 class SimNode:
     def __init__(self, node_id: str, spec: S.ChainSpec, genesis_state,
-                 router: gossip.GossipRouter, fork: str = "altair"):
+                 router: gossip.GossipRouter, fork: str = "altair",
+                 committee_caches: dict | None = None,
+                 slasher: bool = False):
         self.node_id = node_id
         self.spec = spec
         self.clock = ManualSlotClock(
@@ -34,10 +36,19 @@ class SimNode:
             seconds_per_slot=spec.seconds_per_slot,
         )
         self.chain = BeaconChain(
-            spec, genesis_state, store=None, slot_clock=self.clock, fork=fork
+            spec, genesis_state, store=None, slot_clock=self.clock, fork=fork,
+            committee_caches=committee_caches,
         )
         self.gossip = gossip.GossipNode(node_id, router)
         self.fork = fork
+        # optional in-node slasher (service.rs analog): every gossiped
+        # block's header is fed BEFORE import so equivocations are seen
+        # even when fork choice never adopts the second block
+        self.slasher = None
+        if slasher:
+            from ..slasher import Slasher
+
+            self.slasher = Slasher()
         gvr = bytes(genesis_state.genesis_validators_root)
         digest = topics.fork_digest(spec, 0, gvr)
         self.block_topic = topics.topic("beacon_block", digest)
@@ -57,6 +68,7 @@ class SimNode:
             signed = cls.deserialize_value(payload)
         except Exception:
             return "reject"
+        self._feed_slasher_header(signed)
         try:
             self.chain.process_block(signed, verify_signatures=False)
             return "accept"
@@ -64,6 +76,44 @@ class SimNode:
             if "already known" in str(e):
                 return "ignore"
             return "reject"
+
+    def _feed_slasher_header(self, signed_block) -> None:
+        if self.slasher is None:
+            return
+        from ..consensus.containers import (
+            BeaconBlockHeader,
+            SignedBeaconBlockHeader,
+        )
+
+        msg = signed_block.message
+        self.slasher.accept_block_header(
+            SignedBeaconBlockHeader(
+                message=BeaconBlockHeader(
+                    slot=int(msg.slot),
+                    proposer_index=int(msg.proposer_index),
+                    parent_root=bytes(msg.parent_root),
+                    state_root=bytes(msg.state_root),
+                    body_root=msg.body.root(),
+                ),
+                signature=bytes(signed_block.signature),
+            )
+        )
+
+    def poll_slasher(self) -> tuple[list, list]:
+        """One slasher tick: process queued headers/attestations, push any
+        slashings into the op pool so this node's next proposal carries
+        them.  Returns (attester_slashings, proposer_slashings)."""
+        if self.slasher is None:
+            return [], []
+        epoch = int(self.chain.head_state().slot) // (
+            self.spec.preset.slots_per_epoch
+        )
+        att_slashings, prop_slashings = self.slasher.process_queued(epoch)
+        for s in att_slashings:
+            self.chain.op_pool.insert_attester_slashing(s)
+        for s in prop_slashings:
+            self.chain.op_pool.insert_proposer_slashing(s)
+        return att_slashings, prop_slashings
 
     def _on_attestation(self, payload: bytes, from_peer: str) -> str:
         try:
@@ -102,15 +152,27 @@ class SimNode:
 
 
 class Simulator:
+    """N in-process SimNodes over one gossip mesh.
+
+    ``injector``: optional FaultInjector wired into the router's
+    per-delivery ``gossip.route`` site (lossy/corrupting wire).
+    ``slasher``: give every node an in-node slasher service.
+    All nodes share one committee-cache dict (identical histories →
+    identical shufflings) and the cached interop genesis, so dozens of
+    nodes cost roughly one node's setup.
+    """
+
     def __init__(self, n_nodes: int = 3, n_validators: int = 32,
-                 fork: str = "altair"):
+                 fork: str = "altair", injector=None, slasher: bool = False):
         self.spec = phase0_spec(S.MINIMAL)
         genesis, self.keypairs = interop_state(
             n_validators, self.spec, fork=fork
         )
-        self.router = gossip.GossipRouter()
+        self.router = gossip.GossipRouter(injector=injector)
+        shared_caches: dict = {}
         self.nodes = [
-            SimNode(f"node{i}", self.spec, genesis, self.router, fork)
+            SimNode(f"node{i}", self.spec, genesis, self.router, fork,
+                    committee_caches=shared_caches, slasher=slasher)
             for i in range(n_nodes)
         ]
         # a driver harness view for producing blocks/attestations with keys
@@ -123,17 +185,72 @@ class Simulator:
     def run_slot(self, slot: int) -> None:
         """One protocol slot: the proposer node builds + gossips a block;
         every node's committees attest through gossip."""
-        proposer_node = self.nodes[slot % len(self.nodes)]
+        proposer_node = self.proposer_node(slot)
         for node in self.nodes:
             node.clock.set_slot(slot)
         signed = proposer_node.chain.produce_block(slot, self.keypairs)
         proposer_node.publish_block(signed)
-        # attest from the proposer node's view (committees are identical)
-        self._producer.chain = proposer_node.chain
+        self.attest(slot, proposer_node)
+
+    # ---------------------------------------------------- scenario hooks
+
+    def proposer_node(self, slot: int) -> SimNode:
+        return self.nodes[slot % len(self.nodes)]
+
+    def set_slot(self, slot: int) -> None:
+        for node in self.nodes:
+            node.clock.set_slot(slot)
+
+    def attest(self, slot: int, view_node: SimNode | None = None) -> list:
+        """Sign + gossip every committee attestation scheduled at ``slot``
+        from ``view_node``'s head view (committees are identical across
+        honest nodes).  Returns the attestations for traffic shapes that
+        re-publish or flood them."""
+        view_node = view_node or self.proposer_node(slot)
+        self._producer.chain = view_node.chain
         atts = BeaconChainHarness.make_attestations(self._producer, slot)
         for att in atts:
             attester_node = self.nodes[int(att.data.index) % len(self.nodes)]
             attester_node.publish_attestation(att)
+        return atts
+
+    def propose_on(self, slot: int, parent_root: bytes,
+                   graffiti: bytes = b"", node: SimNode | None = None):
+        """Build + gossip a block at ``slot`` anchored on an explicit
+        ``parent_root`` instead of the producing node's head — the lever
+        behind proposer-reorg and equivocation traffic shapes."""
+        node = node or self.proposer_node(slot)
+        chain = node.chain
+        prev_head = chain.head_root
+        chain.head_root = parent_root
+        try:
+            signed = chain.produce_block(slot, self.keypairs,
+                                         graffiti=graffiti)
+        finally:
+            chain.head_root = prev_head
+        node.publish_block(signed)
+        return signed
+
+    def propose_equivocation(self, slot: int) -> tuple:
+        """The scheduled proposer double-proposes: two conflicting blocks
+        for the same slot on the same parent (differing graffiti), both
+        gossiped — the slashable offence the in-node slashers must catch.
+        Returns (block_a, block_b)."""
+        node = self.proposer_node(slot)
+        self.set_slot(slot)
+        parent = node.chain.head_root
+        a = node.chain.produce_block(slot, self.keypairs, graffiti=b"a")
+        node.publish_block(a)
+        b = self.propose_on(slot, parent, graffiti=b"b", node=node)
+        return a, b
+
+    def poll_slashers(self) -> int:
+        """Tick every node's slasher; total slashings found this poll."""
+        found = 0
+        for node in self.nodes:
+            atts, props = node.poll_slasher()
+            found += len(atts) + len(props)
+        return found
 
     def run_slots(self, first: int, count: int) -> None:
         for slot in range(first, first + count):

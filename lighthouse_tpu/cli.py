@@ -47,6 +47,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "extra-blocks arm the req/resp sites, e.g. "
                          "rpc.respond=corrupt-chunk or "
                          "sync.request=stall:3.0x2 — see utils/faults.py")
+    bn.add_argument("--scenario", default=None,
+                    metavar="NAME[:seed=N]",
+                    help="run a named adversarial scenario (SLO-gated, "
+                         "seed-deterministic; see scenario/spec.py and "
+                         "tools/scenario_run.py --list) instead of "
+                         "serving, e.g. --scenario smoke or "
+                         "--scenario mainnet-shape:seed=99; exits 0/1 "
+                         "on SLO pass/fail")
     bn.add_argument("--upnp", action="store_true",
                     help="attempt UPnP port mapping for p2p/discovery "
                          "(best-effort; nat.rs analog)")
@@ -148,6 +156,24 @@ def run_bn(args) -> int:
     import logging
 
     log = get_logger("bn")
+    if getattr(args, "scenario", None):
+        from .scenario import parse_scenario_arg
+        from .scenario.engine import ScenarioEngine
+
+        scn = parse_scenario_arg(args.scenario)
+        log_with(log, logging.INFO, "Running scenario",
+                 scenario=scn.name, seed=scn.seed)
+        report = ScenarioEngine(scn).run()
+        for s in report["slo"]:
+            log_with(log, logging.INFO if s["ok"] else logging.ERROR,
+                     "SLO " + ("ok" if s["ok"] else "FAIL"),
+                     gate=s["name"], observed=s["observed"],
+                     threshold=s["threshold"])
+        log_with(log, logging.INFO, "Scenario finished",
+                 scenario=scn.name,
+                 verdict="PASS" if report["pass"] else "FAIL",
+                 fingerprint=report["fingerprint"])
+        return 0 if report["pass"] else 1
     for spec_str in getattr(args, "chaos", []):
         from .utils import faults
 

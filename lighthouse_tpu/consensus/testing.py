@@ -29,12 +29,16 @@ def interop_secret_key(index: int) -> bls.SecretKey:
     return bls.SecretKey(sk)
 
 
+@lru_cache(maxsize=64)
+def _interop_keypairs_cached(n: int) -> tuple:
+    return tuple(
+        (interop_secret_key(i), interop_secret_key(i).public_key())
+        for i in range(n)
+    )
+
+
 def interop_keypairs(n: int) -> list[tuple[bls.SecretKey, bls.PublicKey]]:
-    out = []
-    for i in range(n):
-        sk = interop_secret_key(i)
-        out.append((sk, sk.public_key()))
-    return out
+    return list(_interop_keypairs_cached(n))
 
 
 def phase0_spec(preset: Preset) -> ChainSpec:
@@ -50,6 +54,15 @@ def phase0_spec(preset: Preset) -> ChainSpec:
     )
 
 
+# Built genesis states keyed by every spec field the construction reads.
+# interop_state is called once per node per test, and the altair+ variants
+# pay a sync-committee computation each time — caching lets a scenario run
+# dozens of in-process nodes off one genesis build.  Values are deep-copied
+# on the way out, so callers mutate freely (same semantics as rebuilding).
+_INTEROP_STATE_CACHE: dict[tuple, object] = {}
+_INTEROP_STATE_CACHE_MAX = 16
+
+
 def interop_state(
     n_validators: int,
     spec: ChainSpec,
@@ -59,6 +72,31 @@ def interop_state(
     """Genesis-like BeaconState (chosen fork variant) with n interop
     validators, plus the keypairs.  genesis_validators_root is computed per
     spec (the root of the validator registry)."""
+    key = (
+        n_validators, balance, fork, spec.preset, spec.config_name,
+        spec.max_effective_balance, spec.min_genesis_time,
+        bytes(spec.genesis_fork_version),
+        bytes(getattr(spec, f"{fork}_fork_version"))
+        if fork != "base"
+        and getattr(spec, f"{fork}_fork_epoch", None) is not None
+        else None,
+    )
+    cached = _INTEROP_STATE_CACHE.get(key)
+    if cached is not None:
+        return cached.copy(), interop_keypairs(n_validators)
+    state, keypairs = _build_interop_state(n_validators, spec, balance, fork)
+    if len(_INTEROP_STATE_CACHE) >= _INTEROP_STATE_CACHE_MAX:
+        _INTEROP_STATE_CACHE.pop(next(iter(_INTEROP_STATE_CACHE)))
+    _INTEROP_STATE_CACHE[key] = state.copy()
+    return state, keypairs
+
+
+def _build_interop_state(
+    n_validators: int,
+    spec: ChainSpec,
+    balance: int | None = None,
+    fork: str = "base",
+):
     preset = spec.preset
     T = types_for(preset)
     balance = balance if balance is not None else spec.max_effective_balance

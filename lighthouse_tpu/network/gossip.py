@@ -117,10 +117,20 @@ class GossipNode:
 
 
 class GossipRouter:
-    """In-process full-mesh router for the multi-node simulator."""
+    """In-process full-mesh router for the multi-node simulator.
 
-    def __init__(self):
+    ``injector``: optional FaultInjector consulted once per *delivery* at
+    the ``gossip.route`` site — a raising kind (``drop``) makes the
+    message vanish on the wire to that one peer (lossy network), a
+    mutating kind (``corrupt``) hands the peer flipped bytes (which then
+    fail snappy/SSZ validation and penalize the forwarder, exactly as a
+    bit-flipping relay would).  Unarmed, the hook is one attribute check.
+    """
+
+    def __init__(self, injector=None):
         self.subscriptions: dict[str, list[GossipNode]] = defaultdict(list)
+        self.injector = injector
+        self.dropped = 0  # deliveries lost to injected wire faults
 
     def register(self, topic: str, node: GossipNode) -> None:
         if node not in self.subscriptions[topic]:
@@ -128,5 +138,13 @@ class GossipRouter:
 
     def route(self, topic: str, compressed: bytes, origin: str) -> None:
         for node in self.subscriptions[topic]:
-            if node.node_id != origin:
-                node.deliver(topic, compressed, origin)
+            if node.node_id == origin:
+                continue
+            payload = compressed
+            if self.injector is not None:
+                try:
+                    payload = self.injector.fire("gossip.route", compressed)
+                except Exception:
+                    self.dropped += 1
+                    continue  # lost on the wire to this one peer
+            node.deliver(topic, payload, origin)

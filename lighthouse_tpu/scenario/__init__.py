@@ -1,0 +1,32 @@
+"""Mainnet-shape adversarial scenario harness with SLO gates.
+
+The chaos pieces built by earlier PRs — FaultInjector sites, the
+CircuitBreaker + ResilientVerifier ladder, byzantine multi-peer sync,
+the ``kill -9`` crash harness, the in-process multi-node Simulator —
+exist separately; this package composes them into one repeatable,
+seed-deterministic scenario generator:
+
+* :mod:`spec`      — declarative :class:`ScenarioSpec` + the named
+                     ``SCENARIOS`` registry (``smoke``,
+                     ``mainnet-shape``, ``mainnet-shape-degraded``)
+* :mod:`traffic`   — traffic shapes: epoch-boundary attestation floods
+                     at committee fan-out, deposit queues, proposer
+                     reorgs, slashable equivocations
+* :mod:`adversity` — adversity tracks: lossy/corrupting gossip,
+                     breaker-tripping device faults, byzantine sync
+                     peers, mid-run ``kill -9`` + recovery
+* :mod:`slo`       — SLO assertions over the live metrics registry
+                     (shed rate, sync stalls, breaker transitions, p99
+                     import/verify latency, head convergence,
+                     finalization advance, never-raise violations)
+* :mod:`engine`    — the :class:`ScenarioEngine` run loop: N SimNodes,
+                     one seeded RNG, a virtual breaker clock, a JSON
+                     report with the seed + fired-fault sequence, and a
+                     BENCH_HISTORY ``scenario`` row
+
+Drivers: ``tools/scenario_run.py`` and ``bn --scenario NAME``.
+"""
+
+from .engine import ScenarioEngine, run_scenario  # noqa: F401
+from .spec import SCENARIOS, ScenarioSpec, parse_scenario_arg  # noqa: F401
+from .slo import SLOResult  # noqa: F401

@@ -1,0 +1,196 @@
+"""Adversity tracks: the failure modes a scenario runs *through*.
+
+Tracks are parsed from ``"name[:key=val,...]"`` specs (mirroring the
+``--chaos`` arming-spec style) and get the same per-slot hooks as
+traffic shapes.  Each reuses machinery built by earlier robustness PRs:
+the FaultInjector's ``gossip.route``/``processor.verify`` sites, the
+byzantine peer servers from the chaos-sync soak, and the ``kill -9``
+crash harness (run in-process here, subprocess child and all).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import tempfile
+import time
+
+from ..utils.faults import DeviceFault
+
+
+def _flip_mid_byte(b: bytes) -> bytes:
+    if not b:
+        return b
+    mid = len(b) // 2
+    return b[:mid] + bytes([b[mid] ^ 0xFF]) + b[mid + 1:]
+
+
+class Track:
+    name = ""
+
+    def install(self, engine) -> None:
+        """One-time setup before slot 0."""
+
+    def on_slot(self, engine, slot: int) -> None:
+        """Called at the start of every slot (before the proposal)."""
+
+    def finalize(self, engine) -> None:
+        """End-of-run bookkeeping into the engine report."""
+
+
+class GossipFaultTrack(Track):
+    """Arm the router's per-delivery ``gossip.route`` site over a slot
+    window: ``drop`` is a lossy wire (per-peer delivery loss the
+    epoch-boundary heal must repair), ``corrupt`` a bit-flipping relay
+    (corrupted payloads fail snappy and penalize the path instead)."""
+
+    name = "gossip-faults"
+
+    def __init__(self, kind="drop", p="0.15", start="4", end="10"):
+        self.kind = kind
+        self.p = float(p)
+        self.start = int(start)
+        self.end = int(end)
+
+    def on_slot(self, engine, slot: int) -> None:
+        if slot == self.start:
+            mutate = _flip_mid_byte if self.kind == "corrupt" else None
+            engine.injector.arm("gossip.route", self.kind,
+                                probability=self.p, mutate=mutate)
+            engine.note("gossip-faults", slot=slot, armed=self.kind,
+                        p=self.p)
+        elif slot == self.end + 1:
+            engine.injector.disarm("gossip.route")
+            engine.note("gossip-faults", slot=slot, disarmed=self.kind)
+
+    def finalize(self, engine) -> None:
+        engine.injector.disarm("gossip.route")
+        engine.run_facts["gossip_deliveries_dropped"] = (
+            engine.sim.router.dropped
+        )
+
+
+class DeviceFaultTrack(Track):
+    """A device-outage window: every ``processor.verify`` call sleeps
+    ``delay`` then raises :class:`DeviceFault` (a slow-then-dead
+    accelerator).  With the breaker enabled this trips it OPEN within
+    ``failure_threshold`` batches and the run recovers through probes;
+    with the breaker disabled every batch pays the full retry budget and
+    the ``max_device_retries`` SLO blows — the degraded-run proof."""
+
+    name = "device-faults"
+
+    def __init__(self, delay="0.02", start="10", end="14"):
+        self.delay = float(delay)
+        self.start = int(start)
+        self.end = int(end)
+
+    def _exc(self):
+        time.sleep(self.delay)
+        return DeviceFault("injected scenario device-fault window")
+
+    def on_slot(self, engine, slot: int) -> None:
+        if slot == self.start:
+            engine.injector.arm("processor.verify", "error", exc=self._exc)
+            engine.note("device-faults", slot=slot, armed="error",
+                        delay=self.delay)
+        elif slot == self.end + 1:
+            engine.injector.disarm("processor.verify")
+            engine.note("device-faults", slot=slot, disarmed="error")
+
+    def finalize(self, engine) -> None:
+        engine.injector.disarm("processor.verify")
+
+
+class ByzantineSyncTrack(Track):
+    """Every epoch-boundary heal gains byzantine company: alongside the
+    honest serving peer, a block-reordering peer and a crashing peer join
+    the SyncManager's peer set (the chaos-sync soak's adversaries), so
+    lagging nodes must score out liars while catching up."""
+
+    name = "byzantine-sync"
+
+    def install(self, engine) -> None:
+        engine.byzantine_sync = True
+
+    def finalize(self, engine) -> None:
+        engine.run_facts["byzantine_heals"] = engine.run_facts.get(
+            "byzantine_heals", 0
+        )
+
+
+class KillRecoveryTrack(Track):
+    """Mid-run ``kill -9`` + recovery: at slot ``at`` the crash harness
+    runs one full iteration in-process (subprocess child, SIGKILL landing
+    inside a record's write window, WAL recovery + verification against
+    the committed prefix).  A failed recovery is recorded and fails the
+    ``crash_recovery`` SLO."""
+
+    name = "kill-recovery"
+
+    def __init__(self, at="24", kill_after="3", blocks="16"):
+        self.at = int(at)
+        self.kill_after = int(kill_after)
+        self.blocks = int(blocks)
+
+    @staticmethod
+    def _load_harness():
+        path = os.path.join(
+            os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            ),
+            "tools", "crash_harness.py",
+        )
+        if "crash_harness" in sys.modules:
+            return sys.modules["crash_harness"]
+        spec = importlib.util.spec_from_file_location("crash_harness", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["crash_harness"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    def on_slot(self, engine, slot: int) -> None:
+        if slot != self.at:
+            return
+        harness = self._load_harness()
+        datadir = tempfile.mkdtemp(prefix="scenario-crash-")
+        report = {"slot": slot, "kill_after": self.kill_after, "ok": False}
+        try:
+            result = harness.run_iteration(
+                engine.spec.seed, datadir, self.kill_after,
+                blocks=self.blocks,
+            )
+            report.update(result)
+            report["ok"] = True
+        except Exception as exc:  # noqa: BLE001 — a failed recovery is an
+            # SLO verdict, not a harness crash
+            report["error"] = f"{type(exc).__name__}: {exc}"
+        engine.run_facts.setdefault("crash_reports", []).append(report)
+        engine.note("kill-recovery", slot=slot, ok=report["ok"])
+
+
+TRACKS = {
+    cls.name: cls
+    for cls in (GossipFaultTrack, DeviceFaultTrack, ByzantineSyncTrack,
+                KillRecoveryTrack)
+}
+
+
+def build_tracks(specs) -> list[Track]:
+    out = []
+    for spec_str in specs:
+        name, _, rest = spec_str.partition(":")
+        name = name.strip()
+        cls = TRACKS.get(name)
+        if cls is None:
+            raise ValueError(
+                f"unknown adversity track {name!r}; have {sorted(TRACKS)}"
+            )
+        kwargs = {}
+        if rest:
+            for kv in rest.split(","):
+                k, _, v = kv.partition("=")
+                kwargs[k.strip()] = v.strip()
+        out.append(cls(**kwargs))
+    return out

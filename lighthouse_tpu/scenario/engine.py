@@ -1,0 +1,413 @@
+"""The scenario run loop: N SimNodes, one seed, SLO-gated.
+
+Determinism contract: one ``random.Random(spec.seed)`` seeds the
+FaultInjector's probability stream, the breaker/verifier run on a
+*virtual* clock advanced one second per slot (trip/probe/backoff timing
+is slot-driven, never wall-clock), and every per-slot action is a pure
+function of (spec, seed).  Two runs of the same spec produce the same
+fired-fault sequence, the same head roots, the same finalized epochs —
+pinned by the report's ``fingerprint``.
+
+The loop per slot: advance the virtual clock, let adversity tracks
+arm/disarm, propose (base proposal or a traffic shape's replacement),
+attest from the proposer's view, push the attestations through the
+BeaconProcessor (where the ResilientVerifier + CircuitBreaker ladder
+runs against injected device faults), poll the in-node slashers, and at
+epoch boundaries heal gossip-partitioned nodes over the real SyncManager
+(byzantine peers included when that track is on) with a canonical-chain
+replay fallback.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import random
+import time
+
+from ..utils.logging import get_logger, log_with
+from .adversity import build_tracks
+from .slo import MetricsSnapshot, evaluate
+from .spec import SCENARIOS, ScenarioSpec
+from .traffic import build_shapes
+
+log = get_logger("lighthouse_tpu.scenario")
+
+
+class ScenarioClock:
+    """Virtual monotonic clock: one second per slot, advanced only by the
+    engine — so breaker timeouts/backoffs resolve identically every run."""
+
+    def __init__(self, start: float = 1000.0):
+        self.t = start
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+class ScenarioEngine:
+    def __init__(self, spec: ScenarioSpec, out_path: str | None = None,
+                 history_path: str | None = None):
+        from ..beacon.processor import (
+            BeaconProcessor,
+            CircuitBreaker,
+            ResilientVerifier,
+            WorkKind,
+        )
+        from ..beacon.simulator import Simulator
+        from ..crypto.bls import api as _bls_api
+        from ..utils.faults import FaultInjector
+
+        self.spec = spec
+        self.out_path = out_path
+        self.history_path = history_path
+        self.rng = random.Random(spec.seed)
+        self.injector = FaultInjector(seed=spec.seed)
+        self.sim = Simulator(
+            n_nodes=spec.n_nodes, n_validators=spec.n_validators,
+            fork=spec.fork, injector=self.injector, slasher=spec.slasher,
+        )
+        self.slots_per_epoch = self.sim.spec.preset.slots_per_epoch
+        self.clock = ScenarioClock()
+        # breaker_enabled=False parks the threshold at infinity — the
+        # ladder still runs, but nothing ever sheds or short-circuits:
+        # the degraded-run proof that the SLO gates catch regressions
+        self.breaker = CircuitBreaker(
+            failure_threshold=3 if spec.breaker_enabled else 10 ** 9,
+            now=self.clock.now,
+        )
+        self.verifier = ResilientVerifier(
+            device_verify=lambda s: _bls_api.get_backend().verify_signature_sets(s),
+            cpu_verify=lambda s: _bls_api.cpu_backend().verify_signature_sets(s),
+            breaker=self.breaker,
+            now=self.clock.now,
+            injector=self.injector,
+        )
+        self._work_kind = WorkKind.GOSSIP_ATTESTATION
+        self.processor = BeaconProcessor(
+            handlers={WorkKind.GOSSIP_ATTESTATION: self._attestation_handler},
+            breaker=self.breaker,
+            injector=self.injector,
+        )
+        self.shapes = build_shapes(spec.traffic)
+        self.tracks = build_tracks(spec.adversity)
+        self.byzantine_sync = False  # ByzantineSyncTrack flips this
+        self.events: list[dict] = []
+        self.run_facts: dict = {
+            "processor_enqueues": 0,
+            "proposal_failures": 0,
+            "never_raise_violations": 0,
+            "slashings_detected": 0,
+            "crash_reports": [],
+        }
+        self._probe_sets: list = []  # last known-good sets, breaker probes
+
+    # ------------------------------------------------------------ plumbing
+
+    def note(self, event: str, **kw) -> None:
+        self.events.append({"event": event, **kw})
+        log_with(log, logging.INFO, f"scenario {event}",
+                 scenario=self.spec.name, **kw)
+
+    def enqueue_attestation(self, att) -> None:
+        from ..beacon.processor import WorkEvent
+
+        self.run_facts["processor_enqueues"] += 1
+        self.processor.try_send(
+            WorkEvent(kind=self._work_kind, item=att,
+                      received_at=self.clock.now())
+        )
+
+    def _attestation_handler(self, events: list) -> None:
+        """Verify a batch of gossip attestations through the resilience
+        ladder — the workload the device-fault track attacks."""
+        from ..consensus import committees as cm
+        from ..consensus.state_processing.signature_sets import (
+            indexed_attestation_signature_set,
+        )
+
+        chain = self.sim.nodes[0].chain
+        state = chain.head_state()
+        sets = []
+        for ev in events:
+            att = ev.item
+            try:
+                epoch = int(att.data.slot) // self.slots_per_epoch
+                cache = chain.committee_cache(state, epoch)
+                committee = cache.committee(
+                    int(att.data.slot), int(att.data.index)
+                )
+                indexed = cm.get_indexed_attestation(committee, att)
+                sets.append(
+                    indexed_attestation_signature_set(
+                        state, chain.get_pubkey, indexed, chain.preset
+                    )
+                )
+            except Exception:
+                continue  # a stale view can't index every flooded att
+        if not sets:
+            return
+        self._probe_sets = sets[:1]
+        try:
+            self.verifier.verify_batch(sets)
+        except Exception as exc:  # noqa: BLE001 — contract says never
+            self.run_facts["never_raise_violations"] += 1
+            self.note("never-raise-violation", where="verify_batch",
+                      error=f"{type(exc).__name__}: {exc}")
+
+    # ------------------------------------------------------------ the loop
+
+    def run(self) -> dict:
+        t0 = time.time()
+        before = MetricsSnapshot()
+        for shape in self.shapes:
+            shape.install(self)
+        for track in self.tracks:
+            track.install(self)
+        total_slots = self.spec.epochs * self.slots_per_epoch
+        for slot in range(1, total_slots + 1):
+            self.clock.advance(1.0)
+            self.sim.set_slot(slot)
+            for track in self.tracks:
+                track.on_slot(self, slot)
+            self._run_slot(slot)
+            if slot % self.slots_per_epoch == 0:
+                self._heal(slot)
+        self._recover_breaker()
+        self._heal(total_slots)  # final convergence pass
+        for shape in self.shapes:
+            shape.finalize(self)
+        for track in self.tracks:
+            track.finalize(self)
+        after = MetricsSnapshot()
+        return self._report(before, after, total_slots, t0)
+
+    def _run_slot(self, slot: int) -> None:
+        sim = self.sim
+        shape = next(
+            (s for s in self.shapes if s.proposes(self, slot)), None
+        )
+        try:
+            if shape is not None:
+                shape.propose(self, slot)
+            else:
+                node = sim.proposer_node(slot)
+                signed = node.chain.produce_block(slot, sim.keypairs)
+                node.publish_block(signed)
+        except Exception as exc:  # a missed proposal is a liveness fact,
+            # not a harness abort — the finalization SLO judges it
+            self.run_facts["proposal_failures"] += 1
+            self.note("proposal-failed", slot=slot,
+                      error=f"{type(exc).__name__}: {exc}")
+        try:
+            atts = sim.attest(slot)
+        except Exception as exc:
+            atts = []
+            self.note("attest-failed", slot=slot,
+                      error=f"{type(exc).__name__}: {exc}")
+        for att in atts:
+            self.enqueue_attestation(att)
+        for s in self.shapes:
+            s.on_attestations(self, slot, atts)
+        self.processor.drain()
+        # a tripped breaker sheds GOSSIP_ATTESTATION at ingress, so the
+        # handler alone would never probe the device again; block/sync
+        # signature traffic keeps flowing through the ladder in a real
+        # node, so feed one known-good batch per slot as that probe
+        if not self.breaker.is_closed and self._probe_sets:
+            try:
+                self.verifier.verify_batch(self._probe_sets)
+            except Exception:  # noqa: BLE001
+                self.run_facts["never_raise_violations"] += 1
+        found = sim.poll_slashers()
+        if found:
+            self.run_facts["slashings_detected"] += found
+            self.note("slashings-detected", slot=slot, found=found)
+
+    # ------------------------------------------------------------- healing
+
+    def _heal(self, slot: int) -> None:
+        """Epoch-boundary catch-up: lagging/partitioned nodes sync off the
+        best node over the real SyncManager, with a canonical replay
+        fallback — gossip drops must never strand a node permanently."""
+        sim = self.sim
+        for n in sim.nodes:
+            n.chain.recompute_head()
+        best = max(
+            sim.nodes,
+            key=lambda n: (int(n.chain.head_state().slot), n.chain.head_root),
+        )
+        for node in sim.nodes:
+            if node.chain.head_root == best.chain.head_root:
+                continue
+            self._sync_from(best, node)
+            if node.chain.head_root != best.chain.head_root:
+                self._replay_canonical(best, node)
+            node.chain.recompute_head()
+
+    def _sync_from(self, best, node) -> None:
+        from ..beacon.sync import SyncManager, SyncPeer, serve_blocks_by_range
+        from ..network import rpc
+        from ..network.peer_manager import PeerManager
+
+        serve = serve_blocks_by_range(best.chain, self.spec.fork)
+
+        def honest(start_slot, count):
+            return [rpc.decode_response_chunk(c)
+                    for c in serve(start_slot, count)]
+
+        head_slot = int(best.chain.head_state().slot)
+        pm = PeerManager()
+        mgr = SyncManager(node.chain, fork=self.spec.fork, peer_manager=pm,
+                          batch_slots=self.slots_per_epoch,
+                          request_timeout=0.5)
+        if self.byzantine_sync:
+            def reorder(start_slot, count):
+                return list(reversed(honest(start_slot, count)))
+
+            def crash(start_slot, count):
+                raise RuntimeError("connection reset by peer")
+
+            mgr.add_peer(SyncPeer(peer_id="byz-reorder", head_slot=head_slot,
+                                  request_blocks=reorder))
+            mgr.add_peer(SyncPeer(peer_id="byz-crash", head_slot=head_slot,
+                                  request_blocks=crash))
+            self.run_facts["byzantine_heals"] = (
+                self.run_facts.get("byzantine_heals", 0) + 1
+            )
+        mgr.add_peer(SyncPeer(peer_id="honest", head_slot=head_slot,
+                              request_blocks=honest))
+        try:
+            mgr.tick()
+        except Exception as exc:  # noqa: BLE001 — tick promises not to
+            self.run_facts["never_raise_violations"] += 1
+            self.note("never-raise-violation", where="sync.tick",
+                      error=f"{type(exc).__name__}: {exc}")
+
+    def _replay_canonical(self, best, node) -> None:
+        """Last-resort heal: feed the best node's canonical chain through
+        the RPC import path; already-known blocks are expected noise."""
+        from ..beacon.chain import BlockError
+        from ..network import rpc
+        from ..beacon.sync import serve_blocks_by_range
+
+        serve = serve_blocks_by_range(best.chain, self.spec.fork)
+        cls = node.chain.types.SignedBeaconBlock_BY_FORK[self.spec.fork]
+        head_slot = int(best.chain.head_state().slot)
+        for chunk in serve(1, head_slot):
+            try:
+                _code, payload = rpc.decode_response_chunk(chunk)
+                blk = cls.deserialize_value(payload)
+                node.chain.process_block(
+                    blk, verify_signatures=False, from_rpc=True
+                )
+            except BlockError as e:
+                if "already known" not in str(e):
+                    self.note("replay-rejected", error=str(e)[:120])
+            except Exception as exc:  # noqa: BLE001
+                self.note("replay-failed",
+                          error=f"{type(exc).__name__}: {exc}")
+
+    def _recover_breaker(self) -> None:
+        """Post-run drain: advance the virtual clock through the backoff
+        schedule feeding known-good probe batches until the breaker
+        re-closes (the ``require_breaker_recovered`` SLO input)."""
+        for _ in range(64):
+            if self.breaker.is_closed:
+                break
+            self.clock.advance(2.0)
+            if self._probe_sets:
+                try:
+                    self.verifier.verify_batch(self._probe_sets)
+                except Exception:  # noqa: BLE001
+                    self.run_facts["never_raise_violations"] += 1
+            elif self.breaker.allow_device():
+                self.breaker.record_success()
+        self.run_facts["breaker_closed"] = self.breaker.is_closed
+
+    # ------------------------------------------------------------- reports
+
+    def _report(self, before, after, total_slots: int, t0: float) -> dict:
+        heads = [h.hex() for h in self.sim.heads()]
+        fins = [int(f) for f in self.sim.finalized_epochs()]
+        self.run_facts["heads"] = heads
+        self.run_facts["finalized_epochs"] = fins
+        self.run_facts.setdefault("breaker_closed", self.breaker.is_closed)
+        deltas = after.delta(before)
+        results = evaluate(
+            self.spec.slo_thresholds(), deltas, self.run_facts
+        )
+        fired = [list(f) for f in self.injector.fired_sequence()]
+        fingerprint = hashlib.sha256(
+            json.dumps(
+                {"fired": fired, "heads": heads, "finalized": fins},
+                sort_keys=True,
+            ).encode()
+        ).hexdigest()[:16]
+        ok = all(r.ok for r in results)
+        report = {
+            "kind": "scenario",
+            "scenario": self.spec.name,
+            "seed": self.spec.seed,
+            "pass": ok,
+            "fingerprint": fingerprint,
+            "slots": total_slots,
+            "nodes": self.spec.n_nodes,
+            "slo": [r.to_dict() for r in results],
+            "metrics": deltas,
+            "facts": dict(self.run_facts),
+            "fired_faults": fired,
+            "events": self.events,
+            "elapsed_s": round(time.time() - t0, 3),
+        }
+        if self.out_path:
+            with open(self.out_path, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+        if self.history_path:
+            self._record_history(report)
+        log_with(log, logging.INFO, "scenario finished",
+                 scenario=self.spec.name, seed=self.spec.seed,
+                 ok=ok, fingerprint=fingerprint,
+                 slo_failed=[r.name for r in results if not r.ok])
+        return report
+
+    def _record_history(self, report: dict) -> None:
+        entry = {
+            "kind": "scenario",
+            "measured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "scenario": report["scenario"],
+            "seed": report["seed"],
+            "pass": report["pass"],
+            "fingerprint": report["fingerprint"],
+            "slots": report["slots"],
+            "nodes": report["nodes"],
+            "slo_failed": [r["name"] for r in report["slo"] if not r["ok"]],
+            "elapsed_s": report["elapsed_s"],
+        }
+        try:
+            with open(self.history_path, "a") as f:
+                f.write(json.dumps(entry) + "\n")
+        except OSError:
+            pass
+
+
+def run_scenario(spec_or_name, out_path: str | None = None,
+                 history_path: str | None = None) -> dict:
+    """Run one scenario (by :class:`ScenarioSpec` or registry name) and
+    return its JSON-shaped report."""
+    spec = spec_or_name
+    if isinstance(spec, str):
+        if spec not in SCENARIOS:
+            raise ValueError(
+                f"unknown scenario {spec!r}; have {sorted(SCENARIOS)}"
+            )
+        spec = SCENARIOS[spec]
+    return ScenarioEngine(
+        spec, out_path=out_path, history_path=history_path
+    ).run()

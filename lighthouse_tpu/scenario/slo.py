@@ -1,0 +1,164 @@
+"""SLO evaluation against the live metrics registry.
+
+The engine snapshots the robustness counters/histograms before and after
+the run; every assertion here is over the *delta*, so scenarios compose
+with whatever else the process has already recorded (pytest runs many
+scenarios against one process-global registry).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils import metrics as M
+
+
+@dataclass
+class SLOResult:
+    name: str
+    ok: bool
+    observed: object
+    threshold: object
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ok": self.ok,
+            "observed": self.observed,
+            "threshold": self.threshold,
+            "detail": self.detail,
+        }
+
+
+def _counter_total(counter) -> float:
+    """Sum over every label combination."""
+    return sum(v for _, v in counter.samples())
+
+
+class MetricsSnapshot:
+    """Point-in-time capture of every metric the SLO gates read."""
+
+    def __init__(self):
+        self.counters = {
+            "processor_shed_total": _counter_total(M.PROCESSOR_SHED),
+            "sync_stalls_total": _counter_total(M.SYNC_STALLS),
+            "breaker_transitions_total": _counter_total(
+                M.BREAKER_TRANSITIONS
+            ),
+            "verify_device_retries_total": _counter_total(
+                M.VERIFY_DEVICE_RETRIES
+            ),
+            "faults_injected_total": _counter_total(M.FAULTS_INJECTED),
+        }
+        self.import_buckets = M.BLOCK_IMPORT_LATENCY.bucket_counts()
+        self.verify_buckets = M.VERIFY_BATCH_LATENCY.bucket_counts()
+
+    def delta(self, earlier: "MetricsSnapshot") -> dict:
+        out = {
+            k: self.counters[k] - earlier.counters[k] for k in self.counters
+        }
+        out["import_p99_s"] = M.BLOCK_IMPORT_LATENCY.quantile(
+            0.99,
+            counts=[a - b for a, b in
+                    zip(self.import_buckets, earlier.import_buckets)],
+        )
+        out["verify_p99_s"] = M.VERIFY_BATCH_LATENCY.quantile(
+            0.99,
+            counts=[a - b for a, b in
+                    zip(self.verify_buckets, earlier.verify_buckets)],
+        )
+        return out
+
+
+def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
+    """Gate a finished run.
+
+    ``deltas``: MetricsSnapshot.delta output.  ``run``: engine-collected
+    facts — heads, finalized epochs, enqueue count, never-raise
+    violations, breaker end state, crash-recovery reports, slashings.
+    Every gate with a non-None threshold produces one SLOResult.
+    """
+    out: list[SLOResult] = []
+
+    def gate(name, ok, observed, threshold, detail=""):
+        out.append(SLOResult(name, bool(ok), observed, threshold, detail))
+
+    t = thresholds
+
+    if t.get("max_shed_rate") is not None:
+        enq = max(1, run.get("processor_enqueues", 0))
+        rate = deltas["processor_shed_total"] / enq
+        gate("shed_rate", rate <= t["max_shed_rate"], round(rate, 4),
+             t["max_shed_rate"],
+             f"{int(deltas['processor_shed_total'])} shed / {enq} enqueued")
+
+    if t.get("max_sync_stalls") is not None:
+        v = deltas["sync_stalls_total"]
+        gate("sync_stalls", v <= t["max_sync_stalls"], int(v),
+             t["max_sync_stalls"])
+
+    if t.get("max_breaker_transitions") is not None:
+        v = deltas["breaker_transitions_total"]
+        gate("breaker_transitions", v <= t["max_breaker_transitions"],
+             int(v), t["max_breaker_transitions"])
+
+    if t.get("min_breaker_transitions") is not None:
+        v = deltas["breaker_transitions_total"]
+        gate("breaker_engaged", v >= t["min_breaker_transitions"], int(v),
+             t["min_breaker_transitions"],
+             "the device-fault track must actually trip the breaker")
+
+    if t.get("max_device_retries") is not None:
+        v = deltas["verify_device_retries_total"]
+        gate("device_retries", v <= t["max_device_retries"], int(v),
+             t["max_device_retries"],
+             "unbounded retry amplification = the breaker is not doing "
+             "its job")
+
+    if t.get("max_import_p99_s") is not None:
+        v = deltas["import_p99_s"]
+        gate("import_p99", v <= t["max_import_p99_s"], round(v, 4),
+             t["max_import_p99_s"])
+
+    if t.get("max_verify_p99_s") is not None:
+        v = deltas["verify_p99_s"]
+        gate("verify_p99", v <= t["max_verify_p99_s"], round(v, 4),
+             t["max_verify_p99_s"])
+
+    if t.get("require_head_convergence"):
+        heads = run.get("heads", [])
+        converged = len(set(heads)) == 1 and bool(heads)
+        gate("head_convergence", converged, len(set(heads)), 1,
+             "distinct heads across nodes at run end")
+
+    if t.get("min_finalized_advance") is not None:
+        fins = run.get("finalized_epochs", [0])
+        worst = min(fins) if fins else 0
+        gate("finalization", worst >= t["min_finalized_advance"], worst,
+             t["min_finalized_advance"],
+             f"per-node finalized epochs {fins}")
+
+    if t.get("max_never_raise_violations") is not None:
+        v = run.get("never_raise_violations", 0)
+        gate("never_raise", v <= t["max_never_raise_violations"], v,
+             t["max_never_raise_violations"],
+             "exceptions escaping contracts that promise not to raise")
+
+    if t.get("require_breaker_recovered"):
+        closed = run.get("breaker_closed", True)
+        gate("breaker_recovered", closed, closed, True,
+             "breaker must re-close once faults stop")
+
+    if t.get("require_crash_recovery") and run.get("crash_reports"):
+        oks = [r.get("ok", False) for r in run["crash_reports"]]
+        gate("crash_recovery", all(oks), oks, True,
+             "every kill -9 iteration must recover committed records")
+
+    if t.get("min_slashings_detected") is not None:
+        v = run.get("slashings_detected", 0)
+        gate("slashings_detected", v >= t["min_slashings_detected"], v,
+             t["min_slashings_detected"],
+             "the equivocation shape must be caught by the slashers")
+
+    return out

@@ -1,0 +1,185 @@
+"""Declarative scenario specs + the named registry.
+
+A :class:`ScenarioSpec` is pure data: how many nodes/validators/epochs,
+which traffic shapes run (by name, see :mod:`traffic`), which adversity
+tracks fire (``"name:key=val,..."`` specs, see :mod:`adversity`), and the
+SLO thresholds the run is gated on (see :mod:`slo`).  The ``SCENARIOS``
+dict below is the canonical registry — the static audit cross-checks
+every ``--scenario`` example in the docs against its keys, exactly the
+way ``--chaos`` specs are validated against the fault-site registry, so
+keep the keys literal (AST-parsed, never imported, by
+``analysis/registry_lint.py``).
+
+Reproduction workflow: every run's JSON report records ``spec.seed`` and
+the injector's fired-fault sequence; re-running the same scenario name
+with the same seed replays the identical run (virtual breaker clock, one
+shared ``random.Random(seed)``, probability gates drawn from a private
+seeded stream).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# Default SLO thresholds; per-scenario overrides merge over these.  A
+# ``None`` threshold disables that gate.  See slo.evaluate for semantics.
+DEFAULT_SLO: dict = {
+    # shed / stall / breaker budgets (counter deltas over the run)
+    "max_shed_rate": 0.5,          # shed events / processor enqueues
+    "max_sync_stalls": 0,          # sync_stalls_total delta
+    "max_breaker_transitions": 12,  # breaker_transitions_total delta
+    "max_device_retries": 16,      # verify_device_retries_total delta
+    # latency tails (histogram quantiles over the run's delta counts).
+    # Gross-regression tripwires, not tight latency targets: the pure-
+    # Python pairing fallback costs ~0.5 s/set and CI hosts run loaded,
+    # so the budget carries headroom over the ~1 s quiet-host p99.
+    "max_import_p99_s": 6.0,       # block_import_latency_seconds
+    "max_verify_p99_s": 6.0,       # verify_batch_latency_seconds
+    # liveness
+    "require_head_convergence": True,
+    "min_finalized_advance": 0,    # epochs every node must finalize
+    # harness invariants
+    "max_never_raise_violations": 0,
+    "require_breaker_recovered": True,   # breaker CLOSED at run end
+    "require_crash_recovery": True,      # kill -9 iterations all verified
+    # "did the adversity actually bite" gates (None = not asserted)
+    "min_breaker_transitions": None,     # breaker must have engaged
+    "min_slashings_detected": None,      # slashers must have caught it
+}
+
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    name: str
+    seed: int
+    n_nodes: int = 3
+    n_validators: int = 32
+    epochs: int = 2
+    fork: str = "altair"
+    breaker_enabled: bool = True
+    slasher: bool = True
+    traffic: tuple = ()    # shape names from traffic.SHAPES
+    adversity: tuple = ()  # track specs "name[:k=v,...]" (adversity.TRACKS)
+    slo: dict = field(default_factory=dict)  # overrides over DEFAULT_SLO
+
+    def slo_thresholds(self) -> dict:
+        merged = dict(DEFAULT_SLO)
+        merged.update(self.slo)
+        return merged
+
+    def with_seed(self, seed: int) -> "ScenarioSpec":
+        from dataclasses import replace
+
+        return replace(self, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# The registry.  Keys are the names `--scenario` accepts; keep them
+# literal string constants (the registry lint AST-parses this dict).
+# ---------------------------------------------------------------------------
+
+SCENARIOS: dict[str, ScenarioSpec] = {
+    # Fast tier-1 smoke: 3 nodes, 2 epochs, one fault track.  Gossip
+    # drops force the epoch-boundary heal path; no finalization is
+    # expected inside 2 minimal-preset epochs.
+    "smoke": ScenarioSpec(
+        name="smoke",
+        seed=1234,
+        n_nodes=3,
+        n_validators=16,
+        epochs=2,
+        traffic=("attestation-flood",),
+        adversity=("gossip-faults:kind=drop,p=0.15,start=4,end=10",),
+        slo={
+            "min_finalized_advance": 0,
+            "require_crash_recovery": False,
+        },
+    ),
+    # The flagship mainnet-shape run: every traffic shape and every
+    # adversity track at once — epoch-boundary attestation floods at
+    # committee fan-out, a deposit queue draining through eth1 voting, a
+    # proposer reorg, a slashable equivocation, lossy gossip, a
+    # breaker-tripping device-fault window, byzantine sync peers on the
+    # heal path, and a mid-run kill -9 + recovery — with a fault
+    # cool-down tail so convergence + finalization SLOs are honest.
+    "mainnet-shape": ScenarioSpec(
+        name="mainnet-shape",
+        seed=7,
+        n_nodes=4,
+        n_validators=32,
+        epochs=6,
+        traffic=(
+            "attestation-flood",
+            "deposit-queue",
+            "proposer-reorg",
+            "equivocation",
+        ),
+        adversity=(
+            "gossip-faults:kind=drop,p=0.12,start=6,end=28",
+            "device-faults:delay=0.02,start=10,end=14",
+            "byzantine-sync",
+            "kill-recovery:at=24",
+        ),
+        slo={
+            "min_finalized_advance": 1,
+            "min_breaker_transitions": 1,
+            "min_slashings_detected": 1,
+        },
+    ),
+    # The same run with the circuit breaker disabled (failure threshold
+    # parked at infinity): the device-fault window must now blow the
+    # device-retry budget — proof the SLO gates catch regressions.
+    "mainnet-shape-degraded": ScenarioSpec(
+        name="mainnet-shape-degraded",
+        seed=7,
+        n_nodes=4,
+        n_validators=32,
+        epochs=6,
+        breaker_enabled=False,
+        traffic=(
+            "attestation-flood",
+            "deposit-queue",
+            "proposer-reorg",
+            "equivocation",
+        ),
+        adversity=(
+            "gossip-faults:kind=drop,p=0.12,start=6,end=28",
+            "device-faults:delay=0.02,start=10,end=14",
+            "byzantine-sync",
+            "kill-recovery:at=24",
+        ),
+        slo={
+            "min_finalized_advance": 1,
+            "require_breaker_recovered": False,
+        },
+    ),
+}
+
+
+def parse_scenario_arg(arg: str) -> ScenarioSpec:
+    """Resolve a CLI ``--scenario`` argument: ``name[:key=val,...]``.
+
+    Supported overrides: ``seed`` (int).  Examples::
+
+        --scenario smoke
+        --scenario mainnet-shape:seed=99
+    """
+    name, _, rest = arg.partition(":")
+    name = name.strip()
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r}; have {sorted(SCENARIOS)}"
+        )
+    spec = SCENARIOS[name]
+    if rest:
+        for kv in rest.split(","):
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k == "seed":
+                spec = spec.with_seed(int(v))
+            else:
+                raise ValueError(
+                    f"unknown scenario override {k!r} in {arg!r}"
+                )
+    return spec

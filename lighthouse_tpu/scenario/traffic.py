@@ -1,0 +1,180 @@
+"""Traffic shapes: the mainnet-shaped load a scenario drives through nodes.
+
+Each shape is a small object with optional hooks the engine calls every
+slot.  A shape may *replace* the base proposal at specific slots
+(``proposes``/``propose`` — proposer reorgs, equivocations) or piggyback
+on the honest flow (``on_attestations`` — floods) or rewire services at
+install time (``install`` — the shared eth1 deposit queue).
+"""
+
+from __future__ import annotations
+
+
+class Shape:
+    name = ""
+
+    def install(self, engine) -> None:
+        """One-time setup before slot 0 (wire services, queue deposits)."""
+
+    def proposes(self, engine, slot: int) -> bool:
+        """True when this shape replaces the base proposal at ``slot``."""
+        return False
+
+    def propose(self, engine, slot: int):
+        raise NotImplementedError
+
+    def on_attestations(self, engine, slot: int, atts: list) -> None:
+        """Called after the honest committees attested at ``slot``."""
+
+    def finalize(self, engine) -> None:
+        """End-of-run bookkeeping into the engine report."""
+
+
+class AttestationFlood(Shape):
+    """Epoch-boundary attestation floods at committee fan-out.
+
+    Every attestation seen during an epoch is replayed into the
+    BeaconProcessor once per node at the epoch's last slot — the
+    burst a real network produces when every subnet's aggregates land
+    around the boundary.  Under a tripped breaker these are exactly the
+    GOSSIP_ATTESTATION events the scheduler sheds, which is what the
+    shed-rate SLO measures.
+    """
+
+    name = "attestation-flood"
+
+    def __init__(self):
+        self._window: list = []
+        self.flooded = 0
+
+    def on_attestations(self, engine, slot: int, atts: list) -> None:
+        self._window.extend(atts)
+        if (slot + 1) % engine.slots_per_epoch != 0:
+            return
+        fan_out = len(engine.sim.nodes)
+        for att in self._window:
+            for _ in range(fan_out):
+                engine.enqueue_attestation(att)
+        self.flooded += len(self._window) * fan_out
+        engine.note("attestation-flood", slot=slot,
+                    burst=len(self._window) * fan_out)
+        self._window = []
+
+    def finalize(self, engine) -> None:
+        engine.run_facts["attestations_flooded"] = self.flooded
+
+
+class DepositQueue(Shape):
+    """A deposit queue draining through eth1 voting.
+
+    One shared :class:`Eth1Service` is wired onto every node's chain with
+    a batch of top-up deposits (existing validator pubkeys, so the
+    transition's signature check is skipped on the top-up path) and a
+    single eth1 block carrying the final deposit root.  Nothing is
+    inserted after install — DepositTree proofs are always against the
+    tree's *current* root, so a growing tree would invalidate proofs for
+    the already-voted block.  Blocks vote for it every slot; once the
+    vote clears the period majority the transition demands the pending
+    deposits in every subsequent block (the ``expected_deposits`` check).
+    """
+
+    name = "deposit-queue"
+    n_topups = 4
+    topup_gwei = 1_000_000_000  # 1 ETH per top-up
+
+    def install(self, engine) -> None:
+        from ..beacon.eth1 import Eth1Block, Eth1Service
+        from ..consensus.containers import DepositData
+
+        spec = engine.sim.spec
+        state = engine.sim.nodes[0].chain.head_state()
+        self._base = int(state.eth1_deposit_index)
+        svc = Eth1Service(spec)
+        for j in range(self.n_topups):
+            v = state.validators[j % len(state.validators)]
+            svc.deposit_cache.insert_log(
+                self._base + j,
+                DepositData(
+                    pubkey=bytes(v.pubkey),
+                    withdrawal_credentials=bytes(v.withdrawal_credentials),
+                    amount=self.topup_gwei,
+                ),
+            )
+        svc.insert_block(
+            Eth1Block(
+                number=1,
+                hash=b"\xe1" * 32,
+                timestamp=0,
+                deposit_count=svc.deposit_cache.count(),
+                deposit_root=svc.deposit_cache.deposit_root(),
+            )
+        )
+        for node in engine.sim.nodes:
+            node.chain.eth1 = svc
+        engine.note("deposit-queue", queued=self.n_topups)
+
+    def finalize(self, engine) -> None:
+        state = engine.sim.nodes[0].chain.head_state()
+        engine.run_facts["deposits_applied"] = (
+            int(state.eth1_deposit_index) - self._base
+        )
+
+
+class ProposerReorg(Shape):
+    """At ``slot_at`` the proposer builds on the head's *parent* instead
+    of the head — a one-block reorg attempt whose sibling competes in
+    fork choice.  Whether it wins or loses, every node must keep
+    converging through the competing branches."""
+
+    name = "proposer-reorg"
+    slot_at = 12
+
+    def proposes(self, engine, slot: int) -> bool:
+        return slot == self.slot_at
+
+    def propose(self, engine, slot: int):
+        node = engine.sim.proposer_node(slot)
+        parent = bytes(
+            node.chain.head_state().latest_block_header.parent_root
+        )
+        signed = engine.sim.propose_on(slot, parent)
+        engine.note("proposer-reorg", slot=slot,
+                    parent=parent.hex()[:16])
+        return signed
+
+
+class Equivocation(Shape):
+    """At ``slot_at`` the scheduled proposer double-proposes (same slot,
+    same parent, differing graffiti) — the slashable offence the in-node
+    slashers must detect, turn into a ProposerSlashing, and get included
+    on-chain, all without stalling honest head convergence."""
+
+    name = "equivocation"
+    slot_at = 21
+
+    def proposes(self, engine, slot: int) -> bool:
+        return slot == self.slot_at
+
+    def propose(self, engine, slot: int):
+        a, _b = engine.sim.propose_equivocation(slot)
+        engine.note("equivocation", slot=slot,
+                    proposer=int(a.message.proposer_index))
+        return a
+
+
+SHAPES = {
+    cls.name: cls
+    for cls in (AttestationFlood, DepositQueue, ProposerReorg, Equivocation)
+}
+
+
+def build_shapes(names) -> list[Shape]:
+    out = []
+    for name in names:
+        cls = SHAPES.get(name)
+        if cls is None:
+            raise ValueError(
+                f"unknown traffic shape {name!r}; have {sorted(SHAPES)}"
+            )
+        out.append(cls())
+    return out

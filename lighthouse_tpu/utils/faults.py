@@ -18,6 +18,8 @@ the stack:
   ``store.put``             each SlabStore append (L2) — I/O errors and
                             torn writes (crash mid-``fwrite``)
   ``store.flush``           SlabStore fsync (L2) — failed durability point
+  ``gossip.route``          each simulator-mesh gossip delivery (L5) —
+                            lossy / bit-flipping wire hops per peer
 
 A site that nothing armed costs one dict lookup (an unarmed ``fire`` is a
 no-op), so production paths keep the hooks compiled in — the same sites
@@ -56,16 +58,28 @@ the encoded chunk list — beacon/sync.py and beacon/node.py):
 
 Arming is bounded: ``times=N`` auto-disarms after N firings (the breaker
 recovery tests ride this), ``probability`` makes soak tests stochastic.
+
+Determinism: construct with ``FaultInjector(seed=N)`` (or pass a
+``random.Random``) and every probability gate draws from that private
+stream — two injectors armed identically with the same seed fire the
+exact same fault sequence.  Every firing is appended to ``fired`` (a
+``(site, kind)`` sequence log) and logged with the seed, so a scenario
+report can name the seed that reproduces the run.
 """
 
 from __future__ import annotations
 
+import logging
+import random
 import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from .logging import get_logger, log_with
 from .metrics import FAULTS_INJECTED
+
+log = get_logger("lighthouse_tpu.faults")
 
 
 class FaultError(RuntimeError):
@@ -116,6 +130,7 @@ SITES = {
     "store.flush": "SlabStore fsync durability point",
     "sync.request": "SyncManager client side, decoded chunk list",
     "rpc.respond": "BeaconNode server side, encoded chunk list",
+    "gossip.route": "GossipRouter per-delivery wire hop (simulator mesh)",
 }
 
 SITE_PREFIXES = (
@@ -174,15 +189,29 @@ class FaultInjector:
     used by overflow-style sites.  Both decrement bounded arms.
     """
 
-    def __init__(self, rng: Callable[[], float] | None = None):
+    def __init__(
+        self,
+        rng: "random.Random | Callable[[], float] | None" = None,
+        seed: int | None = None,
+    ):
         self._armed: dict[str, Fault] = {}
         self._lock = threading.Lock()
         self.injected: int = 0
-        if rng is None:
-            import random
-
-            rng = random.random
-        self._rng = rng
+        #: every firing, in order, as (site, kind) — the deterministic
+        #: fault sequence a scenario report pins alongside the seed
+        self.fired: list[tuple[str, str]] = []
+        if isinstance(rng, random.Random):
+            self._rng = rng.random
+        elif rng is not None:
+            self._rng = rng
+        elif seed is not None:
+            rng = random.Random(seed)
+            self._rng = rng.random
+        else:
+            self._rng = random.random
+        #: seed behind the probability stream (None = module-global RNG,
+        #: i.e. not reproducible); recorded in every fired-fault log line
+        self.seed = seed
 
     # -- arming ------------------------------------------------------------
 
@@ -275,8 +304,18 @@ class FaultInjector:
                 if f.remaining <= 0:
                     del self._armed[site]
             self.injected += 1
+            self.fired.append((site, f.kind))
+            n = self.injected
         FAULTS_INJECTED.inc(labels=(site,))
+        log_with(log, logging.INFO, "fault fired",
+                 site=site, kind=f.kind, seed=self.seed, n=n)
         return f
+
+    def fired_sequence(self) -> tuple[tuple[str, str], ...]:
+        """Snapshot of every firing so far, in order — identical across
+        runs with the same seed and the same arming."""
+        with self._lock:
+            return tuple(self.fired)
 
     def fire(self, site: str, payload: Any = None) -> Any:
         """Apply the armed fault (raise / sleep / mutate) and return the

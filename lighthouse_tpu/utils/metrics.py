@@ -107,6 +107,47 @@ class Histogram(_Metric):
     def timer(self, labels: tuple = ()):
         return _Timer(self, labels)
 
+    def count(self, labels: tuple = ()) -> int:
+        with self._lock:
+            return int(self._values[labels])
+
+    def sum(self, labels: tuple = ()) -> float:
+        with self._lock:
+            return self._sums[labels]
+
+    def bucket_counts(self, labels: tuple = ()) -> list[int]:
+        """Snapshot of per-bucket counts (last entry = +Inf overflow).
+        SLO evaluators subtract two snapshots to get a window's
+        distribution and feed the delta back through :meth:`quantile`."""
+        with self._lock:
+            c = self._counts.get(labels)
+            return list(c) if c else [0] * (len(self.buckets) + 1)
+
+    def quantile(self, q: float, labels: tuple = (),
+                 counts: list[int] | None = None) -> float:
+        """Bucket-interpolated quantile estimate (``histogram_quantile``
+        semantics): linear within the winning bucket, clamped to the
+        highest finite edge when the rank lands in +Inf, 0.0 when empty.
+        Pass ``counts`` (e.g. a snapshot delta) to evaluate a window
+        instead of the lifetime distribution."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        if counts is None:
+            counts = self.bucket_counts(labels)
+        total = sum(counts)
+        if total <= 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        lo = 0.0
+        for edge, c in zip(self.buckets, counts):
+            cum += c
+            if c > 0 and cum >= rank:
+                frac = (rank - (cum - c)) / c
+                return lo + (edge - lo) * frac
+            lo = edge
+        return self.buckets[-1]  # rank fell in the +Inf bucket
+
 
 class _Timer:
     def __init__(self, hist: Histogram, labels: tuple):
@@ -161,6 +202,25 @@ TASKS_ABANDONED = Counter(
     "executor_tasks_abandoned_total",
     "Supervised tasks that exhausted their restart cap, by name",
     ("name",),
+)
+
+# ---------------------------------------------------------------------------
+# Latency histograms (p50/p99 exported): the scenario harness's primary SLO
+# inputs.  Block-import covers the whole process_block pipeline (gossip/RPC
+# arrival through fork choice + store flush); verify-batch covers one trip
+# through the ResilientVerifier ladder (device attempt(s), bisection, CPU
+# fallback included) so breaker regressions show up as tail-latency blowups.
+# ---------------------------------------------------------------------------
+
+BLOCK_IMPORT_LATENCY = Histogram(
+    "block_import_latency_seconds",
+    "End-to-end block import latency (process_block entry to fork choice "
+    "update + store durability point)",
+)
+VERIFY_BATCH_LATENCY = Histogram(
+    "verify_batch_latency_seconds",
+    "ResilientVerifier.verify_batch wall time per batch (device retries, "
+    "infra bisection, and CPU fallback included)",
 )
 
 # ---------------------------------------------------------------------------
@@ -308,6 +368,16 @@ def render() -> str:
                         f"{m.name}_count{m._fmt_labels(labels)} "
                         f"{int(m._values[labels])}"
                     )
+                    # quantile export (p50/p99): summary-style convenience
+                    # samples next to the raw buckets, so SLO gates and
+                    # dashboards read latency percentiles straight off the
+                    # scrape without a histogram_quantile() evaluator
+                    for q, suffix in ((0.5, "p50"), (0.99, "p99")):
+                        est = m.quantile(q, counts=list(counts))
+                        out.append(
+                            f"{m.name}_{suffix}{m._fmt_labels(labels)} "
+                            f"{est:.6g}"
+                        )
         else:
             for labels, v in m.samples():
                 out.append(f"{m.name}{m._fmt_labels(labels)} {v}")

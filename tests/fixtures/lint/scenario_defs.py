@@ -1,0 +1,10 @@
+"""Fixture scenario registry (stands in for scenario/spec.py SCENARIOS).
+
+Deliberately a plain ``SCENARIOS = {...}`` assignment — the live repo
+uses the annotated form, so the corpus covers the other AST shape the
+lint must parse."""
+
+SCENARIOS = {
+    "smoke-fixture": object(),
+    "soak-fixture": object(),
+}

@@ -125,6 +125,49 @@ class TestFaultInjector:
         inj.fire("s")  # rng 0.99 >= 0.5: no fire
         assert inj.injected == 0
 
+    def test_same_seed_same_fault_sequence(self):
+        """Determinism regression: two injectors built from the same seed
+        and armed identically fire the exact same (site, kind) sequence —
+        the property every scenario report's reproduction pins on."""
+
+        def run(seed):
+            inj = FaultInjector(seed=seed)
+            inj.arm("gossip.route", "drop", probability=0.4)
+            inj.arm("processor.verify", "error", probability=0.3)
+            for i in range(60):
+                site = ("gossip.route", "processor.verify")[i % 2]
+                try:
+                    inj.fire(site, payload=i)
+                except Exception:
+                    pass
+            return inj.fired_sequence()
+
+        a, b = run(7), run(7)
+        assert a == b and len(a) > 0
+        assert run(8) != a  # a different seed draws a different stream
+
+    def test_full_probability_consumes_no_rng(self):
+        """p=1.0 faults must not draw from the seeded stream, so their
+        firing count can't skew later probabilistic sites."""
+        draws = {"n": 0}
+
+        def rng():
+            draws["n"] += 1
+            return 0.0
+
+        inj = FaultInjector(rng=rng)
+        inj.arm("s", "slow", delay=0.0)  # probability defaults to 1.0
+        for _ in range(5):
+            inj.fire("s")
+        assert draws["n"] == 0 and inj.injected == 5
+
+    def test_seed_recorded_and_logged_sequence_snapshot(self):
+        inj = FaultInjector(seed=123)
+        assert inj.seed == 123
+        inj.arm("s", "slow", delay=0.0)
+        inj.fire("s")
+        assert inj.fired_sequence() == (("s", "slow"),)
+
     def test_arm_from_spec(self):
         inj = FaultInjector()
         inj.arm_from_spec("bls.device_verify=errorx3")

@@ -109,6 +109,38 @@ class TestMetrics:
         assert h.value() if hasattr(h, "value") else True
         assert int(h._values[()]) == 1
 
+    def test_histogram_quantiles_interpolate(self):
+        h = Histogram("test_q_seconds", "q", buckets=(0.1, 1.0, 10.0))
+        for _ in range(50):
+            h.observe(0.05)  # first bucket
+        for _ in range(50):
+            h.observe(0.5)  # second bucket
+        # p50 falls exactly at the first bucket's upper edge
+        assert h.quantile(0.5) == pytest.approx(0.1)
+        # p99: rank 99 of 100, 49/50 through the (0.1, 1.0] bucket
+        assert h.quantile(0.99) == pytest.approx(0.1 + 0.9 * 49 / 50)
+        assert h.count() == 100
+        assert h.sum() == pytest.approx(50 * 0.05 + 50 * 0.5)
+
+    def test_histogram_quantile_edge_cases(self):
+        h = Histogram("test_qe_seconds", "q", buckets=(1.0, 2.0))
+        assert h.quantile(0.99) == 0.0  # empty: no estimate
+        h.observe(100.0)  # lands in +Inf
+        # the +Inf bucket clamps to the highest finite edge
+        assert h.quantile(0.99) == pytest.approx(2.0)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+        # explicit counts override the live buckets (delta quantiles)
+        assert h.quantile(0.5, counts=[3, 0, 0]) == pytest.approx(0.5)
+
+    def test_histogram_render_exports_p50_p99(self):
+        h = Histogram("test_render_q_seconds", "q", buckets=(0.1, 1.0))
+        for _ in range(10):
+            h.observe(0.05)
+        text = render()
+        assert "test_render_q_seconds_p50" in text
+        assert "test_render_q_seconds_p99" in text
+
 
 class TestLogging:
     def test_structured_fields_and_ring(self):

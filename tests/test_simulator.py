@@ -4,6 +4,9 @@ disconnected-topic node falls behind (checks.rs-style liveness)."""
 
 from lighthouse_tpu.beacon.simulator import Simulator
 from lighthouse_tpu.consensus.spec import MINIMAL
+from lighthouse_tpu.consensus.state_processing.per_block import (
+    BlockProcessingError,
+)
 
 
 def test_three_nodes_converge_and_finalize():
@@ -15,6 +18,40 @@ def test_three_nodes_converge_and_finalize():
     assert all(f >= 1 for f in fins), f"every node must finalize, got {fins}"
     slots = [int(n.chain.head_state().slot) for n in sim.nodes]
     assert len(set(slots)) == 1
+
+
+def test_equivocation_detected_slashed_and_chain_converges():
+    """Slashable equivocation e2e: one node double-proposes (same slot,
+    same parent, differing graffiti); every node's in-node slasher
+    detects the conflicting headers off gossip, the resulting
+    ProposerSlashing reaches an op pool, a later proposal includes it,
+    and the offender ends up slashed ON-CHAIN — all without stalling
+    honest head convergence or finalization."""
+    sim = Simulator(n_nodes=2, n_validators=16, slasher=True)
+    sim.run_slots(1, 4)
+    a, b = sim.propose_equivocation(5)
+    assert a.message.slot == b.message.slot == 5
+    assert bytes(a.message.parent_root) == bytes(b.message.parent_root)
+    assert a.message.root() != b.message.root()
+    found = sim.poll_slashers()
+    assert found >= 1, "conflicting headers must yield a proposer slashing"
+    # keep running: a later block must carry the slashing on-chain; once
+    # it does, the offender's own proposal slots become MISSED slots
+    # (production refuses to propose as a slashed validator) — committees
+    # still attest, so liveness continues
+    for slot in range(6, 6 + 4 * MINIMAL.slots_per_epoch):
+        try:
+            sim.run_slot(slot)
+        except BlockProcessingError:
+            sim.attest(slot)
+    heads = sim.heads()
+    assert len(set(heads)) == 1, "equivocation must not stall convergence"
+    state = sim.nodes[0].chain.head_state()
+    offender = int(a.message.proposer_index)
+    assert state.validators[offender].slashed, (
+        "the equivocating proposer must be slashed on-chain"
+    )
+    assert all(f >= 1 for f in sim.finalized_epochs())
 
 
 def test_gossip_carries_all_blocks():

@@ -138,6 +138,49 @@ def test_chaos_spec_fires_on_bad_kind_and_unknown_site(corpus_result):
     assert not any("<site>" in s for s in symbols)
 
 
+def test_scenario_spec_fires_on_unknown_name_only(corpus_result):
+    vios = _by_rule(corpus_result)["scenario-spec"]
+    symbols = {v.symbol for v in vios}
+    assert symbols == {"nonexistent-fixture"}  # the two valid names pass
+    # the `--scenario <name>` usage template is skipped
+    assert not any("<name>" in s for s in symbols)
+
+
+def test_doc_metric_regex_catches_unregistered_seconds(corpus_result):
+    symbols = {v.symbol for v in _by_rule(corpus_result)["metrics-registry"]}
+    assert "fixture_ghost_seconds" in symbols
+
+
+def test_scenario_defs_parses_both_assignment_shapes():
+    from lighthouse_tpu.analysis.registry_lint import scenario_defs
+
+    plain = 'SCENARIOS = {\n    "a": 1,\n    "b": 2,\n}\n'
+    annotated = 'SCENARIOS: dict[str, int] = {\n    "c": 3,\n}\n'
+    assert set(scenario_defs(plain, "x.py")) == {"a", "b"}
+    assert set(scenario_defs(annotated, "x.py")) == {"c"}
+
+
+def test_scenario_family_skipped_when_defs_absent():
+    # fixture-style corpora without a scenario registry must not trip
+    # the family (registry_lint.run skips it when the file is missing)
+    from lighthouse_tpu.analysis import registry_lint
+
+    docs = [("doc.md", "use `--scenario anything-goes` here")]
+    vios = registry_lint.run(
+        {}, docs, metrics_defs_path=None,
+        faults_defs_path=None, scenarios_defs_path="missing/spec.py",
+    )
+    assert not [v for v in vios if v.rule == "scenario-spec"]
+
+
+def test_live_scenario_registry_matches_docs(live_result):
+    # the live audit wires scenario/spec.py in by default; a clean run
+    # proves every --scenario example in README/docs names a real spec
+    assert not [
+        v for v in live_result.violations if v.rule == "scenario-spec"
+    ]
+
+
 def test_host_sync_lint_fires_only_on_registered_functions(corpus_result):
     vios = [
         v for v in _by_rule(corpus_result)["jaxpr-hygiene"]

@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Run a named adversarial scenario and gate it on its SLOs.
+
+Front-end for ``lighthouse_tpu.scenario``: resolves a scenario from the
+``SCENARIOS`` registry (``--list`` shows them), runs the engine, prints
+each SLO verdict, optionally writes the full JSON report, and appends a
+``scenario`` row to BENCH_HISTORY.jsonl.  Exit status is 0 iff every SLO
+assertion passed.
+
+Reproduction: the report records the seed and the fired-fault sequence;
+re-running the same name with the same seed replays the identical run
+(the fingerprint line must match).
+
+Usage:
+    tools/pyrun tools/scenario_run.py --list
+    tools/pyrun tools/scenario_run.py --scenario smoke
+    tools/pyrun tools/scenario_run.py --scenario mainnet-shape --json /tmp/r.json
+    tools/pyrun tools/scenario_run.py --scenario mainnet-shape:seed=99 --no-history
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", metavar="NAME[:seed=N]",
+                    help="scenario to run (see --list); an optional "
+                         ":seed=N override reruns it under another seed")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered scenarios and exit")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full JSON report to PATH")
+    ap.add_argument("--no-history", action="store_true",
+                    help="do not append a scenario row to BENCH_HISTORY.jsonl")
+    args = ap.parse_args(argv)
+
+    from lighthouse_tpu.scenario import SCENARIOS, parse_scenario_arg
+    from lighthouse_tpu.scenario.engine import ScenarioEngine
+
+    if args.list:
+        for name, spec in SCENARIOS.items():
+            print(f"{name:24s} seed={spec.seed} nodes={spec.n_nodes} "
+                  f"epochs={spec.epochs} traffic={','.join(spec.traffic)} "
+                  f"adversity={len(spec.adversity)} tracks")
+        return 0
+    if not args.scenario:
+        ap.error("--scenario NAME required (or --list)")
+
+    spec = parse_scenario_arg(args.scenario)
+    history = None if args.no_history else os.path.join(
+        ROOT, "BENCH_HISTORY.jsonl"
+    )
+    report = ScenarioEngine(
+        spec, out_path=args.json, history_path=history
+    ).run()
+
+    for s in report["slo"]:
+        verdict = "ok  " if s["ok"] else "FAIL"
+        detail = f"  ({s['detail']})" if s["detail"] and not s["ok"] else ""
+        print(f"  {verdict} {s['name']:22s} {s['observed']} "
+              f"(threshold {s['threshold']}){detail}")
+    verdict = "PASS" if report["pass"] else "FAIL"
+    print(f"scenario {report['scenario']}: {verdict}  "
+          f"seed={report['seed']} fingerprint={report['fingerprint']} "
+          f"slots={report['slots']} faults={len(report['fired_faults'])} "
+          f"elapsed={report['elapsed_s']}s")
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
