@@ -111,7 +111,20 @@ def run_measurement(force_cpu: bool) -> None:
     )
 
     args = jax.device_put(args, dev)
-    fn = jax.jit(_verify_kernel)
+    # traced_jit: the compile lands in the flight recorder as a
+    # jit.compile span with the program fingerprint, feeding the
+    # compile-time BENCH_HISTORY row below
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import (
+        program_fingerprint,
+        traced_jit,
+    )
+
+    fn = traced_jit(
+        _verify_kernel,
+        program_fingerprint(
+            _verify_kernel.__name__, B=B, device_h2c=device_h2c
+        ),
+    )
     t0 = time.time()
     disarm = _arm_watchdog(compile_timeout, f"compile B={B}")
     ok = fn(*args)
@@ -152,8 +165,25 @@ def run_measurement(force_cpu: bool) -> None:
     }
     if os.environ.get("BENCH_PIPELINE", "") == "1":
         result["pipeline"] = _measure_pipeline(B, device_h2c)
+    # every jit.compile span recorded this run, with per-program
+    # fingerprints — the compile-time attribution ROADMAP item 4 asks for
+    from lighthouse_tpu.obs import TRACER
+    from lighthouse_tpu.obs import report as trace_report
+
+    compiles = trace_report.compile_events(
+        TRACER.chrome_trace()["traceEvents"]
+    )
+    if compiles:
+        result["compile_events"] = compiles
+        for c in compiles:
+            print(
+                f"jit.compile {c.get('fingerprint', '?')} "
+                f"{c['seconds']:.1f}s {c.get('kernel', '')}",
+                file=sys.stderr,
+            )
     if "TPU" in str(dev):
         _record_tpu_history(result)
+        _record_compile_history(result)
     print(json.dumps(result), flush=True)
 
 
@@ -199,6 +229,10 @@ def _measure_pipeline(B: int, device_h2c: bool) -> dict:
     for b in batches:
         assert backend.verify_signature_sets(b)
     serial = time.time() - t0
+    from lighthouse_tpu.obs import TRACER
+    from lighthouse_tpu.obs import report as trace_report
+
+    mark = TRACER.mark()
     t0 = time.time()
     outs = pv.verify_stream(batches)
     piped = time.time() - t0
@@ -211,7 +245,38 @@ def _measure_pipeline(B: int, device_h2c: bool) -> dict:
         "speedup": round(serial / piped, 3) if piped > 0 else None,
         "device_occupancy_pct": round(M.PIPELINE_OCCUPANCY.value(), 1),
     }
+    # per-stage attribution from the flight recorder: marshal/dispatch/
+    # resolve p50/p99 plus overlap efficiency (wall / max(marshal, device),
+    # 1.0 = perfect overlap) over the spans of the pipelined run only
+    events = TRACER.chrome_trace(since_sid=mark)["traceEvents"]
+    attr = trace_report.attribution(events)
+    out["stages"] = {
+        name: {
+            "count": st["count"],
+            "p50_ms": round(st["p50_s"] * 1000, 3),
+            "p99_ms": round(st["p99_s"] * 1000, 3),
+            "total_s": st["total_s"],
+        }
+        for name, st in attr["stages"].items()
+        if name.startswith("pipeline.") or name.startswith("verify.")
+    }
+    out["overlap_efficiency"] = attr["overlap"]
+    out["host_share"] = attr["share"]["host_share"]
     print(f"pipeline A/B: {out}", file=sys.stderr)
+    print("pipeline stage attribution (tracer):", file=sys.stderr)
+    for name, st in sorted(out["stages"].items()):
+        print(
+            f"  {name:20s} n={st['count']:<4d} p50={st['p50_ms']:.3f}ms "
+            f"p99={st['p99_ms']:.3f}ms total={st['total_s']:.3f}s",
+            file=sys.stderr,
+        )
+    ov = out["overlap_efficiency"]
+    if ov.get("ratio") is not None:
+        print(
+            f"  overlap efficiency {ov['ratio']:.3f} (mode={ov['mode']}, "
+            "1.0 = perfect overlap)",
+            file=sys.stderr,
+        )
     return out
 
 
@@ -230,6 +295,28 @@ def _record_tpu_history(result: dict) -> None:
         entry["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         with open(_history_path(), "a") as f:
             f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
+
+
+def _record_compile_history(result: dict) -> None:
+    """Append a kind="compile" row per program so compile-time
+    regressions show in BENCH_HISTORY the same way throughput does."""
+    try:
+        with open(_history_path(), "a") as f:
+            for c in result.get("compile_events", []):
+                row = {
+                    "kind": "compile",
+                    "fingerprint": c.get("fingerprint"),
+                    "kernel": c.get("kernel"),
+                    "seconds": c["seconds"],
+                    "device": result.get("device"),
+                    "batch": result.get("batch"),
+                    "measured_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    ),
+                }
+                f.write(json.dumps(row) + "\n")
     except OSError:
         pass
 

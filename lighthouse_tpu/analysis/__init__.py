@@ -6,7 +6,8 @@ unwaivered violation:
 
 * ``lock_lint``     — lock-discipline race detector + lock-order graph
 * ``raise_lint``    — never-raise proofs + broad-except ban
-* ``registry_lint`` — metrics / fault-site / chaos-spec consistency
+* ``registry_lint`` — metrics / fault-site / chaos-spec / trace-span
+  consistency
 * ``jaxpr_lint``    — dispatch hot-path host-sync ban (the jaxpr walk
   and zero-dim guard live here too, but tracing is driven by
   ``tools/dispatch_audit.py`` and the test suite, not by the audit —
@@ -58,6 +59,7 @@ class AuditConfig:
     metrics_defs: str = "lighthouse_tpu/utils/metrics.py"
     faults_defs: str = "lighthouse_tpu/utils/faults.py"
     scenarios_defs: str = "lighthouse_tpu/scenario/spec.py"
+    spans_defs: str = "lighthouse_tpu/obs/tracer.py"
     docs: tuple = ("README.md", "STATUS.md")
     hot_path: dict = field(
         default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
@@ -174,6 +176,8 @@ def load_config(path: str) -> AuditConfig:
         cfg.faults_defs = a["faults_defs"]
     if "scenarios_defs" in a:
         cfg.scenarios_defs = a["scenarios_defs"]
+    if "spans_defs" in a:
+        cfg.spans_defs = a["spans_defs"]
     if "docs" in a:
         cfg.docs = tuple(a["docs"])
     if "site_scan_exclude" in a:
@@ -246,6 +250,7 @@ def run_audit(
             files, docs, cfg.metrics_defs, cfg.faults_defs,
             cfg.site_scan_exclude,
             scenarios_defs_path=cfg.scenarios_defs,
+            spans_defs_path=cfg.spans_defs,
         ))
 
     if "jaxpr" in cfg.families:

@@ -22,9 +22,14 @@ Three cross-reference families, all driven off the canonical registries:
   exactly the way chaos specs are validated; ``:key=val`` overrides are
   stripped first.  The registry is AST-parsed, never imported, so it
   must stay a literal dict.
+* **span-registry** — every literal span name passed to
+  ``.span("...")`` / ``.instant("...")`` must appear in the canonical
+  ``SPANS`` registry (obs/tracer.py), and every registered span must
+  actually be opened somewhere (no orphaned registrations) — the same
+  both-direction cross-reference the fault-site family enforces.
 
-The docs cross-check covers ``*_total`` and ``*_seconds`` metric tokens
-(counters and histograms both).
+The docs cross-check covers ``*_total``, ``*_seconds`` and ``*_percent``
+metric tokens (counters, histograms and gauges).
 """
 
 from __future__ import annotations
@@ -37,8 +42,9 @@ from .report import Violation
 
 _METRIC_FACTORIES = {"Counter", "Gauge", "Histogram"}
 _FIRE_METHODS = {"fire", "check", "maybe_fire"}
+_SPAN_METHODS = {"span", "instant"}
 _UPPER = re.compile(r"^[A-Z][A-Z0-9_]*$")
-_DOC_METRIC = re.compile(r"\b([a-z][a-z0-9_]*_(?:total|seconds))\b")
+_DOC_METRIC = re.compile(r"\b([a-z][a-z0-9_]*_(?:total|seconds|percent))\b")
 _DOC_SPEC = re.compile(r"--chaos[ =]+([^\s`'\")]+)")
 _DOC_SCENARIO = re.compile(r"--scenario[ =]+([^\s`'\")]+)")
 
@@ -323,6 +329,106 @@ def fault_site_violations(
     return out
 
 
+# -- trace spans ---------------------------------------------------------
+
+
+def span_defs(src: str, path: str) -> dict[str, int]:
+    """AST-parse the literal ``SPANS`` dict's string keys from the
+    canonical span registry module (never imported — it must stay a
+    literal dict, same contract as SCENARIOS)."""
+    tree = ast.parse(src, filename=path)
+    names: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            targets = (
+                [node.target] if isinstance(node.target, ast.Name) else []
+            )
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        else:
+            continue
+        if not any(t.id == "SPANS" for t in targets):
+            continue
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    names[k.value] = k.lineno
+    return names
+
+
+def _span_call_sites(src: str, path: str):
+    """[(name, line)] for every ``.span("...")``/``.instant("...")`` call
+    whose first argument is a string literal.  Dynamic names are skipped
+    (the tracer API takes literal names only; ``re.Match.span(int)``-style
+    collisions carry non-str first args and never match)."""
+    out = []
+    for node in ast.walk(ast.parse(src, filename=path)):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if name not in _SPAN_METHODS:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.lineno))
+    return out
+
+
+def span_violations(
+    files, spans_defs_path, exclude_prefixes=("tests/",)
+) -> list[Violation]:
+    """Both directions, exactly like fault sites: an instrumentation
+    site naming an unregistered span, and a registered span that no
+    instrumentation site ever opens."""
+    files = dict(files)
+    out: list[Violation] = []
+    defs_src = files.get(spans_defs_path)
+    if defs_src is None:
+        return [Violation(
+            rule="span-registry", path=spans_defs_path, line=0,
+            symbol="obs/tracer.py",
+            message="span registry file not found in scan set",
+        )]
+    spans = span_defs(defs_src, spans_defs_path)
+    if not spans:
+        return [Violation(
+            rule="span-registry", path=spans_defs_path, line=0,
+            symbol="SPANS",
+            message="canonical SPANS registry missing or empty",
+        )]
+    used: set[str] = set()
+    for display, src in files.items():
+        if display == spans_defs_path or display.startswith(
+            tuple(exclude_prefixes)
+        ):
+            continue
+        for name, line in _span_call_sites(src, display):
+            if name in spans:
+                used.add(name)
+            else:
+                out.append(Violation(
+                    rule="span-registry", path=display, line=line,
+                    symbol=name,
+                    message=(
+                        f"span {name!r} opened but not in the canonical "
+                        f"SPANS registry"
+                    ),
+                ))
+    for name, line in sorted(spans.items()):
+        if name not in used:
+            out.append(Violation(
+                rule="span-registry", path=spans_defs_path, line=line,
+                symbol=name,
+                message=f"registered span {name!r} is never opened",
+            ))
+    return out
+
+
 # -- chaos specs ---------------------------------------------------------
 
 
@@ -427,13 +533,18 @@ def scenario_spec_violations(docs, known_names) -> list[Violation]:
 def run(
     files, docs, metrics_defs_path, faults_defs_path,
     site_scan_exclude=("tests/",), spec_validator=None,
-    scenarios_defs_path=None,
+    scenarios_defs_path=None, spans_defs_path=None,
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
     out.extend(
         fault_site_violations(files, faults_defs_path, site_scan_exclude)
     )
+    if spans_defs_path is not None and files.get(spans_defs_path) is not None:
+        # absent in older fixture corpora: skip the family, don't flag it
+        out.extend(
+            span_violations(files, spans_defs_path, site_scan_exclude)
+        )
     defs_src = files.get(faults_defs_path)
     if defs_src is not None:
         sites, prefixes = fault_site_defs(defs_src, faults_defs_path)

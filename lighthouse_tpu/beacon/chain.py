@@ -34,6 +34,7 @@ from ..consensus.state_processing.per_block import (
 from ..consensus.state_processing.forks import state_fork_name
 from ..consensus.state_processing.per_slot import process_slots
 from ..crypto.bls import api as bls
+from ..obs.tracer import TRACER
 from ..store import HotColdDB
 from ..utils import Counter, get_logger, log_with
 from ..utils.metrics import BLOCK_IMPORT_LATENCY
@@ -269,7 +270,8 @@ class BeaconChain:
         also run the rungs as separate pipeline stages.  Returns the block
         root.  ``from_rpc``: sync/RPC imports skip the gossip-tier clock
         check (the reference's gossip vs rpc block entry distinction)."""
-        with BLOCK_IMPORT_LATENCY.timer():
+        with BLOCK_IMPORT_LATENCY.timer(), TRACER.span(
+                "block.import", slot=int(signed_block.message.slot)):
             # proposal signature rides the bulk batch (one device call for
             # the whole block) rather than the gossip tier's single verify
             gvb = self.gossip_verify_block(

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from enum import Enum, auto
 from typing import Any, Callable
 
+from ..obs.tracer import TRACER
 from ..utils.logging import get_logger
 
 log = get_logger("processor")
@@ -333,6 +334,12 @@ class CircuitBreaker:
         from ..utils.metrics import BREAKER_TRANSITIONS
 
         BREAKER_TRANSITIONS.inc(labels=(state.name,))
+        TRACER.instant("breaker.transition", state=state.name)
+        if state is BreakerState.OPEN:
+            # device-down is exactly the moment the flight recorder's recent
+            # history matters: leave an artifact (no-op unless a dump dir is
+            # configured; never raises)
+            TRACER.maybe_dump("breaker-open")
 
     @property
     def is_closed(self) -> bool:
@@ -447,7 +454,8 @@ class ResilientVerifier:
         try:
             from ..utils.metrics import VERIFY_BATCH_LATENCY
 
-            with VERIFY_BATCH_LATENCY.timer():
+            with VERIFY_BATCH_LATENCY.timer(), TRACER.span(
+                    "verify.batch", sets=len(sets)):
                 budget = RetryBudget(
                     attempts=self.max_device_attempts,
                     deadline=self.now() + self.retry_deadline,
@@ -479,7 +487,8 @@ class ResilientVerifier:
         """
         while self.breaker.allow_device() and budget.spend(self.now()):
             try:
-                out = verify_with_bisection(self._device_call, items)
+                with TRACER.span("verify.device", sets=len(items)):
+                    out = verify_with_bisection(self._device_call, items)
             except Exception:  # noqa: BLE001 — infrastructure, not verdict
                 from ..utils.metrics import VERIFY_DEVICE_RETRIES
 
@@ -505,7 +514,8 @@ class ResilientVerifier:
 
         VERIFY_DEGRADED_BATCHES.inc()
         self.journal.append(("cpu", len(sets)))
-        out = verify_with_bisection(self.cpu_verify, sets)
+        with TRACER.span("verify.cpu", sets=len(sets)):
+            out = verify_with_bisection(self.cpu_verify, sets)
         return BatchOutcome(verdicts=out.verdicts, device_calls=0)
 
 
@@ -586,7 +596,8 @@ class PipelinedVerifier:
         def timed_marshal(sets):
             t0 = self.now()
             try:
-                mb = self._marshal(sets)
+                with TRACER.span("pipeline.marshal", sets=len(sets)):
+                    mb = self._marshal(sets)
             except Exception:  # noqa: BLE001 — marshal failure -> ladder
                 mb = None
             return mb, self.now() - t0
@@ -632,8 +643,9 @@ class PipelinedVerifier:
         if not self.resilient.breaker.allow_device():
             return _FALLBACK
         try:
-            self.injector.fire("processor.verify")
-            return self._dispatch(mb)
+            with TRACER.span("pipeline.dispatch"):
+                self.injector.fire("processor.verify")
+                return self._dispatch(mb)
         except Exception:  # noqa: BLE001 — infrastructure, not verdict
             self.resilient.breaker.record_failure()
             return _FALLBACK
@@ -648,7 +660,8 @@ class PipelinedVerifier:
             return self.resilient.verify_batch(sets), 0.0
         t0 = self.now()
         try:
-            ok = self._resolve(handle)
+            with TRACER.span("pipeline.resolve", sets=len(sets)):
+                ok = self._resolve(handle)
         except Exception:  # noqa: BLE001 — infrastructure, not verdict
             d = self.now() - t0
             self.resilient.breaker.record_failure()

@@ -39,6 +39,7 @@ from dataclasses import dataclass
 from enum import Enum
 
 from ..network import rpc
+from ..obs.tracer import TRACER
 from ..utils import faults as faults_mod
 from ..utils import metrics as M
 from ..utils.logging import get_logger
@@ -357,10 +358,15 @@ class SyncManager:
                 if batch.attempts > 1:
                     M.SYNC_BATCH_RETRIES.inc()
                 try:
-                    blocks = self._request(peer, batch)
-                    self._validate(batch, blocks)
-                    self._bulk_verify(blocks)
-                    self._import(blocks, peer)
+                    # one span per batch attempt: request through import
+                    # (failures carry an "error" field from the span exit)
+                    with TRACER.span("sync.batch",
+                                     start_slot=batch.start_slot,
+                                     attempt=batch.attempts):
+                        blocks = self._request(peer, batch)
+                        self._validate(batch, blocks)
+                        self._bulk_verify(blocks)
+                        self._import(blocks, peer)
                 except BatchInvalid as exc:
                     self.failed_batches += 1
                     M.SYNC_BATCHES_INVALID.inc(labels=(exc.reason,))

@@ -47,6 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "extra-blocks arm the req/resp sites, e.g. "
                          "rpc.respond=corrupt-chunk or "
                          "sync.request=stall:3.0x2 — see utils/faults.py")
+    bn.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve /metrics (Prometheus text), /health, and "
+                         "/trace (Chrome trace-event JSON of the flight "
+                         "recorder, loadable in Perfetto) on this port "
+                         "(0 = ephemeral); separate from the beacon API "
+                         "server, like the reference's http_metrics")
     bn.add_argument("--scenario", default=None,
                     metavar="NAME[:seed=N]",
                     help="run a named adversarial scenario (SLO-gated, "
@@ -165,10 +172,18 @@ def run_bn(args) -> int:
                  scenario=scn.name, seed=scn.seed)
         report = ScenarioEngine(scn).run()
         for s in report["slo"]:
-            log_with(log, logging.INFO if s["ok"] else logging.ERROR,
-                     "SLO " + ("ok" if s["ok"] else "FAIL"),
+            if s["ok"]:
+                lvl, verdict = logging.INFO, "ok"
+            elif s.get("level") == "warn":
+                lvl, verdict = logging.WARNING, "WARN"
+            else:
+                lvl, verdict = logging.ERROR, "FAIL"
+            log_with(log, lvl, f"SLO {verdict}",
                      gate=s["name"], observed=s["observed"],
                      threshold=s["threshold"])
+        if report.get("trace_dump"):
+            log_with(log, logging.WARNING, "Flight-recorder dump written",
+                     path=report["trace_dump"])
         log_with(log, logging.INFO, "Scenario finished",
                  scenario=scn.name,
                  verdict="PASS" if report["pass"] else "FAIL",
@@ -212,6 +227,14 @@ def run_bn(args) -> int:
     h = BeaconChainHarness(n_validators=args.validators, spec=spec, store=store)
     server = BeaconApiServer(h.chain, port=args.http_port)
     server.start()
+    metrics_server = None
+    if args.metrics_port is not None:
+        from .obs import MetricsServer
+
+        metrics_server = MetricsServer(port=args.metrics_port).start()
+        log_with(log, logging.INFO, "Metrics endpoint up",
+                 url=f"http://127.0.0.1:{metrics_server.port}/metrics",
+                 endpoints="/metrics,/health,/trace")
     discovery = None
     if args.discovery_port is not None:
         from .network.discv5 import Discv5Service
@@ -277,6 +300,8 @@ def run_bn(args) -> int:
             upnp.stop()  # delete the WAN mapping; stop the renewals
         if discovery is not None:
             discovery.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
         server.stop()
     return 0
 

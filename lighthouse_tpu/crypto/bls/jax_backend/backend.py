@@ -17,7 +17,10 @@ the reference's "compile the backend once, stream batches through it".
 
 from __future__ import annotations
 
+import hashlib
+import json
 import secrets
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -26,10 +29,54 @@ from .. import params
 from ..curve import Fp, G1_GENERATOR, affine_neg, from_jacobian, jac_add, to_jacobian
 from ..fields import Fp2
 from ..hash_to_curve import hash_to_g2
+from ....obs.tracer import TRACER
+from ....utils.metrics import JIT_COMPILE_SECONDS
 from . import fp as F
 from . import pairing as PR
 from . import points as P
 from . import tower as T
+
+
+def program_fingerprint(kernel: str, **attrs) -> str:
+    """Stable per-program fingerprint for compile-time attribution: the
+    kernel entry point + its static shape/config attrs + the jax version
+    and backend (the same identity the AOT cache of ROADMAP item 4 will
+    key on).  12 hex chars, sha256-derived."""
+    import jax
+
+    blob = json.dumps(
+        {"kernel": kernel, "jax": jax.__version__,
+         "backend": jax.default_backend(), **attrs},
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def traced_jit(fn, fingerprint: str, **jit_kw):
+    """``jax.jit`` wrapped so the FIRST call per cache entry — the one
+    that traces + compiles the program — is timed into the flight
+    recorder as a ``jit.compile`` span (per-program fingerprint in its
+    fields) and into ``jit_compile_seconds``.  Subsequent calls go
+    straight to the compiled callable."""
+    import jax
+
+    jitted = jax.jit(fn, **jit_kw)
+    state = {"first": True}
+
+    def call(*args):
+        if state["first"]:
+            state["first"] = False
+            t0 = time.perf_counter()
+            with TRACER.span("jit.compile", fingerprint=fingerprint,
+                             kernel=getattr(fn, "__name__", str(fn))):
+                out = jitted(*args)
+            JIT_COMPILE_SECONDS.observe(time.perf_counter() - t0)
+            return out
+        return jitted(*args)
+
+    call.jitted = jitted
+    call.fingerprint = fingerprint
+    return call
 
 
 def _tree_reduce_g2(pt):
@@ -311,7 +358,13 @@ class JaxBackend:
             donate = ()
             if jax.default_backend() == "tpu":
                 donate = tuple(range(5 if self.device_h2c else 4))
-            self._kernels[key] = jax.jit(fn, donate_argnums=donate)
+            self._kernels[key] = traced_jit(
+                fn,
+                program_fingerprint(
+                    fn.__name__, B=B, device_h2c=self.device_h2c,
+                ),
+                donate_argnums=donate,
+            )
         return self._kernels[key]
 
     # -- single/aggregate verification reuses the set machinery ------------
@@ -339,7 +392,10 @@ class JaxBackend:
         B = len(pk_pts)
         key = ("agg", B)
         if key not in self._kernels:
-            self._kernels[key] = jax.jit(_aggregate_verify_kernel)
+            self._kernels[key] = traced_jit(
+                _aggregate_verify_kernel,
+                program_fingerprint("_aggregate_verify_kernel", n=B),
+            )
         fn = self._kernels[key]
         ok = fn(
             P.g1_encode(pk_pts),

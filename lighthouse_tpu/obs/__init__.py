@@ -1,0 +1,17 @@
+"""Observability layer — twin of ``beacon_node/http_metrics`` plus a
+flight recorder the reference client does not have.
+
+* :mod:`tracer` — the process-global span tracer / ring-buffer flight
+  recorder (``TRACER``), the canonical ``SPANS`` registry, and Chrome
+  trace-event export with automatic dumps on breaker-open and scenario
+  SLO failure.
+* :mod:`http` — the ``bn --metrics-port`` scrape endpoint serving
+  ``/metrics`` (Prometheus text), ``/health`` and ``/trace``.
+* :mod:`report` — stage-attribution math (per-stage p50/p99,
+  host-vs-device share, pipeline overlap efficiency) shared by
+  ``tools/trace_report.py``, ``bench.py`` and the scenario SLO gate.
+"""
+
+from .http import MetricsServer, last_server  # noqa: F401
+from .report import attribution, overlap_efficiency  # noqa: F401
+from .tracer import SPANS, TRACER, SpanRecord, Tracer  # noqa: F401

@@ -26,6 +26,8 @@ import logging
 import random
 import time
 
+from ..obs import report as trace_report
+from ..obs.tracer import TRACER
 from ..utils.logging import get_logger, log_with
 from .adversity import build_tracks
 from .slo import MetricsSnapshot, evaluate
@@ -163,6 +165,10 @@ class ScenarioEngine:
 
     def run(self) -> dict:
         t0 = time.time()
+        # everything the flight recorder captures past this mark belongs
+        # to THIS run — the SLO-failure dump and the overlap gate read
+        # only the run's own spans
+        self._trace_mark = TRACER.mark()
         before = MetricsSnapshot()
         for shape in self.shapes:
             shape.install(self)
@@ -187,6 +193,10 @@ class ScenarioEngine:
         return self._report(before, after, total_slots, t0)
 
     def _run_slot(self, slot: int) -> None:
+        with TRACER.span("scenario.slot", slot=slot):
+            self._run_slot_inner(slot)
+
+    def _run_slot_inner(self, slot: int) -> None:
         sim = self.sim
         shape = next(
             (s for s in self.shapes if s.proposes(self, slot)), None
@@ -337,6 +347,11 @@ class ScenarioEngine:
         self.run_facts["heads"] = heads
         self.run_facts["finalized_epochs"] = fins
         self.run_facts.setdefault("breaker_closed", self.breaker.is_closed)
+        trace_mark = getattr(self, "_trace_mark", 0)
+        run_events = TRACER.chrome_trace(trace_mark)["traceEvents"]
+        self.run_facts["overlap_efficiency"] = trace_report.overlap_efficiency(
+            run_events
+        )
         deltas = after.delta(before)
         results = evaluate(
             self.spec.slo_thresholds(), deltas, self.run_facts
@@ -348,7 +363,25 @@ class ScenarioEngine:
                 sort_keys=True,
             ).encode()
         ).hexdigest()[:16]
-        ok = all(r.ok for r in results)
+        # warn-level gates are advisory: logged and reported, never the
+        # verdict (slo.SLOResult.level)
+        ok = all(r.ok for r in results if r.level == "fail")
+        trace_dump = None
+        if not ok:
+            # a failing run must leave a flight-recorder artifact: next
+            # to the JSON report when one is written, else through the
+            # configured dump dir ($LIGHTHOUSE_TPU_TRACE_DIR)
+            if self.out_path:
+                try:
+                    trace_dump = TRACER.dump(
+                        f"{self.out_path}.trace.json", since_sid=trace_mark
+                    )
+                except OSError as exc:
+                    log.warning("scenario trace dump failed: %s", exc)
+            else:
+                trace_dump = TRACER.maybe_dump(
+                    f"slo-{self.spec.name}", since_sid=trace_mark
+                )
         report = {
             "kind": "scenario",
             "scenario": self.spec.name,
@@ -357,6 +390,7 @@ class ScenarioEngine:
             "fingerprint": fingerprint,
             "slots": total_slots,
             "nodes": self.spec.n_nodes,
+            "trace_dump": trace_dump,
             "slo": [r.to_dict() for r in results],
             "metrics": deltas,
             "facts": dict(self.run_facts),

@@ -20,6 +20,9 @@ class SLOResult:
     observed: object
     threshold: object
     detail: str = ""
+    # "fail" gates decide the run verdict; "warn" gates are advisory —
+    # reported (and logged) but never flip a passing run to failed.
+    level: str = "fail"
 
     def to_dict(self) -> dict:
         return {
@@ -28,6 +31,7 @@ class SLOResult:
             "observed": self.observed,
             "threshold": self.threshold,
             "detail": self.detail,
+            "level": self.level,
         }
 
 
@@ -81,8 +85,10 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
     """
     out: list[SLOResult] = []
 
-    def gate(name, ok, observed, threshold, detail=""):
-        out.append(SLOResult(name, bool(ok), observed, threshold, detail))
+    def gate(name, ok, observed, threshold, detail="", level="fail"):
+        out.append(
+            SLOResult(name, bool(ok), observed, threshold, detail, level)
+        )
 
     t = thresholds
 
@@ -154,6 +160,20 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
         oks = [r.get("ok", False) for r in run["crash_reports"]]
         gate("crash_recovery", all(oks), oks, True,
              "every kill -9 iteration must recover committed records")
+
+    if t.get("max_overlap_wall_ratio") is not None:
+        # Trace-derived overlap efficiency (obs/report.py): wall over the
+        # busiest stage's busy time — 1.0 is perfect overlap.  Warn-level:
+        # pipeline efficiency regressions should be loud, not flaky run
+        # failures (the ratio depends on host load).
+        ov = run.get("overlap_efficiency") or {}
+        ratio = ov.get("ratio")
+        gate("overlap_efficiency",
+             ratio is None or ratio <= t["max_overlap_wall_ratio"],
+             None if ratio is None else round(ratio, 3),
+             t["max_overlap_wall_ratio"],
+             f"trace wall / max(stage busy), mode={ov.get('mode', 'empty')}",
+             level="warn")
 
     if t.get("min_slashings_detected") is not None:
         v = run.get("slashings_detected", 0)

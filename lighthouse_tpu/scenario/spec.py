@@ -46,6 +46,9 @@ DEFAULT_SLO: dict = {
     # "did the adversity actually bite" gates (None = not asserted)
     "min_breaker_transitions": None,     # breaker must have engaged
     "min_slashings_detected": None,      # slashers must have caught it
+    # trace-derived overlap efficiency (warn-level; see slo.evaluate and
+    # obs/report.py — wall / max(stage busy), 1.0 = perfect overlap)
+    "max_overlap_wall_ratio": None,
 }
 
 
@@ -125,6 +128,9 @@ SCENARIOS: dict[str, ScenarioSpec] = {
             "min_finalized_advance": 1,
             "min_breaker_transitions": 1,
             "min_slashings_detected": 1,
+            # warn-level pipeline-health gate: generous so a loaded CI
+            # host never flips it, loud when overlap truly collapses
+            "max_overlap_wall_ratio": 8.0,
         },
     ),
     # The same run with the circuit breaker disabled (failure threshold

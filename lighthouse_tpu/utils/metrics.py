@@ -324,6 +324,30 @@ PEER_BANS = Counter(
     "Peers banned after their score crossed BAN_THRESHOLD",
 )
 
+# ---------------------------------------------------------------------------
+# Observability layer (lighthouse_tpu/obs/): the flight recorder's own
+# health counters plus JIT compile-time attribution.  Compile durations
+# land both here (scrapeable histogram) and as per-program-fingerprint
+# `jit.compile` spans in the tracer ring.
+# ---------------------------------------------------------------------------
+
+TRACE_SPANS_DROPPED = Counter(
+    "trace_spans_dropped_total",
+    "Spans evicted from the flight-recorder ring past its capacity "
+    "(oldest-first)",
+)
+TRACE_DUMPS = Counter(
+    "trace_dumps_written_total",
+    "Flight-recorder dump files written (breaker-open, scenario SLO "
+    "failure, /trace is not counted)",
+)
+JIT_COMPILE_SECONDS = Histogram(
+    "jit_compile_seconds",
+    "JIT program compile wall time (first call per kernel cache key), "
+    "per-program fingerprints carried by the matching jit.compile spans",
+    buckets=(0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0, 3600.0),
+)
+
 # Per-config Pallas dispatch accounting (tools/dispatch_audit.py): distinct
 # lowered programs and stacked pallas_call dispatches in the traced verify
 # composition, labelled by backend config string (e.g. "chains+miller+h2c").

@@ -1,0 +1,8 @@
+"""Fixture span registry (stands in for obs/tracer.py SPANS).
+
+``fixture.span.orphan`` is registered but never opened (seed)."""
+
+SPANS = {
+    "fixture.span.good": "opened by spans_user.py",
+    "fixture.span.orphan": "SEED: registered but never opened",
+}

@@ -1,0 +1,17 @@
+"""Trace-span seed: opens a span missing from the canonical registry."""
+
+
+class _Tracer:
+    def span(self, name, **fields):
+        return name
+
+    def instant(self, name, **fields):
+        return name
+
+
+T = _Tracer()
+
+
+def work():
+    T.span("fixture.span.good")
+    T.instant("fixture.span.ghost")  # SEED: unregistered span

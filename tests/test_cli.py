@@ -134,3 +134,55 @@ def test_lcli_skip_slots_and_parse_ssz(tmp_path, capsys):
                  "SignedBeaconBlock", "--fork", "altair", str(p)]) == 0
     parsed = _json.loads(capsys.readouterr().out)
     assert parsed["message"]["slot"] == "77"
+
+
+def test_bn_metrics_port_serves_scrape_endpoints(capsys):
+    """`bn --metrics-port 0` boots the scrape endpoint on an ephemeral
+    port; /metrics serves known metric families in Prometheus text
+    format, /health answers ok, /trace serves Chrome trace JSON."""
+    import threading
+    import time
+    import urllib.request
+
+    from lighthouse_tpu.obs import last_server
+
+    before = last_server()
+    rc = {}
+
+    def run():
+        rc["bn"] = main([
+            "--spec", "minimal", "bn", "--validators", "16",
+            "--http-port", "0", "--metrics-port", "0", "--slots", "200",
+        ])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    srv = None
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        srv = last_server()
+        if srv is not None and srv is not before and srv.port:
+            break
+        time.sleep(0.02)
+    assert srv is not None and srv is not before, "metrics server never came up"
+
+    def get(path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=5
+        ) as resp:
+            return resp.headers.get("Content-Type"), resp.read().decode()
+
+    ctype, text = get("/metrics")
+    assert ctype.startswith("text/plain")
+    for family in ("trace_spans_dropped_total", "jit_compile_seconds",
+                   "block_import_latency_seconds"):
+        assert f"# TYPE {family}" in text, family
+
+    _, health = get("/health")
+    assert json.loads(health)["status"] == "ok"
+
+    _, trace = get("/trace")
+    assert "traceEvents" in json.loads(trace)
+
+    t.join(timeout=60)
+    assert not t.is_alive() and rc["bn"] == 0

@@ -146,9 +146,46 @@ def test_scenario_spec_fires_on_unknown_name_only(corpus_result):
     assert not any("<name>" in s for s in symbols)
 
 
+def test_span_registry_fires_on_ghost_and_orphan(corpus_result):
+    symbols = {v.symbol for v in _by_rule(corpus_result)["span-registry"]}
+    assert "fixture.span.ghost" in symbols   # opened but unregistered
+    assert "fixture.span.orphan" in symbols  # registered but never opened
+    assert "fixture.span.good" not in symbols
+
+
+def test_span_registry_skipped_when_defs_absent():
+    from lighthouse_tpu.analysis import registry_lint
+
+    # a corpus that never includes the defs file runs the other families
+    # without a span-registry finding (run() skips, matching scenarios)
+    out = registry_lint.run(
+        [("a.py", "x = 1\n")], [],
+        metrics_defs_path="nope_metrics.py",
+        faults_defs_path="nope_faults.py",
+        spans_defs_path="nope_spans.py",
+    )
+    assert not [v for v in out if v.rule == "span-registry"]
+    # a direct call still reports the missing registry explicitly
+    direct = registry_lint.span_violations([("a.py", "x = 1\n")], "gone.py")
+    assert [v for v in direct if v.rule == "span-registry"]
+
+
+def test_span_registry_parses_live_tracer_registry():
+    from lighthouse_tpu.analysis.registry_lint import span_defs
+
+    path = "lighthouse_tpu/obs/tracer.py"
+    with open(os.path.join(REPO, path), encoding="utf-8") as f:
+        spans = span_defs(f.read(), path)
+    assert "pipeline.marshal" in spans
+    assert "jit.compile" in spans
+    assert len(spans) >= 10
+
+
 def test_doc_metric_regex_catches_unregistered_seconds(corpus_result):
     symbols = {v.symbol for v in _by_rule(corpus_result)["metrics-registry"]}
     assert "fixture_ghost_seconds" in symbols
+    # the widened regex also covers *_percent gauge tokens
+    assert "fixture_ghost_percent" in symbols
 
 
 def test_scenario_defs_parses_both_assignment_shapes():

@@ -64,10 +64,17 @@ def main(argv=None) -> int:
     ).run()
 
     for s in report["slo"]:
-        verdict = "ok  " if s["ok"] else "FAIL"
+        if s["ok"]:
+            verdict = "ok  "
+        elif s.get("level") == "warn":
+            verdict = "WARN"
+        else:
+            verdict = "FAIL"
         detail = f"  ({s['detail']})" if s["detail"] and not s["ok"] else ""
         print(f"  {verdict} {s['name']:22s} {s['observed']} "
               f"(threshold {s['threshold']}){detail}")
+    if report.get("trace_dump"):
+        print(f"  trace dump: {report['trace_dump']}")
     verdict = "PASS" if report["pass"] else "FAIL"
     print(f"scenario {report['scenario']}: {verdict}  "
           f"seed={report['seed']} fingerprint={report['fingerprint']} "
