@@ -1,6 +1,6 @@
 """Repo-wide static invariant analyzer.
 
-One entrypoint (``tools/pyrun tools/static_audit.py``) runs four lint
+One entrypoint (``tools/pyrun tools/static_audit.py``) runs five lint
 families over the package and emits a JSON report, failing on any
 unwaivered violation:
 
@@ -10,8 +10,16 @@ unwaivered violation:
   consistency
 * ``jaxpr_lint``    — dispatch hot-path host-sync ban (the jaxpr walk
   and zero-dim guard live here too, but tracing is driven by
-  ``tools/dispatch_audit.py`` and the test suite, not by the audit —
-  the audit stays AST-only and finishes in seconds)
+  ``tools/dispatch_audit.py`` and the test suite, not by the audit)
+* ``range_lint``    — limb-range abstract interpreter: uint32
+  overflow/carry proofs for every registered field kernel, LFp bound
+  algebra soundness, and the MXU-readiness report
+  (``RANGE_REPORT.json``)
+
+The first four families are pure-AST and finish in seconds; ``range``
+traces kernels through jax and dominates the wall time — use
+``tools/static_audit.py --only lock,raise,registry,jaxpr`` (see
+``AST_FAMILIES``) for the fast tier.
 
 Justified exceptions go in ``analysis/waivers.toml`` (see ``waivers``).
 Everything is configurable so the seeded-violation fixture corpus under
@@ -25,14 +33,15 @@ import os
 import time
 from dataclasses import dataclass, field
 
-from . import jaxpr_lint, lock_lint, raise_lint, registry_lint
+from . import jaxpr_lint, lock_lint, raise_lint, range_lint, registry_lint
 from .report import Violation
 from .waivers import Waiver, apply_waivers, load_waivers, parse_toml_subset
 
 __all__ = [
     "AuditConfig", "AuditResult", "Violation", "Waiver",
     "run_audit", "load_config", "discover_files", "load_waivers",
-    "jaxpr_lint", "lock_lint", "raise_lint", "registry_lint",
+    "jaxpr_lint", "lock_lint", "raise_lint", "range_lint",
+    "registry_lint", "ALL_FAMILIES", "AST_FAMILIES",
 ]
 
 DEFAULT_NEVER_RAISE = (
@@ -42,7 +51,9 @@ DEFAULT_NEVER_RAISE = (
     "lighthouse_tpu/beacon/processor.py::BeaconProcessor.try_send",
 )
 
-ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr")
+ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr", "range")
+# the pure-AST tier: no jax import, finishes in seconds
+AST_FAMILIES = ("lock", "raise", "registry", "jaxpr")
 
 
 @dataclass
@@ -69,6 +80,14 @@ class AuditConfig:
     # fixture corpus must not fail the live audit
     exclude: tuple = ("tests/fixtures/lint/",)
     families: tuple = ALL_FAMILIES
+    # range family: fixture registry override (python file exposing
+    # build_programs()/LFP_CLAIMS; empty = the live kernel registry) and
+    # the checked-in report the audit verifies against ("" skips the
+    # drift check)
+    range_defs: str = ""
+    range_report: str = "RANGE_REPORT.json"
+    # program names to restrict the range family to (empty = all)
+    range_only: tuple = ()
 
 
 @dataclass
@@ -79,6 +98,7 @@ class AuditResult:
     waived: list            # [(Violation, reason)]
     lock_edges: list        # [lock_lint.LockEdge]
     elapsed_s: float
+    family_seconds: dict = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -96,6 +116,9 @@ class AuditResult:
             "pass": self.ok,
             "files_scanned": self.files_scanned,
             "elapsed_s": round(self.elapsed_s, 3),
+            "family_seconds": {
+                k: round(v, 3) for k, v in self.family_seconds.items()
+            },
             "summary": self.summary(),
             "violations": [v.to_dict() for v in self.violations],
             "waived": [
@@ -186,6 +209,12 @@ def load_config(path: str) -> AuditConfig:
         cfg.exclude = tuple(a["exclude"])
     if "families" in a:
         cfg.families = tuple(a["families"])
+    if "range_defs" in a:
+        cfg.range_defs = a["range_defs"]
+    if "range_report" in a:
+        cfg.range_report = a["range_report"]
+    if "range_only" in a:
+        cfg.range_only = tuple(a["range_only"])
     if "hot_path" in a:
         # entries are "relpath::fn" strings
         hp: dict[str, list] = {}
@@ -214,16 +243,21 @@ def run_audit(
         ]
     files, violations = _read_corpus(root, rel_paths)
 
+    fam_t: dict[str, float] = {}
+
     lock_edges: list = []
     if "lock" in cfg.families:
+        t = time.perf_counter()
         lock_files = [
             (p, s) for p, s in files
             if p.startswith(tuple(cfg.lock_scan_include))
         ]
         lock_violations, lock_edges = lock_lint.run(lock_files)
         violations.extend(lock_violations)
+        fam_t["lock"] = time.perf_counter() - t
 
     if "raise" in cfg.families:
+        t = time.perf_counter()
         for p, s in files:
             violations.extend(raise_lint.broad_except_violations(p, s))
         package_files = [
@@ -233,8 +267,10 @@ def run_audit(
         violations.extend(raise_lint.never_raise_violations(
             package_files, cfg.never_raise, cfg.safe_calls
         ))
+        fam_t["raise"] = time.perf_counter() - t
 
     if "registry" in cfg.families:
+        t = time.perf_counter()
         docs = []
         for rel in cfg.docs:
             full = os.path.join(root, rel)
@@ -252,9 +288,17 @@ def run_audit(
             scenarios_defs_path=cfg.scenarios_defs,
             spans_defs_path=cfg.spans_defs,
         ))
+        fam_t["registry"] = time.perf_counter() - t
 
     if "jaxpr" in cfg.families:
+        t = time.perf_counter()
         violations.extend(jaxpr_lint.run(files, cfg.hot_path))
+        fam_t["jaxpr"] = time.perf_counter() - t
+
+    if "range" in cfg.families:
+        t = time.perf_counter()
+        violations.extend(range_lint.run(root, cfg, only=cfg.range_only))
+        fam_t["range"] = time.perf_counter() - t
 
     violations.sort(key=lambda v: (v.path, v.line, v.rule, v.symbol))
     failing, waived = apply_waivers(violations, waivers)
@@ -275,4 +319,5 @@ def run_audit(
         waived=waived,
         lock_edges=lock_edges,
         elapsed_s=time.perf_counter() - t0,
+        family_seconds=fam_t,
     )

@@ -22,8 +22,10 @@ per op small.  Op contracts:
 
 * ``fp_add``: value a+b, bound a.bound + b.bound.
 * ``fp_sub``: value a - b + k*P where k (a power of two >= b.bound) is
-  chosen automatically; the precomputed biased k*P has every limb >= any
-  quasi limb, so the column subtraction cannot go negative.
+  chosen automatically; the precomputed biased k*P has every non-top limb
+  >= any quasi limb, and ``_k_for`` additionally requires the (borrowed)
+  top bias limb to dominate the subtrahend's value-capped top limb, so no
+  column subtraction can go negative.
 * ``mont_mul``: requires a.bound * b.bound <= 2000 (checked at trace time);
   output has STRICT limbs and bound a.bound*b.bound/625 + 1.1 (< 4.3).
   (P/R ~ 2^-9.3 ~ 1/625.)
@@ -42,7 +44,9 @@ per-limb chain in the hot path.
 
 from __future__ import annotations
 
+import functools
 import math
+from fractions import Fraction
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +67,17 @@ U32 = jnp.uint32
 
 MAX_MUL_PRODUCT = 2000.0  # max a.bound * b.bound entering mont_mul
 MAX_BOUND = 500.0  # max value bound anywhere (keeps top limb small)
+
+# Montgomery output-bound model: mont_mul emits bound
+# prod / MONT_DIVISOR + MONT_EPS where prod = a.bound * b.bound.  The
+# exact bound is prod * P/R + 1 with R/P = 630.0525..., so divisor 625
+# with intercept 1.1 over-covers by 2.9% at prod = MAX_MUL_PRODUCT —
+# machine-checked by analysis/range_lint ("mont-output-bound").
+MONT_DIVISOR = 625.0
+MONT_EPS = 1.1
+# fp_reduce pins its output label here; the exact worst case is
+# MAX_BOUND * P/R + 1 = 1.794 (range_lint "reduce-pin").
+REDUCE_PIN = 2.0
 
 P_INT = params.P
 R_INT = 1 << (BITS * N)  # Montgomery radix 2^390
@@ -137,7 +152,12 @@ def limbs_to_ints(limbs) -> list[int]:
 
 def _biased_kp(k: int) -> np.ndarray:
     """k*P with every non-top limb boosted to >= QMAX by borrowing from the
-    limb above, so (a + bias - b) is column-wise non-negative for quasi b."""
+    limb above, so (a + bias - b) is column-wise non-negative for quasi b.
+
+    The boosting borrows exactly one unit into the top limb, lowering it
+    to floor(k*P / 2^375) - 1 — which is why ``k >= b.bound`` alone does
+    NOT guarantee top-column domination; ``_k_for`` additionally enforces
+    ``_sub_top_dominates`` (machine-checked by range_lint "bias-k*")."""
     limbs = [int(v) for v in int_to_limbs(k * P_INT)]
     for i in range(N - 1):
         while limbs[i] < QMAX:
@@ -151,7 +171,8 @@ def _biased_kp(k: int) -> np.ndarray:
 P_LIMBS = jnp.asarray(int_to_limbs(P_INT))
 PPRIME_LIMBS = jnp.asarray(int_to_limbs(PPRIME_INT))
 ONE_MONT = jnp.asarray(int_to_limbs(R1_INT))
-BIAS = {k: jnp.asarray(_biased_kp(k)) for k in _BIAS_KS}
+_BIAS_NP = {k: _biased_kp(k) for k in _BIAS_KS}
+BIAS = {k: jnp.asarray(v) for k, v in _BIAS_NP.items()}
 
 
 def bcast(const, batch_shape) -> jnp.ndarray:
@@ -178,8 +199,12 @@ def batch_shape(a: LFp):
 
 
 def compress1(cols):
-    """One carry pass: quasi-normalizes column sums < 2^16.2.  The top
-    limb's carry is statically impossible (values < 500P)."""
+    """One carry pass: quasi-normalizes column sums < 2^16.6 (worst case
+    is fp_sub: quasi a + boosted bias limb <= 32896 + 65663 = 98559, so
+    hi <= 3 and outputs stay <= MASK + 3 <= QMAX).  The top limb's carry
+    is statically impossible: any value < MAX_BOUND*P has top column
+    <= floor(MAX_BOUND*P / 2^375) = 26142 < 2^15 (range_lint
+    "compress1-top-carry")."""
     lo = cols & MASK
     hi = cols >> BITS
     return lo.at[1:].add(hi[:-1])
@@ -232,16 +257,45 @@ def fp_add(a: LFp, b: LFp) -> LFp:
     return LFp(compress1(a.limbs + b.limbs), out)
 
 
+def _sub_top_dominates(bound: float, k: int) -> bool:
+    """Exact (Fraction) check that the k bias dominates every quasi
+    subtrahend of value bound ``bound`` in the TOP column too: such a
+    value's limb 25 is at most floor(bound*P / 2^375), which must not
+    exceed the bias top limb.  ``k >= bound`` alone is insufficient —
+    ``_biased_kp`` borrows one unit out of the top limb, so e.g. a
+    bound-2.0 subtrahend can carry top limb 104 against the k=2 bias
+    top of 103, wrapping the uint32 column."""
+    top = int(_BIAS_NP[k][N - 1])
+    return Fraction(bound) * P_INT < (top + 1) << (BITS * (N - 1))
+
+
+@functools.lru_cache(maxsize=None)
 def _k_for(bound: float) -> int:
-    k = 2
-    while k < bound:
-        k *= 2
-    assert k in BIAS, f"no bias constant for k={k} (bound {bound})"
-    return k
+    """Smallest bias constant k with k >= bound AND top-limb domination
+    (see _sub_top_dominates).  Shared by the XLA ops and the fused
+    Pallas kernels — both paths must pick identical k or the fused/XLA
+    bit-equality contract breaks."""
+    for k in _BIAS_KS:
+        if k >= bound and _sub_top_dominates(bound, k):
+            return k
+    raise AssertionError(f"no safe bias constant for bound {bound}")
+
+
+@functools.lru_cache(maxsize=None)
+def sub_bias_max_bound(k: int) -> float:
+    """Largest float subtrahend bound the k bias provably dominates (and
+    thus the largest _k_for routes to k).  The range prover quantifies
+    the per-k fp_sub/ksub proof obligations at exactly this edge."""
+    top = int(_BIAS_NP[k][N - 1])
+    f = min(float(k), float(Fraction((top + 1) << (BITS * (N - 1)), P_INT)))
+    while f > 0 and not (f <= k and _sub_top_dominates(f, k)):
+        f = float(np.nextafter(f, 0.0))
+    return f
 
 
 def fp_sub(a: LFp, b: LFp) -> LFp:
-    """Value a - b + k*P, k auto-chosen >= b.bound."""
+    """Value a - b + k*P, k auto-chosen so the bias dominates b column-
+    wise (k >= b.bound for the value, _sub_top_dominates for limb 25)."""
     k = _k_for(b.bound)
     out = a.bound + k
     _check_bound(out, "fp_sub")
@@ -460,12 +514,12 @@ def mont_mul(a: LFp, b: LFp) -> LFp:
             # the kernel is Mosaic/TPU-only: interpret everywhere else
             interpret=jax.default_backend() != "tpu",
         )
-        return LFp(flat.reshape((N,) + batch), prod / 625.0 + 1.1)
+        return LFp(flat.reshape((N,) + batch), prod / MONT_DIVISOR + MONT_EPS)
     t = _mul_cols_wide(a.limbs, b.limbs)
     m = _mul_cols_low(t[:N], bcast(PPRIME_LIMBS, a.limbs.shape[1:]))
     u = _mul_cols_wide(m, bcast(P_LIMBS, a.limbs.shape[1:]))
     s = full_chain(t + u)  # low N limbs are exactly zero (divisible by R)
-    return LFp(s[N:], prod / 625.0 + 1.1)
+    return LFp(s[N:], prod / MONT_DIVISOR + MONT_EPS)
 
 
 def mont_sqr(a: LFp) -> LFp:
@@ -474,12 +528,13 @@ def mont_sqr(a: LFp) -> LFp:
 
 def fp_reduce(x: LFp) -> LFp:
     """Value-preserving (mod P) reduction.  The output bound is pinned to
-    the constant 2.0 (true bound: x.bound/625 + 1.1 < 1.9 for any in-range
-    x) so reduced values have a STABLE static bound — required for lax.scan
+    REDUCE_PIN = 2.0 (exact worst case MAX_BOUND*P/R + 1 = 1.794; the
+    formula bound x.bound/MONT_DIVISOR + MONT_EPS <= 1.9 for in-range x)
+    so reduced values have a STABLE static bound — required for lax.scan
     carries, whose pytree aux must match between iterations."""
     out = mont_mul(x, one_like(x))
-    assert out.bound <= 2.0
-    return LFp(out.limbs, 2.0)
+    assert out.bound <= REDUCE_PIN
+    return LFp(out.limbs, REDUCE_PIN)
 
 
 def guard_le(x: LFp, m: float) -> LFp:
@@ -522,12 +577,12 @@ def fp_pow(a: LFp, e: int) -> LFp:
 
         batch = a.limbs.shape[1:]
         flat = pallas_fp.pow_chain_limbs(a.limbs.reshape(N, -1), e)
-        fixp = MAX_MUL_PRODUCT / 625.0 + 1.1
+        fixp = MAX_MUL_PRODUCT / MONT_DIVISOR + MONT_EPS
         return LFp(flat.reshape((N,) + batch), fixp)
     bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=U32)
     # stabilize the carried bound: sqr of <=4.3 would grow, so pin to the
-    # fixpoint bound of mont outputs
-    fix = MAX_MUL_PRODUCT / 625.0 + 1.1  # 4.3, closed under mont_mul? no:
+    # fixpoint bound of mont outputs (range_lint "pow-fix-closure")
+    fix = MAX_MUL_PRODUCT / MONT_DIVISOR + MONT_EPS  # 4.3, closed? no:
     # 4.3*4.3 = 18.5 <= 2000 ok, out = 18.5/625+1.1 = 1.13 < 4.3 ✓ and
     # mul with a (<= 4.3): 1.13*4.3 ok, out < 1.11 < 4.3 ✓  => 4.3 is stable.
 

@@ -47,8 +47,11 @@ MASK = np.uint32((1 << 15) - 1)
 
 
 def _compress1(cols):
-    """One carry pass (fp.compress1, in-kernel): quasi-normalize < 2^16.2.
-    Shift expressed as pad+slice (Mosaic has no scatter-add)."""
+    """One carry pass (fp.compress1, in-kernel): lo < 2^15 plus the
+    column below's high bits.  On the wide-product accumulator
+    (<= 1,677,799 < 2^20.7) one pass lands <= 32818 and a second
+    <= 32768 <= QMAX (intervals range_lint-verified).  Shift expressed
+    as pad+slice (Mosaic has no scatter-add)."""
     lo = cols & MASK
     hi = cols >> 15
     shifted = jnp.pad(hi[:-1], ((1, 0), (0, 0)))
@@ -66,9 +69,10 @@ def _acc_add(acc, rows, offset: int):
 
 def _wide_product(a, b):
     """Schoolbook sum_i a_i * b * 2^(15 i); a, b (26, T) quasi limbs.
-    Returns (52, T) columns, two carry passes applied (< QMAX + eps).
-    All accumulator updates are full-width in-bounds slice-adds — the
-    clipped-slice variant lowers to a scatter Pallas cannot stage."""
+    Returns (52, T) columns, two carry passes applied (<= 32768 <= QMAX,
+    range_lint-verified).  All accumulator updates are full-width
+    in-bounds slice-adds — the clipped-slice variant lowers to a
+    scatter Pallas cannot stage."""
     T = a.shape[1]
     acc = jnp.zeros((52, T), dtype=jnp.uint32)
     for i in range(26):
@@ -77,7 +81,7 @@ def _wide_product(a, b):
         phi = p >> 15
         acc = _acc_add(acc, plo, i)
         acc = _acc_add(acc, phi, i + 1)
-        # column sums stay < 26 * 2^15.2 + carries < 2^21: no overflow
+        # column sums peak at 1,677,799 < 2^20.7 (range_lint): no overflow
     return _compress1(_compress1(acc))
 
 
@@ -91,7 +95,9 @@ def _wide_square(a):
         tail = a[i:]  # (26-i, T)
         p = a[i][None, :] * tail  # a_i * a_j, j >= i
         # double the cross terms (j > i); diagonal stays single.
-        # products < QMAX^2 ~ 2^30.01, doubled < 2^31.1: no overflow.
+        # products <= QMAX^2 ~ 2^30.01, doubled < 2^31.02 — the
+        # repo-wide uint32 high-water mark (range_lint max_acc): the
+        # int32 MXU budget is the binding one for any matmul remap.
         # i=25 has no cross terms: p[1:] would be a zero-row vector,
         # which real Mosaic lowering rejects ("vector types must have
         # positive constant sizes") even though interpret mode allows it
@@ -111,7 +117,7 @@ def _mont_reduce(t, pl_, pp):
     26 limbs vanish (divisible by R)."""
     m = _wide_product(t[:26], pp)[:26]
     u = _wide_product(m, pl_)
-    s = t + u  # < 2^17.3 per column
+    s = t + u  # <= 2^16 per column: both double-compressed <= 32768 (range_lint)
     carry = jnp.zeros((t.shape[1],), dtype=jnp.uint32)
     out_rows = []
     for k in range(52):
@@ -210,8 +216,11 @@ _BIAS16_COLS = np.asarray(F._biased_kp(16)).astype(np.uint32).reshape(26, 1)
 
 def _sub_biased(a, b, bias):
     """Value a - b + k·P, limb-safe when every bias limb >= b's quasi
-    limbs (fp._biased_kp boosts all non-top limbs past QMAX) and k
-    exceeds b's value bound (top-limb non-negativity)."""
+    limbs (fp._biased_kp boosts all non-top limbs past QMAX) and the
+    bias's borrowed-from top limb dominates b's top limb
+    (fp._sub_top_dominates — ``k >= b's value bound`` alone is NOT
+    sufficient; the in-kernel uses here are interval-proven by
+    range_lint over the fp2/Miller programs)."""
     return _compress1((a + bias) - b)
 
 

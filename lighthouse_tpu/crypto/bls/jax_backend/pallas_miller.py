@@ -133,21 +133,25 @@ def kmul(ctx, a: KFp, b: KFp) -> KFp:
     assert prod <= F.MAX_MUL_PRODUCT, (
         f"in-kernel mont product bound {prod} > {F.MAX_MUL_PRODUCT}"
     )
-    return KFp(PF._mont_core(a.cols, b.cols, ctx.p, ctx.pp), prod / 625.0 + 1.1)
+    return KFp(
+        PF._mont_core(a.cols, b.cols, ctx.p, ctx.pp),
+        prod / F.MONT_DIVISOR + F.MONT_EPS,
+    )
 
 
 def ksqr(ctx, a: KFp) -> KFp:
     prod = a.bound * a.bound
     assert prod <= F.MAX_MUL_PRODUCT
     return KFp(
-        PF._mont_sqr_core(a.cols, ctx.p, ctx.pp), prod / 625.0 + 1.1
+        PF._mont_sqr_core(a.cols, ctx.p, ctx.pp),
+        prod / F.MONT_DIVISOR + F.MONT_EPS,
     )
 
 
 def kreduce(ctx, a: KFp) -> KFp:
     out = kmul(ctx, a, KFp(ctx.one, 1.0))
-    assert out.bound <= 2.0
-    return KFp(out.cols, 2.0)
+    assert out.bound <= F.REDUCE_PIN
+    return KFp(out.cols, F.REDUCE_PIN)
 
 
 def kguard(ctx, a: KFp, m: float) -> KFp:

@@ -1,0 +1,25 @@
+"""Seeded representation-contract violations for the range family.
+
+Each function stays inside uint32 (no overflow finding) but breaks the
+output contract it declares in ``range_defs.build_programs`` — the
+corpus audit must flag every one with ``range-contract``.
+"""
+
+import jax.numpy as jnp
+
+MASK = jnp.uint32(0x7FFF)
+
+
+def skipped_carry(a, b):
+    """Limb add with the carry pass skipped: two quasi planes sum to
+    ~2*QMAX per limb, which breaks the declared quasi (<= QMAX)
+    contract until a compress pass runs."""
+    return a + b
+
+
+def unmasked_reduce(a):
+    """Carry fold with the final mask skipped: ``lo + hi`` reaches
+    2^15, one past the declared strict (< 2^15) contract."""
+    lo = a & MASK
+    hi = a >> 15
+    return lo + hi

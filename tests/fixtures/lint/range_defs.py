@@ -1,0 +1,86 @@
+"""Seeded range-family registry: the ``range_defs`` audit config key
+points here, replacing the live kernel registry with four tiny broken
+programs (two uint32-overflow shapes, two contract shapes) and two bad
+LFp claim sets (one unsound, one sound-but-loose).
+
+Loaded by ``range_lint._load_defs`` via importlib, so sibling fixture
+modules are loaded by path too (the corpus is not a package on
+``sys.path``).
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+
+from lighthouse_tpu.analysis.range_lint import RangeProgram, caps_iv
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REL = "tests/fixtures/lint"
+_T = 8
+
+
+def _load(stem):
+    spec = importlib.util.spec_from_file_location(
+        f"range_fixture_{stem}", os.path.join(_HERE, stem + ".py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _args(n):
+    def build_args():
+        a = np.zeros((26, _T), dtype=np.uint32)
+        return tuple(a for _ in range(n)), [caps_iv((26, _T))] * n
+    return build_args
+
+
+def build_programs():
+    ov = _load("range_overflow")
+    ct = _load("range_contract")
+
+    def prog(fn, n):
+        def build():
+            args, ivs = _args(n)()
+            return fn, args, ivs
+        return build
+
+    return [
+        RangeProgram(
+            "fixture_unsplit_mac", f"{_REL}/range_overflow.py",
+            prog(ov.unsplit_mac, 2),
+            note="26 unsplit 30-bit products accumulated in one uint32 "
+                 "plane: wraps at ~2^34.7",
+        ),
+        RangeProgram(
+            "fixture_raw_sub", f"{_REL}/range_overflow.py",
+            prog(ov.raw_sub, 2),
+            note="biasless limb subtraction wraps below zero",
+        ),
+        RangeProgram(
+            "fixture_skipped_carry", f"{_REL}/range_contract.py",
+            prog(ct.skipped_carry, 2), contracts=((0, "quasi"),),
+            note="declares quasi but skips the carry pass (~2*QMAX)",
+        ),
+        RangeProgram(
+            "fixture_unmasked_reduce", f"{_REL}/range_contract.py",
+            prog(ct.unmasked_reduce, 1), contracts=((0, "strict"),),
+            note="declares strict but skips the final mask (reaches 2^15)",
+        ),
+    ]
+
+
+LFP_CLAIMS = [
+    # unsound: divisor 700 claims a tighter mont output than exact R/P
+    # (~630.05) delivers, the reduce pin undershoots the exact 1.794
+    # worst case, and MAX_BOUND 2500 pushes cap(MAX_BOUND) past 2^15
+    dict(name="unsound", path=f"{_REL}/range_defs.py",
+         mont_divisor=700.0, mont_eps=0.5, reduce_pin=1.5,
+         max_mul_product=2000.0, max_bound=2500.0),
+    # sound but needlessly loose: divisor 200 / pin 9.0 over-claim by
+    # >50% relative slack
+    dict(name="loose", path=f"{_REL}/range_defs.py",
+         mont_divisor=200.0, mont_eps=1.1, reduce_pin=9.0,
+         max_mul_product=2000.0, max_bound=500.0),
+]

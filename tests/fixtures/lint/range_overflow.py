@@ -1,0 +1,27 @@
+"""Seeded uint32-overflow shapes for the range family.
+
+Each function is a deliberately broken limb kernel: the corpus audit
+must flag every one with a ``range-overflow`` finding (see
+``range_defs.build_programs`` for the declared input intervals).
+"""
+
+import jax.numpy as jnp
+
+MASK = jnp.uint32(0x7FFF)
+
+
+def unsplit_mac(a, b):
+    """Schoolbook accumulation WITHOUT the lo/hi product split: 26 full
+    30-bit products summed into one uint32 plane (~2^34.7) — the wrap
+    the real ``_wide_product`` avoids by splitting at 2^15."""
+    acc = jnp.zeros_like(a)
+    for i in range(a.shape[0]):
+        acc = acc + a[i][None, :] * b
+    return acc
+
+
+def raw_sub(a, b):
+    """Biasless limb subtraction: underflows (wraps below zero) whenever
+    any limb of ``b`` exceeds ``a``'s — the wrap ``fp_sub`` prevents by
+    adding a dominating multiple of P first."""
+    return a - b

@@ -1,0 +1,138 @@
+"""Differential soundness probe for the limb-range abstract interpreter.
+
+The static prover (``analysis/range_lint.py``) is only worth trusting if
+its intervals really do over-approximate runtime values.  This suite
+runs the instrumented kernels — the wide-product interior, the triangle
+square core, the Montgomery product kernel, and one fused pow megachain
+— in interpret mode on random AND adversarial (every limb at QMAX)
+inputs, and asserts the observed per-element maxima stay at or below
+the static interval upper bounds.  An unsound interpreter (a handler
+that under-approximates, a fixpoint that converges too early) fails
+here even when every kernel happens to be correct.
+
+A bound-algebra regression rides along: ``fp_sub`` bias selection must
+honour top-limb domination (the ``_k_for``/``_sub_top_dominates`` fix),
+pinned by subtracting a bound-2.0 value whose top limb exceeds the
+bias-2 table's borrowed top limb.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.analysis import range_lint
+from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+
+pytestmark = pytest.mark.analysis
+
+T = 128
+SEED = 0xB15
+
+
+def _quasi_random(rng):
+    return rng.integers(0, F.QMAX + 1, size=(F.N, T), dtype=np.uint32)
+
+
+def _all_qmax():
+    return np.full((F.N, T), F.QMAX, dtype=np.uint32)
+
+
+def _adversarial_inputs(n):
+    rng = np.random.default_rng(SEED)
+    yield tuple(_quasi_random(rng) for _ in range(n))
+    yield tuple(_all_qmax() for _ in range(n))
+
+
+def _static_caps(fn, n_args):
+    """Interval-analyze ``fn`` over fully-general quasi inputs."""
+    prog = range_lint.RangeProgram(
+        f"probe_{getattr(fn, '__name__', 'fn')}", "tests/test_range_probe.py",
+        lambda: (fn, tuple(np.zeros((F.N, T), np.uint32)
+                           for _ in range(n_args)),
+                 [range_lint.caps_iv((F.N, T))] * n_args),
+    )
+    violations, rep = range_lint.analyze_program(prog)
+    assert not violations, [str(v) for v in violations]
+    return rep["out_caps"]
+
+
+def _assert_runtime_below_static(fn, n_args, out_caps):
+    for args in _adversarial_inputs(n_args):
+        outs = fn(*args)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        for out, cap in zip(outs, out_caps):
+            got = int(np.asarray(out).max())
+            assert got <= cap, f"runtime max {got} > static hi {cap}"
+
+
+def test_wide_product_interior_probe():
+    # the 52-column schoolbook accumulator, the densest interior point
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+
+    caps = _static_caps(PF._wide_product, 2)
+    _assert_runtime_below_static(PF._wide_product, 2, caps)
+
+
+def test_mont_sqr_core_probe():
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+
+    pl_ = np.broadcast_to(PF._P_COLS, (F.N, T)).astype(np.uint32)
+    pp = np.broadcast_to(PF._PP_COLS, (F.N, T)).astype(np.uint32)
+
+    def sqr(a):
+        return PF._mont_sqr_core(a, pl_, pp)
+
+    caps = _static_caps(sqr, 1)
+    assert max(caps) < (1 << 15)  # the strict exit contract, statically
+    _assert_runtime_below_static(sqr, 1, caps)
+
+
+def test_mont_mul_kernel_probe():
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+
+    def mul(a, b):
+        return PF.mont_mul_limbs(a, b, interpret=True)
+
+    caps = _static_caps(mul, 2)
+    assert max(caps) < (1 << 15)
+    _assert_runtime_below_static(mul, 2, caps)
+
+
+@pytest.mark.slow
+def test_megachain_probe():
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+
+    def chain(a):
+        return PF.pow_chain_limbs(a, 0x1234, interpret=True)
+
+    caps = _static_caps(chain, 1)
+    assert max(caps) <= F.QMAX  # quasi exit contract
+    _assert_runtime_below_static(chain, 1, caps)
+
+
+def test_fp_sub_top_limb_domination_regression():
+    """A bound-2.0 subtrahend can carry top limb 104, one above the
+    bias-2 table's borrowed top limb 103: the old ``k >= bound`` rule
+    picked k=2 there and wrapped the top column.  ``_k_for`` must now
+    step to k=4, and the subtraction must stay value-correct."""
+    import jax.numpy as jnp
+
+    assert not F._sub_top_dominates(2.0, 2)
+    assert F._k_for(2.0) == 4
+    thr = F.sub_bias_max_bound(2)
+    assert thr < 2.0 and F._sub_top_dominates(thr, 2)
+
+    lanes = 4
+    near_p = F.int_to_limbs(F.P_INT - 1)[:, None].repeat(lanes, axis=1)
+    a = F.LFp(jnp.asarray(near_p.astype(np.uint32)), 1.0)
+    s = F.fp_add(a, a)  # value 2P-2, bound 2.0, top limb 104
+    assert int(np.asarray(s.limbs)[F.N - 1].max()) > 103
+
+    va = 123456789
+    minuend = F.LFp(jnp.asarray(
+        F.int_to_limbs(va)[:, None].repeat(lanes, axis=1).astype(np.uint32)
+    ), 1.0)
+    d = F.fp_sub(minuend, s)
+    want = (va - (2 * F.P_INT - 2)) % F.P_INT
+    got = [v % F.P_INT for v in F.limbs_to_ints(np.asarray(d.limbs))]
+    assert got == [want] * lanes

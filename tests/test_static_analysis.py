@@ -1,13 +1,17 @@
 """Static invariant analyzer suite.
 
-Locks down four surfaces: (1) the live repo stays clean under the full
-audit (zero unwaivered findings, and the waiver file is honoured);
-(2) the seeded corpus under ``tests/fixtures/lint/`` makes every lint
-family fire on at least two distinct violation shapes — including the
-two-lock deadlock cycle, both direct and call-resolved; (3) the CLI
-exit codes and the waiver/stale-waiver mechanics; (4) one chaos sync
-soak runs under the runtime lockcheck sanitizer and the observed
-acquisition order is verified against the static lock-order graph.
+Locks down five surfaces: (1) the live repo stays clean under the full
+audit (zero unwaivered findings, and the waiver file is honoured) —
+the fast AST tier runs in-module, the minutes-scale ``range`` kernel
+proofs under ``slow``; (2) the seeded corpus under
+``tests/fixtures/lint/`` makes every lint family fire on at least two
+distinct violation shapes — including the two-lock deadlock cycle and
+the four range-family theorem classes; (3) the CLI exit codes and the
+waiver/stale-waiver mechanics; (4) one chaos sync soak runs under the
+runtime lockcheck sanitizer and the observed acquisition order is
+verified against the static lock-order graph; (5) the range family's
+live-tree proofs: strict/quasi output contracts and the exact LFp
+bound algebra hold on the real kernels.
 """
 
 import os
@@ -17,7 +21,13 @@ import threading
 
 import pytest
 
-from lighthouse_tpu.analysis import load_config, run_audit
+from lighthouse_tpu.analysis import (
+    AST_FAMILIES,
+    AuditConfig,
+    load_config,
+    range_lint,
+    run_audit,
+)
 from lighthouse_tpu.analysis.lock_lint import static_lock_order
 from lighthouse_tpu.analysis.waivers import (
     Waiver,
@@ -41,7 +51,10 @@ WAIVERS = os.path.join(REPO, "lighthouse_tpu", "analysis", "waivers.toml")
 
 @pytest.fixture(scope="module")
 def live_result():
-    return run_audit(REPO, waivers=WAIVERS)
+    # AST tier only: the range family traces kernels for minutes and has
+    # its own live proofs below (fast subset) and under slow (full)
+    return run_audit(REPO, AuditConfig(families=AST_FAMILIES),
+                     waivers=WAIVERS)
 
 
 @pytest.fixture(scope="module")
@@ -228,20 +241,177 @@ def test_host_sync_lint_fires_only_on_registered_functions(corpus_result):
     # helper's .item() stays unflagged: it is not in the hot-path registry
 
 
+# -- range family: seeded corpus shapes ----------------------------------
+
+
+def test_range_overflow_fires_on_both_shapes(corpus_result):
+    vios = _by_rule(corpus_result)["range-overflow"]
+    by_prog = {v.symbol.split(":")[0] for v in vios}
+    assert "fixture_unsplit_mac" in by_prog   # unsplit MAC wraps uint32
+    assert "fixture_raw_sub" in by_prog       # biasless sub wraps below 0
+    # findings carry the computed interval and the eqn site
+    assert all("interval [" in v.message for v in vios)
+    assert any("range_overflow.py" in v.message for v in vios)
+
+
+def test_range_contract_fires_on_both_shapes(corpus_result):
+    vios = _by_rule(corpus_result)["range-contract"]
+    msgs = {v.symbol: v.message for v in vios}
+    assert "fixture_skipped_carry:out0" in msgs    # quasi cap exceeded
+    assert "fixture_unmasked_reduce:out0" in msgs  # strict cap exceeded
+    assert "`quasi`" in msgs["fixture_skipped_carry:out0"]
+    assert "`strict`" in msgs["fixture_unmasked_reduce:out0"]
+
+
+def test_range_lfp_fires_on_unsound_constants(corpus_result):
+    symbols = {v.symbol for v in _by_rule(corpus_result)["range-lfp"]}
+    # divisor 700 over-claims the mont output bound (exact R/P ~ 630.05)
+    assert "unsound:mont-output-bound@prod=2000" in symbols
+    # pin 1.5 undershoots the exact reduce worst case
+    assert "unsound:reduce-pin" in symbols
+    # MAX_BOUND 2500 pushes the dropped top carry past 2^15
+    assert "unsound:compress1-top-carry" in symbols
+
+
+def test_range_slack_fires_on_loose_constants(corpus_result):
+    symbols = {v.symbol for v in _by_rule(corpus_result)["range-slack"]}
+    assert "loose:mont-output-bound@prod=2000" in symbols  # 62% slack
+    assert "loose:reduce-pin" in symbols                   # 80% slack
+
+
+# -- range family: live-tree proofs (fast subset) -------------------------
+
+# op-level programs only: the minutes-scale whole-kernel composition
+# traces (miller/wsm/megachains) run under ``slow`` below
+FAST_RANGE_PROGRAMS = (
+    "pallas_mont_mul", "pallas_mont_sqr", "xla_mont_mul", "xla_fp_add",
+    "xla_fp_sub_k2", "xla_fp_sub_k256", "pallas_ksub_k2",
+    "pallas_ksub_k256",
+)
+
+
+@pytest.fixture(scope="module")
+def live_range_fast():
+    return range_lint.generate(REPO, AuditConfig(),
+                               only=FAST_RANGE_PROGRAMS)
+
+
+def test_live_kernels_prove_no_uint32_overflow(live_range_fast):
+    violations, report = live_range_fast
+    assert not [v for v in violations if v.rule == "range-overflow"], (
+        [str(v) for v in violations]
+    )
+    # the interpreter actually walked the kernels
+    assert report["programs"]["pallas_mont_mul"]["eqns"] > 1000
+
+
+def test_live_mont_kernels_prove_strict_contract(live_range_fast):
+    violations, report = live_range_fast
+    assert not [v for v in violations if v.rule == "range-contract"]
+    for name in ("pallas_mont_mul", "pallas_mont_sqr", "xla_mont_mul"):
+        assert report["programs"][name]["contracts_ok"]
+        # _mont_reduce's masked carry chain: every output limb < 2^15
+        assert max(report["programs"][name]["out_caps"]) < (1 << 15)
+
+
+def test_live_fp_sub_bias_domination_proved(live_range_fast):
+    # the per-k subtraction programs prove bias-limb domination (no
+    # underflow) for every admissible subtrahend at the _k_for threshold
+    violations, report = live_range_fast
+    assert not violations
+    for name in ("xla_fp_sub_k2", "pallas_ksub_k2", "xla_fp_sub_k256"):
+        assert name in report["programs"]
+
+
+def test_live_lfp_algebra_is_sound_and_tight():
+    violations, checks = range_lint.lfp_check(range_lint.live_claims())
+    assert not violations, [str(v) for v in violations]
+    assert all(c["sound"] for c in checks)
+    # slack is reported for the tightness-bearing checks and stays small
+    slacks = {c["check"]: c["slack"] for c in checks
+              if c["slack"] is not None}
+    assert slacks["mont-output-bound@prod=2000"] < range_lint.SLACK_MAX
+    assert slacks["reduce-pin"] < range_lint.SLACK_MAX
+
+
+def test_live_mxu_report_budgets(live_range_fast):
+    _violations, report = live_range_fast
+    mxu = report["mxu"]
+    # the current 26x15-bit direct dot-product column exceeds both MXU
+    # accumulator budgets — that is the whole point of ROADMAP item 1
+    assert mxu["current_rep"]["f32_ok"] is False
+    assert mxu["current_rep"]["i32_ok"] is False
+    assert mxu["max_w_f32"] == 9    # 43 limbs of <= 9 bits for f32
+    assert mxu["max_w_i32"] == 13   # 30 limbs of <= 13 bits for int32
+    rows = {r["w"]: r for r in mxu["limb_split_table"]}
+    assert rows[9]["f32_ok"] and not rows[10]["f32_ok"]
+    assert rows[13]["i32_ok"] and not rows[14]["i32_ok"]
+
+
+# -- range family: full registry + report drift (slow) --------------------
+
+
+@pytest.mark.slow
+def test_live_full_range_registry_is_clean_and_report_current():
+    # whole registry including the miller/wsm composition traces, plus
+    # the checked-in RANGE_REPORT.json drift check
+    violations = range_lint.run(REPO, AuditConfig())
+    assert not violations, [str(v) for v in violations]
+
+
+@pytest.mark.slow
+def test_range_report_drift_fails_audit(tmp_path):
+    cfg = AuditConfig(range_report="no/such/RANGE_REPORT.json")
+    violations = range_lint.run(REPO, cfg, only=())
+    # ...a missing report is itself a violation pointing at the fix
+    missing = [v for v in violations if v.rule == "range-report"]
+    assert missing and "--write-range-report" in missing[0].message
+
+
+def test_range_report_drift_detector_unit(tmp_path, monkeypatch):
+    # unit-level: corrupt a copy of the checked-in report and verify the
+    # drift check names the changed path (no kernel tracing involved)
+    import json
+
+    src = os.path.join(REPO, "RANGE_REPORT.json")
+    with open(src, encoding="utf-8") as f:
+        report = json.load(f)
+    report["mxu"]["max_w_f32"] = 99
+    bad = tmp_path / "RANGE_REPORT.json"
+    bad.write_text(json.dumps(report))
+
+    monkeypatch.setattr(range_lint, "generate",
+                        lambda root, cfg, only=(): ([], json.loads(
+                            json.dumps(dict(report, mxu=dict(
+                                report["mxu"], max_w_f32=9))))))
+    cfg = AuditConfig(range_report=str(bad.relative_to(tmp_path)))
+    violations = range_lint.run(str(tmp_path), cfg)
+    drift = [v for v in violations if v.rule == "range-report"]
+    assert drift and "drift" in drift[0].symbol
+
+
 # -- CLI entrypoint ------------------------------------------------------
 
 
-def _run_cli(*extra):
+def _run_cli(*extra, timeout=120):
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "static_audit.py"),
          "--quiet", "--no-history", *extra],
-        cwd=REPO, capture_output=True, text=True, timeout=120,
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
     )
 
 
 def test_cli_exits_zero_on_live_repo():
-    proc = _run_cli()
+    # fast AST tier; the full run including range is the slow test below
+    proc = _run_cli("--only", ",".join(AST_FAMILIES))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stderr
+
+
+@pytest.mark.slow
+def test_cli_full_audit_exits_zero_with_range():
+    proc = _run_cli(timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stderr
 
@@ -250,6 +420,16 @@ def test_cli_exits_nonzero_on_seeded_corpus():
     proc = _run_cli("--config", LINT_TOML)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "FAIL" in proc.stderr
+
+
+def test_cli_list_families_and_only_validation():
+    proc = _run_cli("--list-families")
+    assert proc.returncode == 0
+    assert proc.stdout.split() == ["lock", "raise", "registry", "jaxpr",
+                                   "range"]
+    proc = _run_cli("--only", "nonsense")
+    assert proc.returncode == 2
+    assert "unknown families" in proc.stderr
 
 
 # -- waivers + TOML subset ----------------------------------------------

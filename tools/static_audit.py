@@ -1,20 +1,30 @@
 #!/usr/bin/env python3
 """Repo-wide static invariant audit (lighthouse_tpu.analysis front-end).
 
-Runs the four lint families — lock-discipline + lock-order graph,
+Runs the five lint families — lock-discipline + lock-order graph,
 never-raise/broad-except, registry consistency (metrics / fault sites /
---chaos specs), and jaxpr hygiene (dispatch hot-path host-sync ban) —
-and prints a JSON report.  Exit status is 0 iff every finding is covered
-by a justified waiver in ``analysis/waivers.toml``.
+--chaos specs), jaxpr hygiene (dispatch hot-path host-sync ban), and the
+limb-range abstract interpreter (uint32 overflow / representation
+contract / LFp bound-algebra proofs + the MXU-readiness report) — and
+prints a JSON report.  Exit status is 0 iff every finding is covered by
+a justified waiver in ``analysis/waivers.toml``.
 
-The audit is pure AST + text: no jax import, no tracing, seconds not
-minutes.  The traced device-side checks (program budget, zero-dim guard)
-live in the same package (``analysis/jaxpr_lint.py``) but are driven by
-``tools/dispatch_audit.py`` and the test suite.
+The first four families are pure AST + text: no jax import, no tracing,
+seconds not minutes.  The ``range`` family traces every registered
+field kernel through jax in interpret mode and dominates the wall time
+(minutes on the Miller-loop kernels) — run families selectively with
+``--only``.  The traced device-side checks (program budget, zero-dim
+guard) live in the same package (``analysis/jaxpr_lint.py``) but are
+driven by ``tools/dispatch_audit.py`` and the test suite.
 
 Usage:
     tools/pyrun tools/static_audit.py                 # whole repo
     tools/pyrun tools/static_audit.py --quiet         # summary line only
+    tools/pyrun tools/static_audit.py --only lock,raise,registry,jaxpr
+                                                      # fast AST tier
+    tools/pyrun tools/static_audit.py --only range    # kernel proofs only
+    tools/pyrun tools/static_audit.py --write-range-report
+                                                      # refresh RANGE_REPORT.json
     tools/pyrun tools/static_audit.py --paths tests/fixtures/lint \\
         --config tests/fixtures/lint/lint.toml        # fixture corpus
 """
@@ -31,6 +41,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 from lighthouse_tpu.analysis import (  # noqa: E402
+    ALL_FAMILIES,
     AuditConfig,
     load_config,
     load_waivers,
@@ -50,6 +61,9 @@ def _record_history(result, history_path):
         "waived": len(result.waived),
         "summary": result.summary(),
         "elapsed_s": round(result.elapsed_s, 3),
+        "family_seconds": {
+            k: round(v, 3) for k, v in result.family_seconds.items()
+        },
     }
     try:
         with open(history_path, "a") as f:
@@ -71,16 +85,46 @@ def main(argv=None) -> int:
     ap.add_argument("--waivers", default=None,
                     help=f"waiver file (default: {DEFAULT_WAIVERS} when "
                          f"auditing the repo, none otherwise)")
+    ap.add_argument("--only", default=None, metavar="FAMILY[,FAMILY]",
+                    help="run only these lint families (of: "
+                         f"{', '.join(ALL_FAMILIES)}); implies no history "
+                         f"row and, for a partial range run, no report "
+                         f"drift check")
+    ap.add_argument("--list-families", action="store_true",
+                    help="list the lint families and exit")
+    ap.add_argument("--write-range-report", action="store_true",
+                    help="regenerate the checked-in range report "
+                         "(RANGE_REPORT.json) from the live kernels and "
+                         "exit")
     ap.add_argument("--quiet", action="store_true",
                     help="print only the verdict line, not the report")
     ap.add_argument("--no-history", action="store_true",
                     help="do not append an audit row to BENCH_HISTORY.jsonl")
     args = ap.parse_args(argv)
 
+    if args.list_families:
+        for fam in ALL_FAMILIES:
+            print(fam)
+        return 0
+
     if args.config is not None:
         cfg = load_config(args.config)
     else:
         cfg = AuditConfig()
+
+    if args.write_range_report:
+        from lighthouse_tpu.analysis import range_lint
+        path = range_lint.write_report(args.root, cfg)
+        print(f"wrote {path}")
+        return 0
+
+    if args.only is not None:
+        fams = tuple(f.strip() for f in args.only.split(",") if f.strip())
+        unknown = [f for f in fams if f not in ALL_FAMILIES]
+        if unknown:
+            ap.error(f"unknown families: {', '.join(unknown)} "
+                     f"(of: {', '.join(ALL_FAMILIES)})")
+        cfg.families = fams
     if args.paths is not None:
         cfg.scan_roots = tuple(args.paths)
         # a custom corpus scans everything it contains
@@ -102,7 +146,8 @@ def main(argv=None) -> int:
     if not args.quiet:
         print(json.dumps(report, indent=2))
 
-    if not args.no_history and args.config is None and args.paths is None:
+    if (not args.no_history and args.config is None and args.paths is None
+            and args.only is None):
         _record_history(result, os.path.join(args.root, "BENCH_HISTORY.jsonl"))
 
     verdict = "PASS" if result.ok else "FAIL"
