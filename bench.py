@@ -163,8 +163,12 @@ def run_measurement(force_cpu: bool) -> None:
         "miller_fused": _fp.miller_fused_active(),
         "wsm": _fp.wsm_fused_active(),
     }
+    if os.environ.get("BENCH_MARSHAL", "1") != "0":
+        result["marshal"] = _measure_marshal(device_h2c)
     if os.environ.get("BENCH_PIPELINE", "") == "1":
         result["pipeline"] = _measure_pipeline(B, device_h2c)
+    if os.environ.get("BENCH_EPOCH", "") == "1":
+        result["epoch_system"] = _measure_epoch_system(device_h2c)
     # every jit.compile span recorded this run, with per-program
     # fingerprints — the compile-time attribution ROADMAP item 4 asks for
     from lighthouse_tpu.obs import TRACER
@@ -184,7 +188,152 @@ def run_measurement(force_cpu: bool) -> None:
     if "TPU" in str(dev):
         _record_tpu_history(result)
         _record_compile_history(result)
+        _record_marshal_history(result)
     print(json.dumps(result), flush=True)
+
+
+def _measure_marshal(device_h2c: bool) -> dict:
+    """Marshal microbench: the per-set scalar loop vs the vectorized
+    ingest engine (lighthouse_tpu/ingest) on the two production shapes —
+    gossip (single-signer sets over a warm registry) and committee
+    fan-out (K signers per set, repeat committees, warm aggregate cache).
+    Host-only: no kernel dispatch, so it runs identically on any child.
+    Feeds the kind="marshal" BENCH_HISTORY row."""
+    from lighthouse_tpu.crypto.bls.api import SecretKey, SignatureSet
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import JaxBackend
+    from lighthouse_tpu.ingest import IngestEngine
+    from lighthouse_tpu.utils import metrics as M
+
+    backend = JaxBackend(min_batch=8, device_h2c=device_h2c)
+    engine = IngestEngine(backend, device_gather=False)
+    n_pks = 256
+    sks = [SecretKey(300 + i) for i in range(n_pks)]
+    pks = [sk.public_key() for sk in sks]
+    # marshal never touches signature validity: one signed point serves
+    # every set (signing 1k+ sets would dominate the bench's own wall)
+    sig = sks[0].sign(b"bench")
+    out = {"device_h2c": device_h2c}
+
+    # gossip shape: single-signer sets, every signer in the warm cache
+    n_g = int(os.environ.get("BENCH_MARSHAL_GOSSIP", "2048"))
+    gossip = [
+        # 32-byte messages: gossip verification signs fixed-size roots
+        SignatureSet(sig, [pks[i % n_pks]], i.to_bytes(32, "little"))
+        for i in range(n_g)
+    ]
+    engine.marshal_sets(gossip)  # warm the cache, untimed
+    t0 = time.time()
+    mb = engine.marshal_sets(gossip)
+    t_vec = time.time() - t0
+    assert not mb.invalid
+    t0 = time.time()
+    backend.marshal_sets(gossip)
+    t_scalar = time.time() - t0
+    out["gossip"] = {
+        "sets": n_g,
+        "scalar_sets_per_s": round(n_g / t_scalar, 1),
+        "vectorized_sets_per_s": round(n_g / t_vec, 1),
+        "speedup": round(t_scalar / t_vec, 2),
+    }
+
+    # committee fan-out shape (north-star #2): K signers per set, a
+    # rotation of repeat committees — the epoch-processing regime where
+    # the aggregate cache skips K Jacobian adds per set
+    K = int(os.environ.get("BENCH_MARSHAL_K", "128"))
+    n_c = int(os.environ.get("BENCH_MARSHAL_COMMITTEES", "32"))
+    n_b = int(os.environ.get("BENCH_MARSHAL_B", "1024"))
+    pool_k = min(64, n_pks)
+    committees = [
+        [pks[(c * 7 + j) % pool_k] for j in range(K)] for c in range(n_c)
+    ]
+    sets = [
+        SignatureSet(sig, committees[i % n_c],
+                     (i % n_c).to_bytes(32, "big"))
+        for i in range(n_b)
+    ]
+    engine.marshal_sets(sets)  # warm, untimed
+    hits0 = M.INGEST_CACHE_HITS.value()
+    t0 = time.time()
+    mb = engine.marshal_sets(sets)
+    t_vec = time.time() - t0
+    assert not mb.invalid
+    cache_hits = M.INGEST_CACHE_HITS.value() - hits0
+    t0 = time.time()
+    backend.marshal_sets(sets)
+    t_scalar = time.time() - t0
+    out["committee"] = {
+        "sets": n_b,
+        "signers_per_set": K,
+        "committees": n_c,
+        "scalar_sets_per_s": round(n_b / t_scalar, 1),
+        "vectorized_sets_per_s": round(n_b / t_vec, 1),
+        "speedup": round(t_scalar / t_vec, 2),
+        "cache_hits": cache_hits,
+    }
+    print(f"marshal microbench: {out}", file=sys.stderr)
+    return out
+
+
+def _measure_epoch_system(device_h2c: bool) -> dict:
+    """BENCH_EPOCH=1: the epoch-batch *system* number (north-star #2
+    shape) — committee-aggregate sets streamed through PipelinedVerifier
+    with the ingest engine as the marshal stage, reported as end-to-end
+    sets/s alongside the kernel headline.  Sized by env knobs so the TPU
+    run can scale it up without touching code."""
+    from lighthouse_tpu.beacon.processor import (
+        PipelinedVerifier,
+        ResilientVerifier,
+    )
+    from lighthouse_tpu.crypto.bls.api import (
+        PythonBackend,
+        SecretKey,
+        SignatureSet,
+    )
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import JaxBackend
+    from lighthouse_tpu.ingest import IngestEngine
+
+    K = int(os.environ.get("BENCH_EPOCH_COMMITTEE_SIZE", "128"))
+    n_c = int(os.environ.get("BENCH_EPOCH_COMMITTEES", "16"))
+    per = int(os.environ.get("BENCH_EPOCH_BATCH", "64"))
+    n_batches = int(os.environ.get("BENCH_EPOCH_BATCHES", "4"))
+
+    from lighthouse_tpu.crypto.bls.api import AggregateSignature
+
+    sks = [SecretKey(900 + i) for i in range(K)]
+    pks = [sk.public_key() for sk in sks]
+    committees = []
+    for c in range(n_c):
+        msg = b"epoch-duty-%d" % c
+        agg = AggregateSignature.aggregate([sk.sign(msg) for sk in sks])
+        committees.append(SignatureSet(agg.signature, list(pks), msg))
+    batches = [
+        [committees[j % n_c] for j in range(per)] for _ in range(n_batches)
+    ]
+
+    backend = JaxBackend(min_batch=8, device_h2c=device_h2c)
+    engine = IngestEngine(backend)
+    rv = ResilientVerifier(
+        device_verify=backend.verify_signature_sets,
+        cpu_verify=PythonBackend().verify_signature_sets,
+    )
+    pv = PipelinedVerifier.for_backend(rv, backend, ingest=engine)
+
+    pv.verify_stream(batches[:1])  # compile + cache warm, untimed
+    t0 = time.time()
+    outs = pv.verify_stream(batches)
+    wall = time.time() - t0
+    assert all(all(o.verdicts) for o in outs)
+    total = per * n_batches
+    out = {
+        "committee_size": K,
+        "committees": n_c,
+        "sets": total,
+        "wall_sec": round(wall, 3),
+        "sets_per_s": round(total / wall, 1),
+        "aggregate_signatures_per_s": round(total * K / wall, 1),
+    }
+    print(f"epoch system (north-star #2 shape): {out}", file=sys.stderr)
+    return out
 
 
 def _measure_pipeline(B: int, device_h2c: bool) -> dict:
@@ -222,9 +371,16 @@ def _measure_pipeline(B: int, device_h2c: bool) -> dict:
         device_verify=backend.verify_signature_sets,
         cpu_verify=PythonBackend().verify_signature_sets,
     )
-    pv = PipelinedVerifier.for_backend(rv, backend)
+    # marshal stage = the vectorized ingest engine (cache-backed); the
+    # serial arm below keeps the scalar loop, so the A/B also shows the
+    # marshal stage leaving the critical path
+    from lighthouse_tpu.ingest import IngestEngine
+
+    engine = IngestEngine(backend)
+    pv = PipelinedVerifier.for_backend(rv, backend, ingest=engine)
 
     backend.verify_signature_sets(batches[0])  # compile, untimed
+    engine.marshal_sets(batches[0])  # warm the pubkey cache, untimed
     t0 = time.time()
     for b in batches:
         assert backend.verify_signature_sets(b)
@@ -316,6 +472,32 @@ def _record_compile_history(result: dict) -> None:
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
                     ),
                 }
+                f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
+def _record_marshal_history(result: dict) -> None:
+    """Append a kind="marshal" row per shape so the host-side marshal
+    trajectory is tracked in BENCH_HISTORY the way compile times are."""
+    try:
+        m = result.get("marshal")
+        if not m:
+            return
+        with open(_history_path(), "a") as f:
+            for shape in ("gossip", "committee"):
+                if shape not in m:
+                    continue
+                row = {
+                    "kind": "marshal",
+                    "shape": shape,
+                    "device": result.get("device"),
+                    "device_h2c": m.get("device_h2c"),
+                    "measured_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    ),
+                }
+                row.update(m[shape])
                 f.write(json.dumps(row) + "\n")
     except OSError:
         pass

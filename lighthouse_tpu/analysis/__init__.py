@@ -49,6 +49,7 @@ DEFAULT_NEVER_RAISE = (
     "lighthouse_tpu/beacon/sync.py::SyncManager.tick",
     "lighthouse_tpu/utils/faults.py::FaultInjector.maybe_fire",
     "lighthouse_tpu/beacon/processor.py::BeaconProcessor.try_send",
+    "lighthouse_tpu/ingest/engine.py::IngestEngine.marshal_sets",
 )
 
 ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr", "range")
@@ -66,7 +67,7 @@ class AuditConfig:
     lock_scan_include: tuple = ("lighthouse_tpu/",)
     # never-raise proofs also only bind inside the package
     never_raise: tuple = DEFAULT_NEVER_RAISE
-    safe_calls: tuple = ("BatchOutcome",)
+    safe_calls: tuple = ("BatchOutcome", "MarshalledBatch")
     metrics_defs: str = "lighthouse_tpu/utils/metrics.py"
     faults_defs: str = "lighthouse_tpu/utils/faults.py"
     scenarios_defs: str = "lighthouse_tpu/scenario/spec.py"

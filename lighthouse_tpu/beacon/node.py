@@ -147,8 +147,28 @@ class BeaconNode:
         from .processor import CircuitBreaker, ResilientVerifier
 
         self.breaker = CircuitBreaker()
+        # Vectorized ingest engine (lighthouse_tpu/ingest): when the active
+        # backend exposes the marshal/dispatch/resolve split, route the
+        # device rung's marshal through the cache-backed batch engine —
+        # byte-identical to backend.marshal_sets, degrading to it
+        # internally, so the ladder semantics are unchanged.  The pure-
+        # Python backend has no stage split and keeps the direct call.
+        self.ingest = None
+        _active = _bls_api.get_backend()
+        if hasattr(_active, "marshal_sets") and hasattr(_active, "dispatch"):
+            from ..ingest import IngestEngine
+
+            self.ingest = IngestEngine(
+                _active,
+                pubkey_cache=getattr(self.chain, "pubkey_cache", None),
+            )
+            device_verify = self._ingest_device_verify
+        else:
+            device_verify = (
+                lambda s: _bls_api.get_backend().verify_signature_sets(s)
+            )
         self.verifier = ResilientVerifier(
-            device_verify=lambda s: _bls_api.get_backend().verify_signature_sets(s),
+            device_verify=device_verify,
             cpu_verify=lambda s: _bls_api.cpu_backend().verify_signature_sets(s),
             breaker=self.breaker,
         )
@@ -174,6 +194,24 @@ class BeaconNode:
         )
         self.slot_timer = None
         self._running = False
+
+    def _ingest_device_verify(self, sets) -> bool:
+        """Device rung of the resilience ladder, marshalled by the ingest
+        engine.  Fires the same ``bls.device_verify`` chaos site
+        ``verify_signature_sets`` does, so armed device faults still trip
+        the breaker and fall down the ladder."""
+        from ..crypto.bls import api as _bls_api
+        from ..utils import faults as _faults
+
+        be = _bls_api.get_backend()
+        if self.ingest is None or be is not self.ingest._backend:
+            # backend swapped since wiring: use it directly
+            return be.verify_signature_sets(sets)
+        _faults.fire("bls.device_verify")
+        mb = self.ingest.marshal_sets(sets)
+        if mb.invalid:
+            return False
+        return be.resolve(be.dispatch(mb))
 
     def _subscribe_topics(self, digest: bytes) -> None:
         """Subscribe every gossip topic family under ``digest`` and point
@@ -714,9 +752,12 @@ class BeaconNode:
         from ..utils.slot_clock import SlotTimer
 
         def on_slot(slot: int) -> None:
-            self.maybe_rotate_fork_digest(
-                slot // self.spec.preset.slots_per_epoch
-            )
+            epoch = slot // self.spec.preset.slots_per_epoch
+            self.maybe_rotate_fork_digest(epoch)
+            if self.ingest is not None:
+                # epoch boundary invalidates the aggregate-pubkey cache
+                # tier (participation churn); a repeat call is a no-op
+                self.ingest.begin_epoch(epoch)
             with self._chain_lock:  # atomic check-then-produce
                 if auto_propose and self.keypairs and slot > int(
                     self.chain.head_state().slot

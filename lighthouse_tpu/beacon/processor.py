@@ -571,10 +571,19 @@ class PipelinedVerifier:
 
     @classmethod
     def for_backend(cls, resilient: "ResilientVerifier", backend,
-                    **kw) -> "PipelinedVerifier":
+                    ingest=None, **kw) -> "PipelinedVerifier":
         """Wire the three stages to a JaxBackend's marshal_sets /
-        dispatch / resolve split (crypto/bls/jax_backend/backend.py)."""
-        return cls(resilient, backend.marshal_sets, backend.dispatch,
+        dispatch / resolve split (crypto/bls/jax_backend/backend.py).
+
+        Pass an ``IngestEngine`` (lighthouse_tpu/ingest) as ``ingest`` to
+        use its vectorized, cache-backed marshal as the host stage; it is
+        byte-identical to ``backend.marshal_sets`` and degrades to it
+        internally, so dispatch/resolve and the fallback ladder are
+        untouched.
+        """
+        marshal = ingest.marshal_sets if ingest is not None \
+            else backend.marshal_sets
+        return cls(resilient, marshal, backend.dispatch,
                    backend.resolve, **kw)
 
     def verify_stream(self, batches: list[list]) -> list[BatchOutcome]:

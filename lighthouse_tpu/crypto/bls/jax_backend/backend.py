@@ -433,15 +433,23 @@ class JaxBackend:
     # device rate (6,221 sets/s at B=8192) are near co-bound, so overlap
     # approaches wall = max(marshal, device) instead of their sum.
 
-    def marshal_sets(self, sets) -> MarshalledBatch:
+    def marshal_sets(self, sets, weights=None) -> MarshalledBatch:
         """Pure host stage: validation, pubkey aggregation, hashing, limb
         encode, weight packing.  Thread-safe (no backend state touched
-        besides reads), so a marshal pool may run several concurrently."""
+        besides reads), so a marshal pool may run several concurrently.
+
+        ``weights`` pins the per-set random weight draw (one int per
+        set).  This is the determinism seam the ingest engine's
+        differential suite uses to assert byte-identity between this
+        scalar oracle and the vectorized path; production callers leave
+        it None and get the secrets-drawn weights.
+        """
         if not sets:
             return MarshalledBatch(0, 0, self.device_h2c, invalid=True)
         n = len(sets)
+        given = weights
         pk_pts, sig_pts, h_pts, weights = [], [], [], []
-        for s in sets:
+        for idx, s in enumerate(sets):
             if s.signature.point is None:
                 return MarshalledBatch(n, 0, self.device_h2c, invalid=True)
             if not s.signing_keys:
@@ -465,9 +473,12 @@ class JaxBackend:
                     return MarshalledBatch(n, 0, self.device_h2c,
                                            invalid=True)
                 h_pts.append(h)
-            r = 0
-            while r == 0:
-                r = secrets.randbits(params.RAND_BITS)
+            if given is None:
+                r = 0
+                while r == 0:
+                    r = secrets.randbits(params.RAND_BITS)
+            else:
+                r = int(given[idx])
             pk_pts.append(agg)
             sig_pts.append(s.signature.point)
             weights.append(r)

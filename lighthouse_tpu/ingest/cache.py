@@ -1,0 +1,209 @@
+"""Device-resident pubkey limb cache: repeat signers skip marshal work.
+
+Every attestation epoch re-signs with the same ~1M registry keys, so the
+marshal stage keeps re-paying two per-set costs that depend only on the
+*signer set*: host aggregation (one Jacobian add per signer, ~21 us of
+bigint Python each) and the aggregate's Montgomery limb encode.  This
+cache makes both one-time costs:
+
+* **registry tier** — validator index -> canonical Montgomery limb
+  columns of that validator's G1 pubkey, append-only (a validator's
+  index->key binding is immutable), synced from the beacon
+  ``ValidatorPubkeyCache`` and lazily mirrored to the device, so a batch
+  whose sets all resolve to registry slots gathers its pubkey operand
+  with one on-device ``take`` — no host limb work, no H2D transfer of
+  the pubkey operand at dispatch.
+* **LRU tier** — bounded map from a signer-set identity to the
+  *aggregated* pubkey's limb columns: multi-signer committees and
+  off-registry keys hit here, skipping re-aggregation entirely.  Cleared
+  at every epoch boundary (``begin_epoch``) so participation-bitfield
+  churn cannot pin stale aggregates, and size-bounded with
+  oldest-first eviction.
+
+Identity is by object (``id``): production sets are built from the
+chain's ``ValidatorPubkeyCache``, which hands out stable ``PublicKey``
+objects, and the cache holds a reference to every keyed object so an id
+can never be recycled while its entry lives.  Equal-but-distinct key
+objects simply miss and repopulate — correctness never depends on a hit.
+
+Cached columns are exactly ``fp.encode_mont`` output, so a cache-served
+operand is byte-identical to the scalar marshal's — the differential
+suite asserts this on every corpus shape.
+
+Thread-safe: one lock, batch-granular methods (one acquisition per
+marshal call, not per set).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..utils import metrics as M
+from ..utils.logging import get_logger
+
+log = get_logger("ingest.cache")
+
+DEFAULT_LRU_CAPACITY = 8192
+
+
+class PubkeyLimbCache:
+    """Aggregate-pubkey limb columns keyed by validator index (registry
+    tier) or signer-set identity (LRU tier).  See module docstring."""
+
+    def __init__(self, lru_capacity: int = DEFAULT_LRU_CAPACITY):
+        from ..crypto.bls.jax_backend import fp as F
+
+        self._F = F
+        self._lock = threading.Lock()
+        # registry tier: (N, n) canonical Montgomery limb columns
+        self._reg_x = np.zeros((F.N, 0), dtype=np.uint32)
+        self._reg_y = np.zeros((F.N, 0), dtype=np.uint32)
+        self._reg_keys: list = []          # slot -> PublicKey (id anchor)
+        self._slot_by_id: dict[int, int] = {}
+        # LRU tier: signer-set identity -> (keys_ref, x_col, y_col)
+        self.lru_capacity = max(1, int(lru_capacity))
+        self._lru: OrderedDict = OrderedDict()
+        self._epoch: int | None = None
+        # lazily-built device mirror of the registry columns
+        self._dev = None
+
+    # -- registry tier -----------------------------------------------------
+
+    def sync_registry(self, pubkey_cache) -> int:
+        """Pull validators ``[len(self), len(pubkey_cache))`` from the
+        beacon ValidatorPubkeyCache, limb-encoding the new keys in one
+        vectorized batch.  Returns the number of keys added."""
+        with self._lock:
+            start = len(self._reg_keys)
+            end = len(pubkey_cache)
+            if end <= start:
+                return 0
+            new = [pubkey_cache.get(i) for i in range(start, end)]
+            xs = self._F.encode_mont([pk.point[0].v for pk in new])
+            ys = self._F.encode_mont([pk.point[1].v for pk in new])
+            self._reg_x = np.hstack([self._reg_x, xs])
+            self._reg_y = np.hstack([self._reg_y, ys])
+            for off, pk in enumerate(new):
+                self._slot_by_id[id(pk)] = start + off
+            self._reg_keys.extend(new)
+            self._dev = None  # mirror is stale
+            M.INGEST_CACHE_KEYS.set(len(self._reg_keys) + len(self._lru))
+            return end - start
+
+    def registry_size(self) -> int:
+        with self._lock:
+            return len(self._reg_keys)
+
+    def registry_device(self):
+        """The device-resident mirror: (jnp_x, jnp_y), (N, n) each.
+        Built lazily after registry growth; subsequent gathers run
+        on-device with no host limb traffic."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._dev is None:
+                self._dev = (jnp.asarray(self._reg_x),
+                             jnp.asarray(self._reg_y))
+            return self._dev
+
+    def gather_device(self, slots):
+        """On-device gather of registry columns by validator slot:
+        ``slots`` (B,) int -> ((N, B), (N, B)) jnp arrays."""
+        import jax.numpy as jnp
+
+        dev_x, dev_y = self.registry_device()
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        return jnp.take(dev_x, idx, axis=1), jnp.take(dev_y, idx, axis=1)
+
+    # -- epoch lifecycle ---------------------------------------------------
+
+    def begin_epoch(self, epoch: int) -> None:
+        """Epoch-boundary invalidation: the aggregate LRU is cleared
+        (committee aggregates are an epoch-scoped working set; holding
+        them across the boundary pins stale participation patterns), the
+        registry tier — immutable index->key bindings — survives."""
+        with self._lock:
+            if self._epoch == epoch:
+                return
+            dropped = len(self._lru)
+            self._lru.clear()
+            self._epoch = epoch
+            if dropped:
+                M.INGEST_CACHE_EVICTIONS.inc(dropped)
+            M.INGEST_CACHE_KEYS.set(len(self._reg_keys) + len(self._lru))
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    # -- batch resolve / insert (the marshal-time API) ---------------------
+
+    @staticmethod
+    def _set_key(signing_keys) -> tuple:
+        return tuple(map(id, signing_keys))
+
+    def resolve_batch(self, sets):
+        """One-lock lookup for a whole batch.
+
+        Returns ``(slots, cols, missing)``:
+        * ``slots[i]`` — registry slot for single-signer registry hits,
+          else -1
+        * ``cols[i]`` — (x_col, y_col) for LRU hits
+        * ``missing`` — set indices the engine must aggregate + encode
+          (then hand back via :meth:`insert_aggregates`)
+        """
+        slots = np.full(len(sets), -1, dtype=np.int64)
+        cols: dict[int, tuple] = {}
+        missing: list[int] = []
+        hits = misses = 0
+        with self._lock:
+            for i, s in enumerate(sets):
+                keys = s.signing_keys
+                if len(keys) == 1:
+                    slot = self._slot_by_id.get(id(keys[0]), -1)
+                    if slot >= 0:
+                        slots[i] = slot
+                        hits += 1
+                        continue
+                entry = self._lru.get(self._set_key(keys))
+                if entry is not None:
+                    self._lru.move_to_end(self._set_key(keys))
+                    cols[i] = (entry[1], entry[2])
+                    hits += 1
+                else:
+                    missing.append(i)
+                    misses += 1
+        if hits:
+            M.INGEST_CACHE_HITS.inc(hits)
+        if misses:
+            M.INGEST_CACHE_MISSES.inc(misses)
+        return slots, cols, missing
+
+    def insert_aggregates(self, entries) -> None:
+        """Admit freshly aggregated/encoded signer sets:
+        ``entries`` = [(signing_keys, x_col, y_col)].  Bounded:
+        oldest entries are evicted past ``lru_capacity``."""
+        evicted = 0
+        with self._lock:
+            for keys, x_col, y_col in entries:
+                # hold the key objects: an id can't recycle while cached
+                self._lru[self._set_key(keys)] = (tuple(keys), x_col, y_col)
+            while len(self._lru) > self.lru_capacity:
+                self._lru.popitem(last=False)
+                evicted += 1
+            M.INGEST_CACHE_KEYS.set(len(self._reg_keys) + len(self._lru))
+        if evicted:
+            M.INGEST_CACHE_EVICTIONS.inc(evicted)
+
+    def lru_size(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def registry_columns(self, slots):
+        """Host-side gather: (N, B) x/y columns for registry ``slots``."""
+        with self._lock:
+            return (np.take(self._reg_x, slots, axis=1),
+                    np.take(self._reg_y, slots, axis=1))

@@ -59,6 +59,10 @@ SPANS: dict[str, str] = {
     "jit.compile": "XLA/Mosaic program compile, per-program fingerprint",
     # scenario engine virtual slots (scenario/engine.py)
     "scenario.slot": "one virtual slot of a scenario run",
+    # vectorized ingest engine (ingest/engine.py)
+    "ingest.marshal": "IngestEngine vectorized marshal of one batch",
+    "ingest.expand": "batched SHA-256 hash-to-field draws for the batch",
+    "ingest.encode": "pubkey cache resolve + operand limb assembly",
 }
 
 

@@ -20,6 +20,8 @@ the stack:
   ``store.flush``           SlabStore fsync (L2) — failed durability point
   ``gossip.route``          each simulator-mesh gossip delivery (L5) —
                             lossy / bit-flipping wire hops per peer
+  ``ingest.marshal``        IngestEngine's vectorized marshal entry (L3)
+                            — forces the scalar-oracle degradation path
 
 A site that nothing armed costs one dict lookup (an unarmed ``fire`` is a
 no-op), so production paths keep the hooks compiled in — the same sites
@@ -131,6 +133,7 @@ SITES = {
     "sync.request": "SyncManager client side, decoded chunk list",
     "rpc.respond": "BeaconNode server side, encoded chunk list",
     "gossip.route": "GossipRouter per-delivery wire hop (simulator mesh)",
+    "ingest.marshal": "IngestEngine vectorized marshal entry (ingest/engine.py)",
 }
 
 SITE_PREFIXES = (

@@ -274,6 +274,45 @@ PIPELINE_FALLBACKS = Counter(
 )
 
 # ---------------------------------------------------------------------------
+# Vectorized ingest engine (ingest/): the batch marshal subsystem.  Cache
+# counters are the proof that repeat signers skip aggregation/limb-encode
+# (hit path); the rate gauge and pool depth track whether marshal keeps
+# pace with the device as cores scale.
+# ---------------------------------------------------------------------------
+
+INGEST_CACHE_HITS = Counter(
+    "ingest_pubkey_cache_hits_total",
+    "Signer sets whose aggregated-pubkey limbs came from the cache "
+    "(registry tier or aggregate LRU) — no aggregation, no limb encode",
+)
+INGEST_CACHE_MISSES = Counter(
+    "ingest_pubkey_cache_misses_total",
+    "Signer sets that had to be aggregated and limb-encoded host-side",
+)
+INGEST_CACHE_EVICTIONS = Counter(
+    "ingest_pubkey_cache_evictions_total",
+    "Aggregate-LRU entries dropped (capacity bound or epoch-boundary "
+    "invalidation)",
+)
+INGEST_CACHE_KEYS = Gauge(
+    "ingest_pubkey_cache_keys",
+    "Resident cache entries: registry validators plus live LRU aggregates",
+)
+INGEST_POOL_DEPTH = Gauge(
+    "ingest_pool_depth",
+    "Shards the marshal pool split the last batch into (1 == inline)",
+)
+INGEST_MARSHAL_RATE = Gauge(
+    "ingest_marshal_rate",
+    "Sets marshalled per second by the last vectorized marshal call",
+)
+INGEST_FALLBACKS = Counter(
+    "ingest_fallbacks_total",
+    "Ingest marshal degradations: vectorized path fell back to the scalar "
+    "oracle, or the scalar fallback itself failed (invalid batch)",
+)
+
+# ---------------------------------------------------------------------------
 # Multi-peer sync + peer scoring (beacon/sync.py SyncManager,
 # network/peer_manager.py): the adversarial network boundary.  Batch
 # counters tell whether sync is making progress and against what weather;

@@ -15,3 +15,11 @@ class Counter:
 
 FIXTURE_GOOD = Counter("fixture_good_total", "referenced by metrics_user")
 FIXTURE_ORPHAN = Counter("fixture_orphan_total", "SEED: never referenced")
+# ingest-flavored good shape: cache-counter pair registered AND
+# referenced (mirrors ingest_pubkey_cache_{hits,misses}_total)
+FIXTURE_INGEST_HITS = Counter(
+    "fixture_ingest_cache_hits_total", "referenced by metrics_user"
+)
+FIXTURE_INGEST_MISSES = Counter(
+    "fixture_ingest_cache_misses_total", "referenced by metrics_user"
+)

@@ -6,3 +6,9 @@ from . import metrics_defs as M
 def record():
     M.FIXTURE_GOOD.inc()
     M.FIXTURE_GHOST.inc()  # SEED: not registered in metrics_defs.py
+
+
+def record_ingest():
+    # good shapes: both registered, so neither side flags them
+    M.FIXTURE_INGEST_HITS.inc()
+    M.FIXTURE_INGEST_MISSES.inc()

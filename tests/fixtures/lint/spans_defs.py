@@ -5,4 +5,7 @@
 SPANS = {
     "fixture.span.good": "opened by spans_user.py",
     "fixture.span.orphan": "SEED: registered but never opened",
+    # ingest-flavored good shape: a dotted stage span registered AND
+    # opened (mirrors ingest.marshal/expand/encode in the live registry)
+    "fixture.ingest.marshal": "opened by spans_user.py (good shape)",
 }

@@ -15,3 +15,9 @@ T = _Tracer()
 def work():
     T.span("fixture.span.good")
     T.instant("fixture.span.ghost")  # SEED: unregistered span
+
+
+def marshal():
+    # good shape: registered ingest-style stage span, no violation
+    with T.span("fixture.ingest.marshal"):
+        pass
