@@ -185,10 +185,31 @@ def run_measurement(force_cpu: bool) -> None:
                 f"{c['seconds']:.1f}s {c.get('kernel', '')}",
                 file=sys.stderr,
             )
+        # compile-time regression gate (ROADMAP item 4): any program >3x
+        # slower to compile than its last kind="compile" history row is a
+        # loud failure — fingerprints carry jax version + backend, so CPU
+        # children never compare against TPU rows
+        regressions = _compile_regressions(compiles, _load_history())
+        if regressions:
+            result["compile_regression"] = regressions
+            print("=" * 64, file=sys.stderr)
+            print("COMPILE-TIME REGRESSION (>3x last BENCH_HISTORY entry):",
+                  file=sys.stderr)
+            for r in regressions:
+                print(
+                    f"  {r['fingerprint']} {r.get('kernel') or '?'}: "
+                    f"{r['seconds']:.1f}s vs {r['previous_seconds']:.1f}s "
+                    f"({r['ratio']:.1f}x)",
+                    file=sys.stderr,
+                )
+            print("=" * 64, file=sys.stderr)
+    if os.environ.get("BENCH_MULTICHIP", "") == "1":
+        result["multichip"] = _measure_multichip()
     if "TPU" in str(dev):
         _record_tpu_history(result)
         _record_compile_history(result)
         _record_marshal_history(result)
+        _record_multichip_history(result)
     print(json.dumps(result), flush=True)
 
 
@@ -503,6 +524,120 @@ def _record_marshal_history(result: dict) -> None:
         pass
 
 
+def _record_multichip_history(result: dict) -> None:
+    """Append a kind="multichip" row per mesh width so sets/s-vs-device
+    scaling is tracked in BENCH_HISTORY alongside throughput rows."""
+    try:
+        rows = result.get("multichip")
+        if not rows:
+            return
+        with open(_history_path(), "a") as f:
+            for r in rows:
+                row = {
+                    "kind": "multichip",
+                    "device": result.get("device"),
+                    "measured_at": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                    ),
+                }
+                row.update(r)
+                f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
+def _load_history() -> list[dict]:
+    """All parsed BENCH_HISTORY rows, oldest first (bad lines skipped)."""
+    rows = []
+    try:
+        with open(_history_path()) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rows.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return rows
+
+
+def _compile_regressions(
+    compiles: list[dict], history: list[dict], factor: float = 3.0
+) -> list[dict]:
+    """Programs whose compile time exceeds their last kind="compile"
+    BENCH_HISTORY row by more than ``factor``.  Pure: compares by
+    fingerprint (which bakes in jax version + backend platform, so a CPU
+    child never judges itself against a TPU row)."""
+    last: dict[str, dict] = {}
+    for row in history:
+        if row.get("kind") == "compile" and row.get("fingerprint"):
+            last[row["fingerprint"]] = row
+    out = []
+    for c in compiles:
+        prev = last.get(c.get("fingerprint"))
+        if not prev:
+            continue
+        prev_s = float(prev.get("seconds") or 0.0)
+        if prev_s > 0 and c["seconds"] > factor * prev_s:
+            out.append(
+                {
+                    "fingerprint": c.get("fingerprint"),
+                    "kernel": c.get("kernel"),
+                    "seconds": round(float(c["seconds"]), 1),
+                    "previous_seconds": round(prev_s, 1),
+                    "ratio": round(float(c["seconds"]) / prev_s, 2),
+                }
+            )
+    return out
+
+
+def _measure_multichip() -> list[dict]:
+    """BENCH_MULTICHIP=1: sets/s vs device count through the sharded
+    verify kernel (jax_backend/multichip.py) — the pod-scale scaling
+    curve.  Mesh widths 1/2/4/8 capped by visible devices; on CPU the
+    conftest-style XLA_FLAGS=--xla_force_host_platform_device_count=8
+    recipe makes all four widths measurable."""
+    import jax
+
+    from __graft_entry__ import _example_batch
+    from lighthouse_tpu.crypto.bls.jax_backend.multichip import (
+        make_verify_sharded,
+    )
+    from lighthouse_tpu.parallel.mesh import make_mesh
+
+    B = int(os.environ.get("BENCH_MULTICHIP_BATCH", "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    args = _example_batch(B)
+    rows = []
+    n_dev = len(jax.devices())
+    for n in (1, 2, 4, 8):
+        if n > n_dev:
+            break
+        mesh = make_mesh(n)
+        fn = make_verify_sharded(mesh)
+        ok = fn(*args)  # compile + first run, untimed
+        assert bool(jax.block_until_ready(ok)) is True
+        times = []
+        for _ in range(iters):
+            t0 = time.time()
+            jax.block_until_ready(fn(*args))
+            times.append(time.time() - t0)
+        best = min(times)
+        rows.append(
+            {
+                "devices": n,
+                "batch": B,
+                "best_ms": round(best * 1000, 2),
+                "sets_per_s": round(B / best, 1),
+            }
+        )
+        print(f"multichip scaling: {rows[-1]}", file=sys.stderr)
+    return rows
+
+
 def _last_tpu_measurement() -> dict | None:
     try:
         with open(_history_path()) as f:
@@ -563,6 +698,13 @@ def orchestrate() -> None:
                 if alt["value"] > result["value"]:
                     result = alt
         print(json.dumps(result))
+        if result.get("compile_regression"):
+            print(
+                "bench: FAILING on compile-time regression (see child "
+                "stderr banner above)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
         return
     tpu_error = (result or {}).get("error", "TPU attempt timed out or crashed")
     print(f"TPU attempt failed ({tpu_error}); measuring CPU-XLA fallback",
@@ -579,6 +721,13 @@ def orchestrate() -> None:
             # round (clearly labeled; NOT this run's measurement)
             fallback["last_real_tpu_measurement"] = last
         print(json.dumps(fallback))
+        if fallback.get("compile_regression"):
+            print(
+                "bench: FAILING on compile-time regression (see child "
+                "stderr banner above)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
         return
     print(
         json.dumps(

@@ -50,6 +50,7 @@ DEFAULT_NEVER_RAISE = (
     "lighthouse_tpu/utils/faults.py::FaultInjector.maybe_fire",
     "lighthouse_tpu/beacon/processor.py::BeaconProcessor.try_send",
     "lighthouse_tpu/ingest/engine.py::IngestEngine.marshal_sets",
+    "lighthouse_tpu/parallel/pod.py::PodVerifier.verify_batch",
 )
 
 ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr", "range")

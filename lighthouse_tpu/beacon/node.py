@@ -181,6 +181,23 @@ class BeaconNode:
         from ..utils import faults as faults_mod
 
         self.injector = injector if injector is not None else faults_mod.INJECTOR
+        # pod-scale serving: with more than one device visible and a
+        # shardable backend, put the PodVerifier's per-shard fault domains
+        # in front of the single-device ladder.  Drop-in: it exposes the
+        # same verify_batch/breaker/journal surface, so SyncManager and
+        # the gossip handlers below are untouched.  maybe_build never
+        # raises and returns None on single-device hosts.
+        self.pod = None
+        if self.ingest is not None:
+            from ..parallel.pod import PodVerifier
+
+            self.pod = PodVerifier.maybe_build(
+                self.verifier, backend=_active,
+                marshal=self.ingest.marshal_sets,
+                injector=self.injector,
+            )
+            if self.pod is not None:
+                self.verifier = self.pod
         self.peer_manager = self.host.peer_manager
         from .sync import SyncManager
 

@@ -46,7 +46,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "kinds drop/stall/corrupt-chunk/wrong-blocks/"
                          "extra-blocks arm the req/resp sites, e.g. "
                          "rpc.respond=corrupt-chunk or "
-                         "sync.request=stall:3.0x2 — see utils/faults.py")
+                         "sync.request=stall:3.0x2; pod-mesh kinds arm "
+                         "the per-shard sites, e.g. "
+                         "pod.dispatch=shard-dropx1 or "
+                         "pod.dispatch=device-hang:2.0 or "
+                         "pod.gather=corrupt-shard-result — see "
+                         "utils/faults.py")
     bn.add_argument("--metrics-port", type=int, default=None,
                     metavar="PORT",
                     help="serve /metrics (Prometheus text), /health, and "
@@ -220,6 +225,18 @@ def run_bn(args) -> int:
         from .store import HotColdDB, SlabStore
 
         os.makedirs(args.datadir, exist_ok=True)
+        # JAX persistent compilation cache keyed under the node data dir:
+        # a restarted node reloads its compiled BLS programs instead of
+        # re-paying the XLA compile (ROADMAP item 4).  Best-effort.
+        try:
+            from .crypto.bls.jax_backend.backend import enable_compile_cache
+
+            if enable_compile_cache(os.path.join(args.datadir, "jax_cache")):
+                log_with(log, logging.INFO, "JAX compile cache enabled",
+                         path=os.path.join(args.datadir, "jax_cache"))
+        except Exception as exc:  # noqa: BLE001 — cache is optional
+            log_with(log, logging.WARNING, "JAX compile cache unavailable",
+                     error=str(exc))
         store = HotColdDB(
             store=SlabStore(os.path.join(args.datadir, "beacon.slab")),
             types_family=types_for(spec.preset),

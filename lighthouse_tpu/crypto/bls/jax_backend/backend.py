@@ -37,6 +37,27 @@ from . import points as P
 from . import tower as T
 
 
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` so node
+    restarts reuse compiled BLS programs instead of re-paying minutes of
+    XLA time (ROADMAP item 4).  Best-effort: returns False (never raises)
+    when jax or the cache config is unavailable."""
+    import os
+
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # the BLS programs are exactly the long-compile case the cache
+        # exists for; cache even small/fast entries so tests exercise it
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    except Exception:  # noqa: BLE001 — cache is an optimization, not a dep
+        return False
+
+
 def program_fingerprint(kernel: str, **attrs) -> str:
     """Stable per-program fingerprint for compile-time attribution: the
     kernel entry point + its static shape/config attrs + the jax version

@@ -26,14 +26,58 @@ from . import tower as T
 from .backend import _neg_gen_const, _tree_reduce_g2
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions: top-level ``jax.shard_map`` with
+    ``check_vma`` where available, else ``jax.experimental.shard_map``
+    with its older ``check_rep`` spelling.  Both flags are the same
+    check disabled for the same reason (the scan-carry vma note in
+    make_verify_sharded)."""
+    try:
+        from jax import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def _trailing_extent(tree) -> int:
+    """Trailing-axis extent of the first leaf — the global batch size."""
+    return int(jax.tree.leaves(tree)[0].shape[-1])
+
+
+def _pad_tail_cols(tree, pad: int):
+    """Append ``pad`` copies of column 0 to every leaf's trailing axis.
+
+    Column 0 is an arbitrary *real* entry, so the padding is well-formed
+    field data; whether it is verdict-neutral depends on the kernel's
+    combine — AND-reduce kernels tolerate it as-is (a duplicate of a
+    valid set stays valid; of an invalid set, the verdict was already
+    False), product-combine kernels must additionally mask the padded
+    lanes out (see make_pair_sharded_aggregate_verify).
+    """
+    if pad <= 0:
+        return tree
+    return jax.tree.map(
+        lambda a: jnp.concatenate(
+            [a, jnp.repeat(a[..., :1], pad, axis=-1)], axis=-1
+        ),
+        tree,
+    )
+
+
 def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
     """Build a jitted, mesh-sharded verify kernel.
 
-    Returns fn(pk_aff, sig_aff, h_aff, wbits) -> bool where all inputs carry
-    the global batch on the trailing axis (divisible by the mesh size).
+    Returns fn(pk_aff, sig_aff, h_aff, wbits) -> bool where all inputs
+    carry the global batch on the trailing axis.  Batches not divisible
+    by the mesh size are padded up with duplicates of set 0 (AND-safe:
+    padding cannot flip the conjunction) — each distinct padded extent
+    traces its own program, same as any new batch size.
     """
-    from jax import shard_map
-
     in_spec = batch_spec(2, axis=axis)  # (limbs, B) arrays split on B
 
     def local_part(pk_aff, sig_aff, h_aff, wbits):
@@ -81,14 +125,24 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
     # pinned by the shard-vs-single bit-equality tests
     # (test_multichip.py) and the poisoned-batch rejection in the
     # driver's dryrun.
-    sharded = shard_map(
+    sharded = _shard_map(
         local_part,
         mesh=mesh,
         in_specs=(in_spec, in_spec, in_spec, in_spec),
         out_specs=PS(),
-        check_vma=False,
     )
-    return jax.jit(sharded)
+    jitted = jax.jit(sharded)
+    n = int(mesh.devices.size)
+
+    def verify(pk_aff, sig_aff, h_aff, wbits):
+        pad = (-_trailing_extent(pk_aff)) % n
+        if pad:
+            pk_aff, sig_aff, h_aff, wbits = _pad_tail_cols(
+                (pk_aff, sig_aff, h_aff, wbits), pad
+            )
+        return jitted(pk_aff, sig_aff, h_aff, wbits)
+
+    return verify
 
 
 def make_pair_sharded_aggregate_verify(mesh: Mesh, axis: str = "batch"):
@@ -102,15 +156,19 @@ def make_pair_sharded_aggregate_verify(mesh: Mesh, axis: str = "batch"):
     runs replicated.
 
     Returns fn(pk_aff, h_aff, sig_aff) -> bool: pk/h carry the global pair
-    count on the trailing axis (divisible by the mesh size); sig is the
-    batch-1 aggregate signature, replicated."""
-    from jax import shard_map
-
+    count on the trailing axis; sig is the batch-1 aggregate signature,
+    replicated.  Pair counts not divisible by the mesh size are padded up
+    with duplicates of pair 0 plus a sharded pad mask — unlike the
+    AND-reduce kernel, a padded pair's Miller factor would multiply into
+    the single GT product and change the verdict, so padded lanes are
+    selected to fp12 one before the local reduce."""
     pair_spec = batch_spec(2, axis=axis)
 
-    def local_part(pk_aff, h_aff, sig_aff):
+    def local_part(pk_aff, h_aff, sig_aff, pad_mask):
         ok_sub = jnp.all(P.g2_subgroup_check(sig_aff))
         f_local = PR.miller_loop(pk_aff, h_aff)
+        one = PR._fp12_one_like_from_fp2(f_local[0][0])
+        f_local = T.fp12_select(pad_mask, one, f_local)
         g_local = PR.gt_product(f_local)  # one fp12 partial per device
         # --- the ring: N-1 ppermute hops, each folding the neighbour's
         # partial into the accumulator (ICI traffic = one fp12 per hop) ---
@@ -122,11 +180,21 @@ def make_pair_sharded_aggregate_verify(mesh: Mesh, axis: str = "batch"):
         ok_pair = PR.final_exp_is_one(total)
         return jnp.reshape(ok_pair & ok_sub, ())
 
-    sharded = shard_map(
+    sharded = _shard_map(
         local_part,
         mesh=mesh,
-        in_specs=(pair_spec, pair_spec, PS()),
+        in_specs=(pair_spec, pair_spec, PS(), batch_spec(1, axis=axis)),
         out_specs=PS(),
-        check_vma=False,
     )
-    return jax.jit(sharded)
+    jitted = jax.jit(sharded)
+    n = int(mesh.devices.size)
+
+    def aggregate_verify(pk_aff, h_aff, sig_aff):
+        pairs = _trailing_extent(pk_aff)
+        pad = (-pairs) % n
+        if pad:
+            pk_aff, h_aff = _pad_tail_cols((pk_aff, h_aff), pad)
+        pad_mask = jnp.arange(pairs + pad) >= pairs
+        return jitted(pk_aff, h_aff, sig_aff, pad_mask)
+
+    return aggregate_verify

@@ -63,6 +63,9 @@ SPANS: dict[str, str] = {
     "ingest.marshal": "IngestEngine vectorized marshal of one batch",
     "ingest.expand": "batched SHA-256 hash-to-field draws for the batch",
     "ingest.encode": "pubkey cache resolve + operand limb assembly",
+    # pod-scale verification service (parallel/pod.py)
+    "pod.dispatch": "one pod round: per-shard device dispatch + gather",
+    "pod.reshard": "mesh shrink onto surviving devices (instant event)",
 }
 
 

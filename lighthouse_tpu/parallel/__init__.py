@@ -1,5 +1,10 @@
 """SPMD parallelism toolkit: device meshes + data-parallel sharding
-(SURVEY §2.8 — the DP axis of the framework)."""
+(SURVEY §2.8 — the DP axis of the framework), plus the pod-scale
+verification service with per-shard fault domains (parallel/pod.py).
+
+``pod`` is imported lazily by its consumers (it pulls in the beacon
+processor); only the dependency-free mesh helpers are re-exported here.
+"""
 
 from .mesh import (  # noqa: F401
     BATCH_AXIS,

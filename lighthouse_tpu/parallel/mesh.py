@@ -63,7 +63,12 @@ def ring_reduce(tree, combine, axis: str = BATCH_AXIS):
     full product.  For non-commutative-friendly shapes prefer this over
     all_gather when the partials are large (one hop in flight instead of
     an N-way gather)."""
-    n = jax.lax.axis_size(axis)  # static: the mesh extent
+    try:
+        n = jax.lax.axis_size(axis)  # static: the mesh extent
+    except AttributeError:
+        # older jax (<0.5) has no lax.axis_size; psum of a Python
+        # literal over a named axis folds to a static int
+        n = jax.lax.psum(1, axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def hop(t):

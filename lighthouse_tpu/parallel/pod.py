@@ -1,0 +1,523 @@
+"""Pod-scale verification service: per-shard fault domains on an N-device mesh.
+
+ROADMAP item 2's serving half: :class:`PodVerifier` data-parallel-shards a
+marshalled signature batch across the visible devices and keeps the
+never-drop-a-batch contract of the single-device ladder while any subset
+of the mesh fails underneath it.  Each shard is its own fault domain —
+one hung or dying device costs retries and (past a threshold) its mesh
+seat, never the batch:
+
+* **shard planner** — contiguous trailing-axis slices of the marshalled
+  batch, one per device.  The mesh width is always a power of two
+  (8→4→2→1), so with the backend's power-of-two padded batches every
+  shard width is itself a power of two and the per-width programs stay
+  inside the existing ≤6-program dispatch budget.
+* **per-shard dispatch** — one thread per shard places its slice on its
+  device and runs the width-sized program; the coordinator enforces a
+  per-shard timeout (a hung device leaks its daemon thread exactly like
+  a hung XLA call would) and retries failed shards with exponential
+  backoff on the same device.
+* **device health** — consecutive-failure scoring per device
+  (:class:`DeviceHealth`, the PeerManager idiom): a device that keeps
+  failing is excluded, the batch re-shards onto the surviving mesh, and
+  an excluded device is re-armed after a later probe shard succeeds.
+* **degradation ladder** — pod → reduced mesh → single-device
+  :class:`~..beacon.processor.ResilientVerifier` → CPU.  The pod shares
+  the resilient verifier's CircuitBreaker (mesh exhaustion is a breaker
+  failure; a completed round is a success) and its ``verify_batch`` is
+  registered in ``DEFAULT_NEVER_RAISE`` and proven by the never-raise
+  prover.
+
+Correctness: the pod only ever short-circuits the all-valid case.  A
+completed round whose conjunction is True returns all-True verdicts —
+identical to the single-device oracle, because every shard's padding
+columns are valid duplicates (the backend marshal contract).  Any shard
+verdict of False, any marshal failure, and any mesh exhaustion hand the
+*original* sets to ``resilient.verify_batch`` for the unchanged
+bisection/CPU ladder, so per-set verdicts are byte-identical to the
+oracle under every injected fault.  (A device lying True-for-False is
+outside the model, exactly as on the single-device path.)
+
+Chaos: ``pod.dispatch`` fires inside each shard attempt (``shard-drop``
+kills the shard, ``device-hang:<s>`` hangs it past the timeout) and
+``pod.gather`` fires on the verdict coming back
+(``corrupt-shard-result`` inverts it).  Everything is testable on CPU
+via ``XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..beacon.processor import BatchOutcome
+from ..obs.tracer import TRACER
+from ..utils import metrics as M
+from ..utils.logging import get_logger
+
+log = get_logger("parallel.pod")
+
+
+# ---------------------------------------------------------------------------
+# Shard planning
+# ---------------------------------------------------------------------------
+
+
+def mesh_width(n_devices: int) -> int:
+    """Largest power-of-two mesh that fits on ``n_devices`` (0 when none
+    survive) — the 8→4→2→1 degradation ladder's rung selector."""
+    if n_devices < 1:
+        return 0
+    w = 1
+    while w * 2 <= n_devices:
+        w *= 2
+    return w
+
+
+@dataclass(frozen=True)
+class ShardPlan:
+    """Contiguous [a, b) ranges over the batch axis, one per shard."""
+
+    shards: int
+    bounds: tuple[tuple[int, int], ...]
+
+
+def plan_shards(total: int, shards: int) -> ShardPlan:
+    """Split [0, total) into ``shards`` contiguous near-even ranges.
+
+    With the backend's power-of-two padded batch and a power-of-two mesh
+    the ranges are exactly even (and themselves power-of-two wide, which
+    is what keeps the per-width program count bounded); ragged totals
+    only occur in list-sharding mode, where width is unconstrained.
+    Ranges may be empty when ``shards > total`` — callers skip those.
+    """
+    base, extra = divmod(total, shards)
+    bounds = []
+    a = 0
+    for i in range(shards):
+        b = a + base + (1 if i < extra else 0)
+        bounds.append((a, b))
+        a = b
+    return ShardPlan(shards=shards, bounds=tuple(bounds))
+
+
+def _slice_tree(x, a: int, b: int):
+    """Slice the trailing axis of a marshalled-operand tree: LFp-shaped
+    leaves (``.limbs``/``.bound``), bare arrays, and nested tuples — the
+    same shape contract as the backend's batch slicer, kept local so the
+    pod layer does not import the field stack."""
+    if hasattr(x, "limbs"):
+        return type(x)(x.limbs[..., a:b], x.bound)
+    if hasattr(x, "shape"):
+        return x[..., a:b]
+    if isinstance(x, (tuple, list)):
+        return type(x)(_slice_tree(e, a, b) for e in x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Device health: consecutive-failure scoring, exclusion, probe re-arm
+# ---------------------------------------------------------------------------
+
+
+class DeviceHealth:
+    """Per-device consecutive-failure scores (the PeerManager idiom).
+
+    ``exclusion_threshold`` consecutive shard failures pull a device out
+    of the mesh; after ``probe_after`` subsequent batches the device
+    becomes probe-eligible and a successful probe shard re-arms it.  The
+    cooldown is counted in verify_batch calls, not wall time, so tests
+    are deterministic without sleeping.
+    """
+
+    def __init__(self, n_devices: int, exclusion_threshold: int = 2,
+                 probe_after: int = 2):
+        self.exclusion_threshold = max(1, exclusion_threshold)
+        self.probe_after = max(1, probe_after)
+        self._lock = threading.Lock()
+        self._failures = [0] * n_devices
+        self._excluded: dict[int, int] = {}  # device index -> cooldown left
+
+    def healthy(self) -> list[int]:
+        with self._lock:
+            return [i for i in range(len(self._failures))
+                    if i not in self._excluded]
+
+    def excluded(self) -> list[int]:
+        with self._lock:
+            return sorted(self._excluded)
+
+    def record_success(self, dev: int) -> None:
+        with self._lock:
+            self._failures[dev] = 0
+
+    def record_failure(self, dev: int) -> bool:
+        """Score one shard failure; True when it crossed the threshold
+        and the device was excluded just now."""
+        with self._lock:
+            if dev in self._excluded:
+                return False
+            self._failures[dev] += 1
+            if self._failures[dev] >= self.exclusion_threshold:
+                self._excluded[dev] = self.probe_after
+                return True
+            return False
+
+    def exclude(self, dev: int) -> bool:
+        """Force-exclude (retry budget exhausted); True when newly
+        excluded."""
+        with self._lock:
+            if dev in self._excluded:
+                return False
+            self._excluded[dev] = self.probe_after
+            return True
+
+    def tick(self) -> None:
+        """One verify_batch elapsed: age every exclusion cooldown."""
+        with self._lock:
+            for dev in self._excluded:
+                if self._excluded[dev] > 0:
+                    self._excluded[dev] -= 1
+
+    def probe_ready(self) -> list[int]:
+        with self._lock:
+            return sorted(d for d, cd in self._excluded.items() if cd <= 0)
+
+    def defer_probe(self, dev: int) -> None:
+        """Failed probe: restart the cooldown."""
+        with self._lock:
+            if dev in self._excluded:
+                self._excluded[dev] = self.probe_after
+
+    def rearm(self, dev: int) -> None:
+        with self._lock:
+            self._excluded.pop(dev, None)
+            self._failures[dev] = 0
+
+
+# ---------------------------------------------------------------------------
+# PodVerifier
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _PodJob:
+    """One batch prepared for sharding: the original sets plus (backend
+    mode) the marshalled batch whose trailing axis is the shard axis."""
+
+    sets: list
+    mb: Any = None
+    total: int = 0
+
+
+class PodVerifier:
+    """Data-parallel batch verification over an N-device mesh with
+    per-shard fault domains and the full degradation ladder underneath.
+
+    Two dispatch modes share one coordinator:
+
+    * **backend mode** — ``marshal(sets)`` produces a
+      ``MarshalledBatch``; each shard slices the operand tree, places it
+      on its own device (``jax.device_put``) and runs the backend's
+      width-sized program.  This is the serving configuration.
+    * **list mode** — ``shard_verify(sub_sets) -> bool`` is called per
+      shard on a contiguous sublist.  The scenario harness and the CPU
+      chaos tests ride this one: same planner, same fault domains, same
+      ladder, no kernel compiles.
+
+    Drop-in for every ``verify_batch`` consumer (SyncManager,
+    BeaconNode, the scenario engine) and for ``PipelinedVerifier``'s
+    ``resilient`` slot — ``breaker`` and ``journal`` pass through to the
+    wrapped :class:`ResilientVerifier`.
+    """
+
+    def __init__(
+        self,
+        resilient,
+        backend=None,
+        marshal: Callable[[list], Any] | None = None,
+        shard_verify: Callable[[list], bool] | None = None,
+        devices: list | None = None,
+        shard_timeout: float = 2.0,
+        max_shard_retries: int = 2,
+        backoff_base: float = 0.02,
+        exclusion_threshold: int = 2,
+        probe_after: int = 2,
+        max_rounds: int = 6,
+        injector=None,
+    ):
+        if backend is None and shard_verify is None:
+            raise ValueError(
+                "PodVerifier needs a backend (device mode) or a "
+                "shard_verify callable (list mode)"
+            )
+        self.resilient = resilient
+        self.backend = backend
+        self.marshal = (
+            marshal if marshal is not None
+            else getattr(backend, "marshal_sets", None)
+        )
+        self.shard_verify = shard_verify
+        self.shard_timeout = shard_timeout
+        self.max_shard_retries = max(0, max_shard_retries)
+        self.backoff_base = backoff_base
+        self.exclusion_threshold = exclusion_threshold
+        self.probe_after = probe_after
+        self.max_rounds = max(1, max_rounds)
+        if injector is None:
+            from ..utils import faults as _faults
+
+            injector = _faults.INJECTOR
+        self.injector = injector
+        self._devices = list(devices) if devices is not None else None
+        self.health: DeviceHealth | None = None
+        self._health_lock = threading.Lock()
+
+    # -- drop-in ladder surface (PipelinedVerifier's resilient slot) -------
+
+    @property
+    def breaker(self):
+        return self.resilient.breaker
+
+    @property
+    def journal(self):
+        return self.resilient.journal
+
+    @classmethod
+    def maybe_build(cls, resilient, backend=None, marshal=None, **kw):
+        """A :class:`PodVerifier` when more than one device is visible
+        and the backend exposes the shard surface, else None.  Never
+        raises — pod wiring is strictly opportunistic."""
+        try:
+            import jax
+
+            devices = list(jax.devices())
+            if len(devices) < 2 or backend is None:
+                return None
+            if not hasattr(backend, "_kernel"):
+                return None
+            if marshal is None:
+                marshal = getattr(backend, "marshal_sets", None)
+            if marshal is None:
+                return None
+            return cls(resilient, backend=backend, marshal=marshal,
+                       devices=devices, **kw)
+        except Exception as exc:  # noqa: BLE001 — opportunistic wiring
+            log.warning("pod wiring unavailable: %s", exc)
+            return None
+
+    def devices(self) -> list:
+        if self._devices is None:
+            import jax
+
+            self._devices = list(jax.devices())
+        return self._devices
+
+    # -- entry point (registered in DEFAULT_NEVER_RAISE) -------------------
+
+    def verify_batch(self, sets: list) -> BatchOutcome:
+        sets = list(sets)
+        if not sets:
+            return BatchOutcome(verdicts=[], device_calls=0)
+        try:
+            from ..utils.metrics import VERIFY_BATCH_LATENCY
+
+            with VERIFY_BATCH_LATENCY.timer(), TRACER.span(
+                    "verify.batch", sets=len(sets)):
+                return self._pod_verify(sets)
+        except Exception as exc:  # noqa: BLE001 — never-raise backstop
+            # The pod coordinator already absorbs shard faults and the
+            # ladder below it absorbs device faults; this catches a bug
+            # in the coordinator itself.  Fail closed, same contract as
+            # the single-device ladder.
+            log.error("pod verify_batch backstop caught %s: %s",
+                      type(exc).__name__, exc)
+            return BatchOutcome(verdicts=[False] * len(sets), device_calls=0)
+
+    # -- coordinator --------------------------------------------------------
+
+    def _ensure_health(self) -> DeviceHealth:
+        with self._health_lock:
+            if self.health is None:
+                self.health = DeviceHealth(
+                    len(self.devices()),
+                    exclusion_threshold=self.exclusion_threshold,
+                    probe_after=self.probe_after,
+                )
+            return self.health
+
+    def _ladder(self, sets: list) -> BatchOutcome:
+        M.POD_FALLBACKS.inc()
+        return self.resilient.verify_batch(sets)
+
+    def _pod_verify(self, sets: list) -> BatchOutcome:
+        health = self._ensure_health()
+        health.tick()
+        # one breaker gate per batch, shared with the single-device path:
+        # while OPEN the whole pod stands down (the ladder routes to CPU),
+        # and the half-open probe batch is admitted here exactly once
+        if not self.resilient.breaker.allow_device():
+            return self._ladder(sets)
+        job = self._prepare(sets)
+        if job is None:
+            return self._ladder(sets)
+        for round_no in range(1, self.max_rounds + 1):
+            healthy = health.healthy()
+            width = mesh_width(len(healthy))
+            if width < 1:
+                break
+            M.POD_ACTIVE_SHARDS.set(width)
+            with TRACER.span("pod.dispatch", shards=width,
+                             sets=len(sets), round=round_no):
+                ok = self._run_round(job, healthy[:width], health)
+            if ok is None:
+                # the round lost shards past their retry budget:
+                # re-shard the batch onto the surviving mesh
+                M.POD_RESHARDS.inc()
+                TRACER.instant("pod.reshard", round=round_no,
+                               survivors=len(health.healthy()))
+                continue
+            self.resilient.breaker.record_success()
+            if ok:
+                self.resilient.journal.append(("pod", len(sets)))
+                self._probe_excluded(job, health)
+                return BatchOutcome(
+                    verdicts=[True] * len(sets), device_calls=width
+                )
+            # some shard's conjunction is False: the single-device ladder
+            # re-verifies the ORIGINAL sets with bisection attribution,
+            # keeping per-set verdicts byte-identical to the oracle
+            return self._ladder(sets)
+        # surviving mesh exhausted — that is a backend-level failure.
+        # Still probe cooled-down devices here: with the WHOLE mesh
+        # excluded no round can ever succeed, so without this probe the
+        # pod would stay pinned to the ladder forever.
+        self.resilient.breaker.record_failure()
+        M.POD_ACTIVE_SHARDS.set(0)
+        self._probe_excluded(job, health)
+        return self._ladder(sets)
+
+    def _prepare(self, sets: list) -> _PodJob | None:
+        try:
+            if self.shard_verify is not None:
+                return _PodJob(sets=sets, total=len(sets))
+            mb = self.marshal(sets)
+            if mb is None or getattr(mb, "invalid", False):
+                return None
+            return _PodJob(sets=sets, mb=mb, total=int(mb.B))
+        except Exception as exc:  # noqa: BLE001 — marshal is a ladder rung
+            log.warning("pod marshal failed, taking the ladder: %s", exc)
+            return None
+
+    def _run_round(self, job: _PodJob, device_indices: list[int],
+                   health: DeviceHealth) -> bool | None:
+        """One dispatch round on a fixed mesh.  True/False: every shard
+        resolved and this is the conjunction.  None: the round failed
+        (device newly excluded or retries exhausted) — re-shard."""
+        plan = plan_shards(job.total, len(device_indices))
+        pending = [
+            (sid, dev, a, b)
+            for sid, (dev, (a, b)) in enumerate(
+                zip(device_indices, plan.bounds))
+            if b > a
+        ]
+        verdicts: dict[int, bool] = {}
+        for attempt in range(self.max_shard_retries + 1):
+            if attempt:
+                M.POD_RETRIES.inc(len(pending))
+                time.sleep(self.backoff_base * (2 ** (attempt - 1)))
+            results = self._attempt(job, pending)
+            still, dead = [], False
+            for sid, dev, a, b in pending:
+                res = results.get(sid)
+                if res is None:  # shard raised or timed out
+                    if health.record_failure(dev):
+                        M.POD_EXCLUSIONS.inc()
+                        dead = True
+                    still.append((sid, dev, a, b))
+                else:
+                    health.record_success(dev)
+                    verdicts[sid] = bool(res)
+            pending = still
+            if dead:
+                return None  # a device left the mesh: re-plan, don't retry
+            if not pending:
+                return all(verdicts.values()) if verdicts else True
+        # retries exhausted with shards outstanding: pull their devices
+        # from the mesh so the next round shrinks instead of repeating
+        for _sid, dev, _a, _b in pending:
+            if health.exclude(dev):
+                M.POD_EXCLUSIONS.inc()
+        return None
+
+    def _attempt(self, job: _PodJob, pending: list) -> dict[int, bool]:
+        """Run every pending shard concurrently, one thread per shard,
+        under one wall-clock deadline.  A shard that raises or outlives
+        the deadline simply has no entry in the result map; its thread
+        is a daemon and leaks if truly hung — the same cost as a hung
+        XLA call, paid per shard instead of per batch."""
+        results: dict[int, bool] = {}
+        lock = threading.Lock()
+
+        def run(sid: int, dev: int, a: int, b: int) -> None:
+            try:
+                ok = self._run_shard(job, dev, a, b)
+            except Exception as exc:  # noqa: BLE001 — shard fault domain
+                log.warning("pod shard %d (device %d, [%d:%d)) failed: %s",
+                            sid, dev, a, b, exc)
+                return
+            with lock:
+                results[sid] = ok
+
+        threads = [
+            threading.Thread(target=run, args=jb, daemon=True,
+                             name=f"pod-shard-{jb[0]}")
+            for jb in pending
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.shard_timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        with lock:
+            return dict(results)
+
+    def _run_shard(self, job: _PodJob, dev: int, a: int, b: int) -> bool:
+        self.injector.fire("pod.dispatch")
+        if self.shard_verify is not None:
+            ok = bool(self.shard_verify(job.sets[a:b]))
+        else:
+            ok = self._run_device_shard(job.mb, dev, a, b)
+        return bool(self.injector.fire("pod.gather", ok))
+
+    def _run_device_shard(self, mb, dev: int, a: int, b: int) -> bool:
+        import jax
+
+        device = self.devices()[dev]
+        args = tuple(_slice_tree(x, a, b) for x in mb.args)
+        args = jax.device_put(args, device)
+        handle = self.backend._kernel(b - a)(*args)
+        resolve = getattr(self.backend, "resolve", None)
+        return bool(resolve(handle)) if resolve is not None else bool(handle)
+
+    def _probe_excluded(self, job: _PodJob, health: DeviceHealth) -> None:
+        """After a healthy round: one probe shard per cooled-down
+        excluded device; success re-arms it into the mesh.  Probe
+        failures only restart the cooldown — they never affect the
+        batch's verdict (the caller already has it)."""
+        ready = health.probe_ready()
+        if not ready:
+            return
+        width = max(1, job.total // mesh_width(len(self.devices())))
+        for dev in ready:
+            try:
+                self._run_shard(job, dev, 0, min(job.total, width))
+            except Exception as exc:  # noqa: BLE001 — probe fault domain
+                log.info("pod probe on device %d failed: %s", dev, exc)
+                health.defer_probe(dev)
+                continue
+            health.rearm(dev)
+            M.POD_REARMS.inc()
+            log.info("pod device %d re-armed after probe", dev)

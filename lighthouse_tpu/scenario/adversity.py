@@ -170,10 +170,72 @@ class KillRecoveryTrack(Track):
         engine.note("kill-recovery", slot=slot, ok=report["ok"])
 
 
+class PodDeviceDropTrack(Track):
+    """Pod-serving device loss: at install the engine's verify path is
+    lifted onto a list-mode :class:`~...parallel.pod.PodVerifier` —
+    ``shards`` fault domains over the ResilientVerifier's own
+    ``device_verify``, sharing its breaker/journal — and over the slot
+    window the ``pod.dispatch`` site drops shards with probability ``p``.
+    Repeat offenders are excluded, the batch re-shards onto the
+    surviving mesh (never dropping a batch), and after the window probe
+    shards re-arm the excluded devices."""
+
+    name = "pod-device-drop"
+
+    def __init__(self, shards="4", p="0.7", start="8", end="12",
+                 timeout="30.0"):
+        self.shards = int(shards)
+        self.p = float(p)
+        self.start = int(start)
+        self.end = int(end)
+        self.timeout = float(timeout)
+        self.pod = None
+
+    def install(self, engine) -> None:
+        from ..parallel.pod import PodVerifier
+
+        inner = engine.verifier
+        self.pod = PodVerifier(
+            inner,
+            shard_verify=lambda sub: bool(inner.device_verify(sub)),
+            devices=list(range(self.shards)),
+            injector=engine.injector,
+            shard_timeout=self.timeout,
+            max_shard_retries=1,
+            backoff_base=0.0,
+            exclusion_threshold=2,
+            probe_after=1,
+        )
+        engine.verifier = self.pod
+
+    def on_slot(self, engine, slot: int) -> None:
+        if slot == self.start:
+            engine.injector.arm("pod.dispatch", "shard-drop",
+                                probability=self.p)
+            engine.note("pod-device-drop", slot=slot, armed="shard-drop",
+                        p=self.p, shards=self.shards)
+        elif slot == self.end + 1:
+            engine.injector.disarm("pod.dispatch")
+            engine.note("pod-device-drop", slot=slot,
+                        disarmed="shard-drop")
+
+    def finalize(self, engine) -> None:
+        engine.injector.disarm("pod.dispatch")
+        if self.pod is None:
+            return
+        health = self.pod.health
+        engine.run_facts["pod_batches"] = sum(
+            1 for kind, _n in self.pod.journal if kind == "pod"
+        )
+        engine.run_facts["pod_excluded_at_end"] = (
+            health.excluded() if health is not None else []
+        )
+
+
 TRACKS = {
     cls.name: cls
     for cls in (GossipFaultTrack, DeviceFaultTrack, ByzantineSyncTrack,
-                KillRecoveryTrack)
+                KillRecoveryTrack, PodDeviceDropTrack)
 }
 
 

@@ -133,6 +133,29 @@ SCENARIOS: dict[str, ScenarioSpec] = {
             "max_overlap_wall_ratio": 8.0,
         },
     ),
+    # Pod-serving device loss under mainnet-shape SLOs: the verify path
+    # rides a list-mode PodVerifier (4 per-shard fault domains over the
+    # resilience ladder) and a mid-epoch window drops shards at the
+    # pod.dispatch site until repeat offenders are excluded and batches
+    # re-shard onto the surviving mesh — no batch is ever dropped, the
+    # breaker must be CLOSED again by run end, and the excluded devices
+    # must be probed back in after the window.
+    "pod-degrade": ScenarioSpec(
+        name="pod-degrade",
+        seed=11,
+        n_nodes=3,
+        n_validators=32,
+        epochs=4,
+        traffic=("attestation-flood",),
+        adversity=(
+            "gossip-faults:kind=drop,p=0.10,start=6,end=18",
+            "pod-device-drop:shards=4,p=0.7,start=8,end=14",
+        ),
+        slo={
+            "min_finalized_advance": 0,
+            "require_crash_recovery": False,
+        },
+    ),
     # The same run with the circuit breaker disabled (failure threshold
     # parked at infinity): the device-fault window must now blow the
     # device-retry budget — proof the SLO gates catch regressions.

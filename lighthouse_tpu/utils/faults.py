@@ -22,6 +22,10 @@ the stack:
                             lossy / bit-flipping wire hops per peer
   ``ingest.marshal``        IngestEngine's vectorized marshal entry (L3)
                             — forces the scalar-oracle degradation path
+  ``pod.dispatch``          PodVerifier's per-shard device dispatch (L3)
+                            — shard loss, hung devices mid-batch
+  ``pod.gather``            PodVerifier's per-shard verdict gather (L3)
+                            — corrupted shard results on the way back
 
 A site that nothing armed costs one dict lookup (an unarmed ``fire`` is a
 no-op), so production paths keep the hooks compiled in — the same sites
@@ -57,6 +61,18 @@ the encoded chunk list — beacon/sync.py and beacon/node.py):
                     — trips the strictly-increasing-slots validation)
 * ``extra-blocks``  append a duplicate of the last chunk (over-count /
                     non-monotonic response)
+
+Pod-mesh kinds (armed at the per-shard sites ``pod.dispatch``, around one
+shard's device place+run, and ``pod.gather``, on the shard verdict coming
+back — parallel/pod.py):
+
+* ``shard-drop``           raise :class:`DeviceFault` — the device backing
+                           this shard went away mid-batch
+* ``device-hang:<secs>``   sleep ``delay`` seconds, then pass — a hung
+                           device; the pod's per-shard timeout is what
+                           rescues the batch
+* ``corrupt-shard-result`` invert (or ``mutate``) the gathered shard
+                           verdict — a device returning garbage
 
 Arming is bounded: ``times=N`` auto-disarms after N firings (the breaker
 recovery tests ride this), ``probability`` makes soak tests stochastic.
@@ -117,7 +133,8 @@ class NetworkFault(FaultError):
 
 _KINDS = ("error", "slow", "corrupt", "overflow", "crash", "io-error",
           "torn-write", "drop", "stall", "corrupt-chunk", "wrong-blocks",
-          "extra-blocks")
+          "extra-blocks", "shard-drop", "device-hang",
+          "corrupt-shard-result")
 
 # Canonical site registry.  Every literal site string fired anywhere in
 # the package must appear here (the static audit's fault-sites family
@@ -134,6 +151,8 @@ SITES = {
     "rpc.respond": "BeaconNode server side, encoded chunk list",
     "gossip.route": "GossipRouter per-delivery wire hop (simulator mesh)",
     "ingest.marshal": "IngestEngine vectorized marshal entry (ingest/engine.py)",
+    "pod.dispatch": "PodVerifier per-shard device place+run (parallel/pod.py)",
+    "pod.gather": "PodVerifier per-shard verdict gather (parallel/pod.py)",
 }
 
 SITE_PREFIXES = (
@@ -243,6 +262,8 @@ class FaultInjector:
             exc = lambda: StorageFault(f"injected storage fault at {site}")  # noqa: E731
         if exc is None and kind == "drop":
             exc = lambda: NetworkFault(f"injected network drop at {site}")  # noqa: E731
+        if exc is None and kind == "shard-drop":
+            exc = lambda: DeviceFault(f"injected shard drop at {site}")  # noqa: E731
         with self._lock:
             self._armed[site] = Fault(
                 kind=kind, exc=exc, delay=delay, mutate=mutate,
@@ -264,9 +285,9 @@ class FaultInjector:
     def arm_from_spec(self, spec: str) -> None:
         """Parse a CLI arming spec: ``site=kind[:arg][xN]``.
 
-        ``arg`` is the delay in seconds for ``slow``/``stall`` faults and
-        the on-disk fraction for ``torn-write`` faults; ``xN`` bounds the
-        arm to N firings.  Examples::
+        ``arg`` is the delay in seconds for ``slow``/``stall``/
+        ``device-hang`` faults and the on-disk fraction for ``torn-write``
+        faults; ``xN`` bounds the arm to N firings.  Examples::
 
             bls.device_verify=error x3   ->  "bls.device_verify=errorx3"
             bls.device_verify=slow:0.5
@@ -274,6 +295,9 @@ class FaultInjector:
             store.put=torn-write:0.4x1
             rpc.respond=corrupt-chunk
             sync.request=stall:3.0x2
+            pod.dispatch=shard-dropx1
+            pod.dispatch=device-hang:2.0
+            pod.gather=corrupt-shard-result
         """
         site, _, rest = spec.partition("=")
         if not site or not rest:
@@ -287,7 +311,11 @@ class FaultInjector:
                 rest, times = head, int(n)
         kind, _, arg = rest.partition(":")
         kind = kind.strip()
-        delay = float(arg) if (arg and kind in ("slow", "stall")) else 0.0
+        delay = (
+            float(arg)
+            if (arg and kind in ("slow", "stall", "device-hang"))
+            else 0.0
+        )
         fraction = float(arg) if (arg and kind == "torn-write") else 0.5
         self.arm(site.strip(), kind, delay=delay, times=times,
                  fraction=fraction)
@@ -326,17 +354,21 @@ class FaultInjector:
         f = self._take(site)
         if f is None:
             return payload
-        if f.kind in ("slow", "stall"):
+        if f.kind in ("slow", "stall", "device-hang"):
             time.sleep(f.delay)
             return payload
         if f.kind == "corrupt":
             return f.mutate(payload) if f.mutate is not None else payload
+        if f.kind == "corrupt-shard-result":
+            # default mutator inverts a boolean shard verdict
+            fn = f.mutate or (lambda ok: not ok)
+            return fn(payload)
         if f.kind in _NETWORK_MUTATORS:
             fn = f.mutate or _NETWORK_MUTATORS[f.kind]
             return fn(payload)
         if f.kind == "torn-write":
             raise TornWrite(fraction=f.fraction)
-        if f.kind in ("error", "crash", "io-error", "drop"):
+        if f.kind in ("error", "crash", "io-error", "drop", "shard-drop"):
             raise f.exc()
         return payload  # "overflow" is a check()-site kind; fire is a no-op
 

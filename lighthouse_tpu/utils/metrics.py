@@ -313,6 +313,42 @@ INGEST_FALLBACKS = Counter(
 )
 
 # ---------------------------------------------------------------------------
+# Pod-scale verification service (parallel/pod.py PodVerifier): the
+# multi-device fault-domain surface.  Active-shard count is the live mesh
+# width (8/4/2/1); exclusions/re-arms are the device health tracker's
+# observable half; reshards and retries count the recovery work the fault
+# domains absorbed; fallbacks count batches the pod handed down the ladder
+# to the single-device ResilientVerifier.
+# ---------------------------------------------------------------------------
+
+POD_ACTIVE_SHARDS = Gauge(
+    "pod_active_shards",
+    "Shards (devices) the pod verifier is currently dispatching across "
+    "(mesh width after exclusions: 8/4/2/1, 0 before first use)",
+)
+POD_EXCLUSIONS = Counter(
+    "pod_device_exclusions_total",
+    "Devices excluded from the pod mesh after consecutive shard failures",
+)
+POD_RESHARDS = Counter(
+    "pod_reshards_total",
+    "Batches re-sharded onto a reduced mesh after shard failures",
+)
+POD_RETRIES = Counter(
+    "pod_shard_retries_total",
+    "Per-shard dispatch attempts past the first (timeout or device fault)",
+)
+POD_REARMS = Counter(
+    "pod_device_rearms_total",
+    "Excluded devices re-admitted to the mesh after a probe batch succeeded",
+)
+POD_FALLBACKS = Counter(
+    "pod_fallbacks_total",
+    "Batches the pod handed to the single-device ResilientVerifier ladder "
+    "(mesh exhausted, breaker open, or shard verdict False)",
+)
+
+# ---------------------------------------------------------------------------
 # Multi-peer sync + peer scoring (beacon/sync.py SyncManager,
 # network/peer_manager.py): the adversarial network boundary.  Batch
 # counters tell whether sync is making progress and against what weather;

@@ -23,3 +23,8 @@ FIXTURE_INGEST_HITS = Counter(
 FIXTURE_INGEST_MISSES = Counter(
     "fixture_ingest_cache_misses_total", "referenced by metrics_user"
 )
+# pod-flavored good shape: registered AND referenced (mirrors the
+# pod_reshards_total / pod_device_exclusions_total counter family)
+FIXTURE_POD_RESHARDS = Counter(
+    "fixture_pod_reshards_total", "referenced by metrics_user"
+)

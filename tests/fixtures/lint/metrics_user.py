@@ -12,3 +12,8 @@ def record_ingest():
     # good shapes: both registered, so neither side flags them
     M.FIXTURE_INGEST_HITS.inc()
     M.FIXTURE_INGEST_MISSES.inc()
+
+
+def record_pod():
+    # good shape: registered pod-style counter, no violation
+    M.FIXTURE_POD_RESHARDS.inc()

@@ -6,6 +6,9 @@
 SITES = {
     "fixture.good": "fired by sites_user.py",
     "fixture.orphan": "SEED: registered but never fired",
+    # pod-flavored good shape: a per-shard dispatch site registered AND
+    # fired (mirrors pod.dispatch/pod.gather in the live registry)
+    "fixture.pod.dispatch": "fired by sites_user.py (good shape)",
 }
 
 SITE_PREFIXES = ("fixture.dyn.",)

@@ -12,3 +12,8 @@ INJ = _Injector()
 def go(payload):
     payload = INJ.fire("fixture.good", payload)
     return INJ.fire("fixture.bogus", payload)  # SEED: unregistered site
+
+
+def dispatch_shard(payload):
+    # good shape: registered pod-style dispatch site, no violation
+    return INJ.fire("fixture.pod.dispatch", payload)

@@ -8,4 +8,8 @@ SPANS = {
     # ingest-flavored good shape: a dotted stage span registered AND
     # opened (mirrors ingest.marshal/expand/encode in the live registry)
     "fixture.ingest.marshal": "opened by spans_user.py (good shape)",
+    # pod-flavored good shapes: a dispatch span plus an instant reshard
+    # event (mirrors pod.dispatch/pod.reshard in the live registry)
+    "fixture.pod.dispatch": "opened by spans_user.py (good shape)",
+    "fixture.pod.reshard": "instant event in spans_user.py (good shape)",
 }

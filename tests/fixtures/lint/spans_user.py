@@ -21,3 +21,9 @@ def marshal():
     # good shape: registered ingest-style stage span, no violation
     with T.span("fixture.ingest.marshal"):
         pass
+
+
+def dispatch_round():
+    # good shapes: registered pod-style dispatch span + reshard instant
+    with T.span("fixture.pod.dispatch", shards=4):
+        T.instant("fixture.pod.reshard", survivors=3)

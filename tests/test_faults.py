@@ -267,6 +267,47 @@ class TestNetworkFaultKinds:
         assert f.kind == "corrupt-chunk" and f.remaining == 1
 
 
+class TestPodFaultKinds:
+    """Pod-mesh kinds for the pod.dispatch / pod.gather sites."""
+
+    def test_shard_drop_raises_device_fault(self):
+        inj = FaultInjector()
+        inj.arm("pod.dispatch", "shard-drop", times=1)
+        with pytest.raises(DeviceFault):
+            inj.fire("pod.dispatch")
+        assert inj.fire("pod.dispatch", 7) == 7  # consumed
+
+    def test_device_hang_sleeps_then_passes(self):
+        import time as _time
+
+        inj = FaultInjector()
+        inj.arm("pod.dispatch", "device-hang", delay=0.02, times=1)
+        t0 = _time.monotonic()
+        assert inj.fire("pod.dispatch", "x") == "x"
+        assert _time.monotonic() - t0 >= 0.015
+
+    def test_corrupt_shard_result_inverts_verdict(self):
+        inj = FaultInjector()
+        inj.arm("pod.gather", "corrupt-shard-result", times=2)
+        assert inj.fire("pod.gather", True) is False
+        assert inj.fire("pod.gather", False) is True
+        # custom mutate wins over the default inversion
+        inj.arm("pod.gather", "corrupt-shard-result", mutate=lambda _: 42)
+        assert inj.fire("pod.gather", True) == 42
+
+    def test_arm_from_spec_pod_kinds(self):
+        inj = FaultInjector()
+        inj.arm_from_spec("pod.dispatch=shard-dropx1")
+        f = inj._armed["pod.dispatch"]
+        assert f.kind == "shard-drop" and f.remaining == 1
+        inj.arm_from_spec("pod.dispatch=device-hang:2.5x3")
+        f = inj._armed["pod.dispatch"]
+        assert f.kind == "device-hang" and f.delay == 2.5 and f.remaining == 3
+        inj.arm_from_spec("pod.gather=corrupt-shard-result")
+        f = inj._armed["pod.gather"]
+        assert f.kind == "corrupt-shard-result" and f.remaining is None
+
+
 # ---------------------------------------------------------------------------
 # CircuitBreaker
 # ---------------------------------------------------------------------------

@@ -69,6 +69,81 @@ def test_pair_sharded_aggregate_verify_ring():
     bad[3] = SecretKey(424242).public_key().point
     assert bool(fn(P.g1_encode(bad), h_enc, sig_enc)) is False
 
+def test_pad_tail_cols_and_trailing_extent():
+    """Fast unit: the non-divisible-batch pad helpers (no kernel)."""
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.crypto.bls.jax_backend.multichip import (
+        _pad_tail_cols,
+        _trailing_extent,
+    )
+
+    tree = (jnp.arange(12).reshape(2, 6), jnp.arange(6))
+    assert _trailing_extent(tree) == 6
+    padded = _pad_tail_cols(tree, 2)
+    assert _trailing_extent(padded) == 8
+    a, b = padded
+    assert a.shape == (2, 8)
+    # every pad column is a copy of column 0 (real, well-formed data)
+    assert bool((a[:, 6] == a[:, 0]).all()) and bool((a[:, 7] == a[:, 0]).all())
+    assert bool((b[6:] == b[0]).all())
+    assert _pad_tail_cols(tree, 0) is tree  # pad=0 is the identity
+
+
+@pytest.mark.slow
+def test_sharded_accepts_non_divisible_batch():
+    """B=6 on the 8-device mesh: padded up with duplicates of set 0
+    (AND-safe), and the padding must not mask a genuinely bad set."""
+    import jax
+    from jax.sharding import Mesh
+
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lighthouse_tpu.crypto.bls.jax_backend import points as P
+    from lighthouse_tpu.crypto.bls.jax_backend.multichip import make_verify_sharded
+
+    graft._enable_compile_cache(jax)
+    args = graft._example_batch(6)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+    sharded = make_verify_sharded(mesh)
+    assert bool(sharded(*args)) is True
+    pk, sig, h, wbits = args
+    bad_h = P.g2_encode([hash_to_g2(b"\xEE" * 32)] * 6)
+    assert bool(sharded(pk, sig, bad_h, wbits)) is False
+
+
+@pytest.mark.slow
+def test_pair_sharded_non_divisible_pair_count():
+    """6 pairs of one aggregate-verify over 8 devices: the two padded
+    lanes are selected to fp12 one before the GT product (a duplicated
+    Miller factor would corrupt the single accumulation)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from lighthouse_tpu.crypto.bls.api import AggregateSignature, SecretKey
+    from lighthouse_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lighthouse_tpu.crypto.bls.jax_backend import points as P
+    from lighthouse_tpu.crypto.bls.jax_backend.multichip import (
+        make_pair_sharded_aggregate_verify,
+    )
+
+    graft._enable_compile_cache(jax)
+    n_pairs = 6
+    sks = [SecretKey(8000 + i) for i in range(n_pairs)]
+    msgs = [bytes([40 + i]) * 32 for i in range(n_pairs)]
+    sig = AggregateSignature.aggregate(
+        [sk.sign(m) for sk, m in zip(sks, msgs)]
+    )
+    pk_enc = P.g1_encode([sk.public_key().point for sk in sks])
+    h_enc = P.g2_encode([hash_to_g2(m) for m in msgs])
+    sig_enc = P.g2_encode([sig.signature.point])
+    mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+    fn = make_pair_sharded_aggregate_verify(mesh)
+    assert bool(fn(pk_enc, h_enc, sig_enc)) is True
+    bad = [sk.public_key().point for sk in sks]
+    bad[2] = SecretKey(515151).public_key().point
+    assert bool(fn(P.g1_encode(bad), h_enc, sig_enc)) is False
+
+
 # suite tiering (VERDICT r4 weak #6): JAX-compile-dominated module;
 # deselect with -m 'not compile' for the sub-minute consensus tier
 pytestmark = globals().get('pytestmark', []) + [pytest.mark.compile]

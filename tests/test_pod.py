@@ -1,0 +1,408 @@
+"""Pod-scale verification service: the per-shard fault-domain chaos suite.
+
+Runs entirely on the conftest's virtual 8-device CPU mesh (XLA_FLAGS
+--xla_force_host_platform_device_count=8): the shard planner, the device
+health tracker, backend-mode dispatch through a stub kernel, and every
+injected fault from the ISSUE's corpus — shard-drop mid-batch (re-shard,
+byte-identical verdicts), device-hang (timeout → exclusion → probe
+re-arm), corrupt-shard-result (ladder re-verify), all-devices-down (CPU
+ladder), plus fault-sequence determinism under a pinned seed and a
+randomized fault corpus checked against the single-device oracle.
+"""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon.processor import CircuitBreaker, ResilientVerifier
+from lighthouse_tpu.parallel.pod import (
+    DeviceHealth,
+    PodVerifier,
+    _slice_tree,
+    mesh_width,
+    plan_shards,
+)
+from lighthouse_tpu.utils import faults
+from lighthouse_tpu.utils.faults import DeviceFault, FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_injector():
+    faults.INJECTOR.disarm()
+    yield
+    faults.INJECTOR.disarm()
+
+
+# ---------------------------------------------------------------------------
+# Harness: a list-of-bools "signature set" batch.  A set IS its verdict,
+# so the single-device oracle is trivially [bool(s) for s in sets] and
+# every pod outcome can be checked byte-for-byte against it.
+# ---------------------------------------------------------------------------
+
+
+class StubMB:
+    """Marshalled-batch stand-in: one (1, B) int array, trailing batch."""
+
+    def __init__(self, arr):
+        self.args = (arr,)
+        self.B = arr.shape[-1]
+        self.invalid = []
+
+
+class StubBackend:
+    """Backend-mode surface: marshal + width-keyed kernel + resolve."""
+
+    def __init__(self):
+        self.kernel_widths = []
+        self._lock = threading.Lock()
+
+    def marshal_sets(self, sets):
+        import jax.numpy as jnp
+
+        return StubMB(
+            jnp.array([[1 if s else 0 for s in sets]], dtype=jnp.int32)
+        )
+
+    def _kernel(self, width):
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            self.kernel_widths.append(width)
+        return jax.jit(lambda a: jnp.all(a != 0))
+
+    def resolve(self, handle):
+        return bool(handle)
+
+
+def _oracle(sets):
+    return [bool(s) for s in sets]
+
+
+def _all(sets):
+    if not all(sets):
+        return False
+    return True
+
+
+def make_pod(injector=None, backend=None, shard_verify=None,
+             devices=None, **kw):
+    """A PodVerifier over a fresh ResilientVerifier whose device and CPU
+    rungs are the list-conjunction oracle (virtual clock: no sleeps)."""
+    clock = [0.0]
+    breaker = CircuitBreaker(failure_threshold=3, now=lambda: clock[0])
+    resilient = ResilientVerifier(
+        device_verify=_all,
+        cpu_verify=_all,
+        breaker=breaker,
+        now=lambda: clock[0],
+        injector=injector if injector is not None else FaultInjector(),
+    )
+    if backend is None and shard_verify is None:
+        backend = StubBackend()
+    pod = PodVerifier(
+        resilient,
+        backend=backend,
+        shard_verify=shard_verify,
+        devices=devices,
+        injector=injector if injector is not None else FaultInjector(),
+        backoff_base=0.0,
+        **kw,
+    )
+    return pod, resilient
+
+
+# ---------------------------------------------------------------------------
+# Planner / health units
+# ---------------------------------------------------------------------------
+
+
+class TestPlanner:
+    def test_mesh_width_is_the_ladder_rung(self):
+        assert [mesh_width(n) for n in (0, 1, 2, 3, 5, 7, 8, 9)] == [
+            0, 1, 2, 2, 4, 4, 8, 8,
+        ]
+
+    def test_plan_shards_covers_contiguously(self):
+        plan = plan_shards(10, 4)
+        assert plan.bounds == ((0, 3), (3, 6), (6, 8), (8, 10))
+        # power-of-two batch on power-of-two mesh: exactly even
+        plan = plan_shards(16, 8)
+        assert all(b - a == 2 for a, b in plan.bounds)
+        # more shards than work: trailing ranges are empty, callers skip
+        plan = plan_shards(2, 4)
+        assert plan.bounds == ((0, 1), (1, 2), (2, 2), (2, 2))
+
+    def test_slice_tree_shapes(self):
+        import jax.numpy as jnp
+
+        class LFpLike:
+            def __init__(self, limbs, bound):
+                self.limbs, self.bound = limbs, bound
+
+        arr = jnp.arange(24).reshape(3, 8)
+        lfp = LFpLike(arr, 5)
+        sliced = _slice_tree((lfp, (arr, "meta")), 2, 5)
+        assert sliced[0].limbs.shape == (3, 3) and sliced[0].bound == 5
+        assert sliced[1][0].shape == (3, 3) and sliced[1][1] == "meta"
+
+
+class TestDeviceHealth:
+    def test_threshold_excludes_and_probe_cycle_rearms(self):
+        h = DeviceHealth(4, exclusion_threshold=2, probe_after=1)
+        assert not h.record_failure(1)  # 1 of 2
+        assert h.record_failure(1)      # crossed: newly excluded
+        assert h.healthy() == [0, 2, 3] and h.excluded() == [1]
+        assert not h.record_failure(1)  # already out: not "newly"
+        assert h.probe_ready() == []    # cooldown still pending
+        h.tick()
+        assert h.probe_ready() == [1]
+        h.defer_probe(1)                # failed probe restarts cooldown
+        assert h.probe_ready() == []
+        h.tick()
+        h.rearm(1)
+        assert h.healthy() == [0, 1, 2, 3] and h.excluded() == []
+
+    def test_success_resets_consecutive_score(self):
+        h = DeviceHealth(2, exclusion_threshold=2)
+        h.record_failure(0)
+        h.record_success(0)
+        assert not h.record_failure(0)  # score restarted, not cumulative
+        assert h.excluded() == []
+
+
+# ---------------------------------------------------------------------------
+# Backend-mode dispatch on the virtual 8-device mesh
+# ---------------------------------------------------------------------------
+
+
+class TestBackendMode:
+    def test_clean_round_shards_across_all_devices(self):
+        backend = StubBackend()
+        pod, resilient = make_pod(backend=backend)
+        out = pod.verify_batch([True] * 16)
+        assert out.verdicts == [True] * 16
+        assert out.device_calls == 8  # one shard per device
+        assert resilient.journal == [("pod", 16)]
+        assert sorted(backend.kernel_widths) == [2] * 8
+
+    def test_invalid_set_takes_the_ladder_byte_identical(self):
+        sets = [True, True, False, True] * 2
+        pod, resilient = make_pod()
+        out = pod.verify_batch(sets)
+        assert out.verdicts == _oracle(sets)
+        # pod saw the False conjunction and handed the ORIGINAL sets to
+        # the single-device bisection ladder
+        assert ("pod", len(sets)) not in resilient.journal
+        assert any(kind == "device" for kind, _ in resilient.journal)
+
+    def test_empty_batch_short_circuits(self):
+        pod, _ = make_pod()
+        out = pod.verify_batch([])
+        assert out.verdicts == [] and out.device_calls == 0
+
+    def test_maybe_build_needs_shard_surface(self):
+        _, resilient = make_pod()
+        assert PodVerifier.maybe_build(resilient) is None
+        assert PodVerifier.maybe_build(resilient, backend=object()) is None
+        pod = PodVerifier.maybe_build(resilient, backend=StubBackend())
+        assert isinstance(pod, PodVerifier)
+        assert len(pod.devices()) == 8  # the conftest's virtual mesh
+
+    def test_passes_through_pipelined_verifier_surface(self):
+        pod, resilient = make_pod()
+        assert pod.breaker is resilient.breaker
+        assert pod.journal is resilient.journal
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the ISSUE's fault corpus
+# ---------------------------------------------------------------------------
+
+
+class TestShardDrop:
+    def test_drop_mid_batch_reshards_and_stays_byte_identical(self):
+        from lighthouse_tpu.utils import metrics as M
+
+        inj = FaultInjector()
+        inj.arm("pod.dispatch", "shard-drop", times=1)
+        pod, resilient = make_pod(
+            injector=inj, max_shard_retries=0, exclusion_threshold=1,
+        )
+        reshards0 = M.POD_RESHARDS.value()
+        sets = [True] * 16
+        out = pod.verify_batch(sets)
+        assert out.verdicts == _oracle(sets)  # never drops the batch
+        assert out.device_calls == 4          # 8 -> 4 surviving mesh
+        assert M.POD_RESHARDS.value() == reshards0 + 1
+        assert inj.fired_sequence() == (("pod.dispatch", "shard-drop"),)
+        assert len(pod.health.excluded()) == 1
+        assert resilient.journal == [("pod", 16)]
+
+    def test_retry_rescues_a_transient_drop_without_resharding(self):
+        from lighthouse_tpu.utils import metrics as M
+
+        inj = FaultInjector()
+        inj.arm("pod.dispatch", "shard-drop", times=1)
+        pod, resilient = make_pod(
+            injector=inj, max_shard_retries=2, exclusion_threshold=2,
+        )
+        reshards0 = M.POD_RESHARDS.value()
+        retries0 = M.POD_RETRIES.value()
+        out = pod.verify_batch([True] * 16)
+        assert out.verdicts == [True] * 16
+        assert out.device_calls == 8          # full mesh held
+        assert M.POD_RESHARDS.value() == reshards0
+        assert M.POD_RETRIES.value() == retries0 + 1
+        assert pod.health.excluded() == []
+
+
+class TestDeviceHang:
+    def test_hang_times_out_then_excludes_then_probe_rearms(self):
+        from lighthouse_tpu.utils import metrics as M
+
+        inj = FaultInjector()
+        # one hang, far past the shard timeout.  The timeout carries a
+        # wide margin over the (trivial) honest-shard work so a loaded
+        # host can't starve honest threads into spurious exclusion.
+        inj.arm("pod.dispatch", "device-hang", delay=6.0, times=1)
+        pod, _ = make_pod(
+            injector=inj, shard_timeout=1.0, max_shard_retries=0,
+            exclusion_threshold=1, probe_after=1,
+        )
+        rearms0 = M.POD_REARMS.value()
+        t0 = time.monotonic()
+        out = pod.verify_batch([True] * 8)
+        assert out.verdicts == [True] * 8     # round 2 on the survivors
+        assert time.monotonic() - t0 < 5.0    # timeout, not the full hang
+        assert len(pod.health.excluded()) == 1
+        # next batch: cooldown has aged, the healthy round's probe shard
+        # succeeds (the hang was times=1) and the device re-arms
+        out = pod.verify_batch([True] * 8)
+        assert out.verdicts == [True] * 8 and out.device_calls == 4
+        assert pod.health.excluded() == []
+        assert M.POD_REARMS.value() == rearms0 + 1
+        # full-width mesh restored
+        assert pod.verify_batch([True] * 8).device_calls == 8
+
+
+class TestCorruptShardResult:
+    def test_corrupted_gather_falls_to_ladder_byte_identical(self):
+        inj = FaultInjector()
+        inj.arm("pod.gather", "corrupt-shard-result", times=1)
+        pod, resilient = make_pod(injector=inj)
+        sets = [True] * 16
+        out = pod.verify_batch(sets)
+        # the inverted shard verdict makes the conjunction False; the
+        # ladder re-verifies the ORIGINAL sets, so the corruption costs
+        # latency, never correctness
+        assert out.verdicts == _oracle(sets)
+        assert inj.fired_sequence() == (("pod.gather", "corrupt-shard-result"),)
+        assert ("pod", 16) not in resilient.journal
+
+
+class TestAllDevicesDown:
+    def test_mesh_exhaustion_lands_on_the_cpu_ladder(self):
+        inj = FaultInjector()
+        inj.arm("pod.dispatch", "shard-drop")  # unbounded: every shard
+        clock = [0.0]
+        breaker = CircuitBreaker(failure_threshold=3, now=lambda: clock[0])
+        resilient = ResilientVerifier(
+            device_verify=lambda s: (_ for _ in ()).throw(
+                DeviceFault("device down")
+            ),
+            cpu_verify=_all,
+            breaker=breaker,
+            now=lambda: clock[0],
+            injector=FaultInjector(),
+        )
+        pod = PodVerifier(
+            resilient, shard_verify=_all, devices=list(range(8)),
+            injector=inj, exclusion_threshold=1, max_shard_retries=0,
+            backoff_base=0.0,
+        )
+        sets = [True] * 8
+        out = pod.verify_batch(sets)
+        assert out.verdicts == _oracle(sets)  # the batch still lands
+        assert pod.health.healthy() == []     # whole mesh excluded
+        assert any(kind == "cpu" for kind, _ in resilient.journal)
+
+    def test_open_breaker_stands_the_pod_down(self):
+        pod, resilient = make_pod()
+        for _ in range(3):
+            resilient.breaker.record_failure()
+        assert not resilient.breaker.allow_device()
+        out = pod.verify_batch([True] * 8)
+        assert out.verdicts == [True] * 8
+        # no pod round ran: the ladder (breaker-gated to CPU) served it
+        assert ("pod", 8) not in resilient.journal
+
+
+class TestDeterminismAndCorpus:
+    def _run_once(self, seed):
+        inj = FaultInjector(seed=seed)
+        inj.arm("pod.dispatch", "shard-drop", probability=0.5)
+        pod, _ = make_pod(
+            injector=inj, shard_verify=_all, devices=list(range(8)),
+            exclusion_threshold=1, max_shard_retries=0,
+        )
+        verdicts = []
+        for _ in range(4):
+            verdicts.append(pod.verify_batch([True] * 8).verdicts)
+        return inj.fired_sequence(), verdicts
+
+    def test_pinned_seed_pins_the_fault_sequence(self):
+        seq1, v1 = self._run_once(42)
+        seq2, v2 = self._run_once(42)
+        assert seq1 == seq2 and v1 == v2
+        assert len(seq1) > 0, "the corpus must actually bite"
+        seq3, _ = self._run_once(43)
+        assert seq3 != seq1  # a different seed draws a different stream
+
+    def test_randomized_fault_corpus_matches_the_oracle(self):
+        import random
+
+        for seed in range(6):
+            rng = random.Random(seed)
+            inj = FaultInjector(seed=seed)
+            inj.arm("pod.dispatch", "shard-drop", probability=0.3)
+            pod, _ = make_pod(
+                injector=inj, exclusion_threshold=2, max_shard_retries=1,
+                probe_after=1,
+            )
+            for _ in range(5):
+                sets = [rng.random() < 0.8 for _ in range(rng.choice([5, 8, 16]))]
+                out = pod.verify_batch(sets)
+                assert out.verdicts == _oracle(sets), (
+                    f"seed {seed}: pod diverged from the oracle"
+                )
+
+    def test_corrupt_corpus_matches_the_oracle(self):
+        for seed in range(4):
+            inj = FaultInjector(seed=seed)
+            inj.arm("pod.gather", "corrupt-shard-result", probability=0.4)
+            pod, _ = make_pod(injector=inj)
+            for _ in range(4):
+                sets = [True] * 8
+                assert pod.verify_batch(sets).verdicts == _oracle(sets)
+
+
+class TestNeverRaise:
+    def test_backstop_fails_closed_on_coordinator_bugs(self):
+        pod, _ = make_pod()
+        pod._pod_verify = lambda sets: (_ for _ in ()).throw(
+            RuntimeError("coordinator bug")
+        )
+        out = pod.verify_batch([True, True])
+        assert out.verdicts == [False, False] and out.device_calls == 0
+
+    def test_registered_in_the_never_raise_registry(self):
+        from lighthouse_tpu.analysis import DEFAULT_NEVER_RAISE
+
+        assert (
+            "lighthouse_tpu/parallel/pod.py::PodVerifier.verify_batch"
+            in DEFAULT_NEVER_RAISE
+        )

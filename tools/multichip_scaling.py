@@ -42,7 +42,10 @@ def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # older jax: rely on XLA_FLAGS=--xla_force_host_platform_device_count
     import numpy as np
 
     import __graft_entry__ as graft
