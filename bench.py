@@ -163,8 +163,12 @@ def run_measurement(force_cpu: bool) -> None:
         "miller_fused": _fp.miller_fused_active(),
         "wsm": _fp.wsm_fused_active(),
     }
+    result["mxu_routed"] = _fp.mxu_active()
     if os.environ.get("BENCH_MARSHAL", "1") != "0":
         result["marshal"] = _measure_marshal(device_h2c)
+    if os.environ.get("BENCH_MXU", "") == "1":
+        result["mxu"] = _measure_mxu()
+        _record_mxu_history(result)
     if os.environ.get("BENCH_PIPELINE", "") == "1":
         result["pipeline"] = _measure_pipeline(B, device_h2c)
     if os.environ.get("BENCH_EPOCH", "") == "1":
@@ -292,6 +296,99 @@ def _measure_marshal(device_h2c: bool) -> dict:
         "cache_hits": cache_hits,
     }
     print(f"marshal microbench: {out}", file=sys.stderr)
+    return out
+
+
+def _measure_mxu() -> dict:
+    """BENCH_MXU=1: the MXU-vs-VPU Montgomery core A/B (ROADMAP item 1,
+    tpu_keeper agenda r6).
+
+    Two scopes: (a) the mont_mul kernel microbench — one dispatch per
+    call, identical padding/tiling both arms (ONE _mont_call family
+    keyed on mxu), so the delta is purely VPU schoolbook columns vs the
+    13-bit re-limbed banded matmul; (b) the end-to-end verify kernel
+    with fp.set_mxu toggled across separate jit compiles, at the batch
+    sizes BENCH_MXU_VERIFY_BATCHES (default 512,4096,8192 on TPU — the
+    sweep PERF.md's batch table uses).  On CPU both arms run the exact
+    kernel program in interpret mode: throughput numbers are
+    meaningless there (and labeled), but the rows prove the A/B
+    harness end to end, and the verify sweep defaults to empty to skip
+    the minutes-scale interpret compiles (opt in with the env knob).
+    Feeds the kind="mxu" BENCH_HISTORY rows."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = not on_tpu
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    out = {"backend": jax.default_backend(), "interpret": interpret}
+
+    T = int(os.environ.get("BENCH_MXU_T", "8192" if on_tpu else "128"))
+    rng = np.random.default_rng(0xA8)
+    a = jnp.asarray(rng.integers(0, 1 << 15, size=(26, T), dtype=np.uint32))
+    b = jnp.asarray(rng.integers(0, 1 << 15, size=(26, T), dtype=np.uint32))
+    mm = {"batch": T}
+    for arm, mxu in (("vpu", False), ("mxu", True)):
+        fn = jax.jit(functools.partial(
+            PF.mont_mul_limbs, interpret=interpret, mxu=mxu))
+        fn(a, b).block_until_ready()  # compile, untimed
+        times = []
+        for _ in range(iters):
+            t0 = time.time()
+            fn(a, b).block_until_ready()
+            times.append(time.time() - t0)
+        best = min(times)
+        mm[arm] = {
+            "best_ms": round(best * 1000, 3),
+            "mont_muls_per_s": round(T / best, 1),
+        }
+        print(f"mont_mul microbench [{arm}]: {mm[arm]}", file=sys.stderr)
+    mm["mxu_speedup"] = round(
+        mm["vpu"]["best_ms"] / mm["mxu"]["best_ms"], 3)
+    out["mont_mul"] = mm
+
+    batches = os.environ.get(
+        "BENCH_MXU_VERIFY_BATCHES", "512,4096,8192" if on_tpu else "")
+    verify_rows = []
+    if batches.strip():
+        from __graft_entry__ import _example_batch
+        from lighthouse_tpu.crypto.bls.jax_backend.backend import (
+            _verify_kernel,
+        )
+
+        prev = F.mxu_enabled()
+        try:
+            for Bv in [int(x) for x in batches.split(",") if x.strip()]:
+                args = _example_batch(Bv)
+                row = {"batch": Bv}
+                for arm, mxu in (("vpu", False), ("mxu", True)):
+                    F.set_mxu(mxu)
+                    fn = jax.jit(_verify_kernel)
+                    ok = fn(*args)
+                    assert bool(jax.block_until_ready(ok)) is True
+                    times = []
+                    for _ in range(iters):
+                        t0 = time.time()
+                        jax.block_until_ready(fn(*args))
+                        times.append(time.time() - t0)
+                    best = min(times)
+                    row[arm] = {
+                        "best_ms": round(best * 1000, 2),
+                        "sets_per_s": round(Bv / best, 1),
+                    }
+                row["mxu_speedup"] = round(
+                    row["vpu"]["best_ms"] / row["mxu"]["best_ms"], 3)
+                verify_rows.append(row)
+                print(f"verify A/B: {row}", file=sys.stderr)
+        finally:
+            F.set_mxu(prev)
+    out["verify"] = verify_rows
     return out
 
 
@@ -519,6 +616,34 @@ def _record_marshal_history(result: dict) -> None:
                     ),
                 }
                 row.update(m[shape])
+                f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
+def _record_mxu_history(result: dict) -> None:
+    """Append kind="mxu" rows — one per A/B scope — so the MXU-vs-VPU
+    trajectory lands in BENCH_HISTORY alongside compile/marshal rows.
+    Recorded for CPU children too (interpret-mode harness proof runs):
+    the device field keeps them from ever being read as chip numbers."""
+    try:
+        m = result.get("mxu")
+        if not m:
+            return
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(_history_path(), "a") as f:
+            base = {
+                "kind": "mxu",
+                "device": result.get("device"),
+                "interpret": m.get("interpret"),
+                "measured_at": stamp,
+            }
+            row = dict(base, scope="mont_mul")
+            row.update(m.get("mont_mul") or {})
+            f.write(json.dumps(row) + "\n")
+            for v in m.get("verify") or ():
+                row = dict(base, scope="verify")
+                row.update(v)
                 f.write(json.dumps(row) + "\n")
     except OSError:
         pass

@@ -90,6 +90,10 @@ class AuditConfig:
     range_report: str = "RANGE_REPORT.json"
     # program names to restrict the range family to (empty = all)
     range_only: tuple = ()
+    # replay per-program range verdicts from .range_proof_cache.json
+    # when the kernel sources are unchanged (False / CLI --no-cache
+    # forces fresh interpret-mode traces)
+    range_cache: bool = True
 
 
 @dataclass
@@ -217,6 +221,8 @@ def load_config(path: str) -> AuditConfig:
         cfg.range_report = a["range_report"]
     if "range_only" in a:
         cfg.range_only = tuple(a["range_only"])
+    if "range_cache" in a:
+        cfg.range_cache = bool(a["range_cache"])
     if "hot_path" in a:
         # entries are "relpath::fn" strings
         hp: dict[str, list] = {}

@@ -48,13 +48,25 @@ programs already discharge that obligation universally — the
 composition run still proves accumulation/overflow safety and output
 contracts.
 
-**MXU-readiness report** (``mxu_report``): per-kernel max accumulation
-magnitude from the interval run, the direct dot-product column
-magnitude of the current 15-bit representation, and the limb-split
-table ROADMAP item 1 needs (w <= 9 for f32-mantissa MXU accumulation,
-w <= 12 for int32).  The full result is serialized as
-``RANGE_REPORT.json`` and checked in; the audit regenerates it and
-fails with ``range-report`` on drift.
+**MXU report** (``mxu_report``): per-kernel max accumulation magnitude
+from the interval run, the direct dot-product column magnitude of the
+15-bit representation, the generic limb-split table (w <= 9 for
+f32-mantissa MXU accumulation, w <= 13 for int32), and the
+``selected_split`` block for the split ``pallas_mxu`` actually ships
+(w=13, 31 limbs, int32 column budget 31 * QMAX13^2 < 2^31).  The MXU
+kernels are registered programs like any other — their dot-product
+column proof rides the precise non-negative ``dot_general`` transfer,
+which in turn needs the iota/div/rem/eq handlers to constant-fold the
+in-kernel band matrix to its exact 0/1 entries.  The full result is
+serialized as ``RANGE_REPORT.json`` and checked in; the audit
+regenerates it and fails with ``range-report`` on drift.
+
+**Proof cache**: per-program verdicts are replayed from
+``.range_proof_cache.json`` when a sha256 fingerprint over the kernel
+sources (+ this module + jax/numpy versions) is unchanged — the
+interpret-mode traces dominate audit wall time; the warm path skips
+them all.  ``--no-cache`` (``cfg.range_cache = False``) forces fresh
+traces; cached and fresh runs produce byte-identical verdicts.
 
 Fixture corpora re-point the registry via the ``range_defs`` audit
 config key (a python file exposing ``build_programs()`` /
@@ -299,6 +311,7 @@ class _Interp:
         self.eqn_count = 0
         self.max_any = 0   # max |endpoint| over every integer intermediate
         self.max_acc = 0   # max over `add` outputs — accumulation magnitude
+        self.max_dot = 0   # max over `dot_general` outputs — MXU column sums
         self.unknown_prims: set = set()
         self._swap_target = None
         self._ref_state: dict = {}
@@ -384,6 +397,8 @@ class _Interp:
         name = eqn.primitive.name
         if name == "add" and iv.max_hi() > self.max_acc:
             self.max_acc = iv.max_hi()
+        if name == "dot_general" and iv.max_hi() > self.max_dot:
+            self.max_dot = iv.max_hi()
         lo_ok, hi_ok = iv.min_lo() >= rng[0], iv.max_hi() <= rng[1]
         if lo_ok and hi_ok:
             return iv
@@ -448,6 +463,10 @@ def _h_mul(it, eqn, ins):
 def _h_and(it, eqn, ins):
     a, b = ins
     if a.min_lo() >= 0 and b.min_lo() >= 0:
+        if _is_exact(a) and _is_exact(b):   # e.g. floor-correction preds
+            v = np.broadcast_arrays(a.lo, b.lo)
+            v = (v[0] & v[1]).copy()
+            return [IV(v, v.copy())]
         hi = np.minimum(*np.broadcast_arrays(a.hi, b.hi)).copy()
         return [IV(np.zeros_like(hi), hi)]
     return it.unknown(eqn, ins)
@@ -485,9 +504,64 @@ def _h_shl(it, eqn, ins):
     return it.unknown(eqn, ins)
 
 
+def _is_exact(iv: IV) -> bool:
+    return bool(np.array_equal(iv.lo, iv.hi))
+
+
+_CMP_NP = {
+    "eq": np.equal, "ne": np.not_equal, "lt": np.less, "le": np.less_equal,
+    "gt": np.greater, "ge": np.greater_equal,
+}
+
+
 def _h_cmp(it, eqn, ins):
-    shape = np.broadcast_shapes(*(iv.shape for iv in ins))
+    # exact on degenerate intervals — load-bearing for the MXU path: the
+    # band matrix is built in-kernel as `(iota // n + iota % n) == k`
+    # (pallas_call forbids captured constants), and the dot-product
+    # column proof needs the exact 0/1 band, not the [0, 1] envelope
+    # ([0, 1] weights would put every outer-product element in every
+    # column: ~2^37 >> 2^31).  jnp's integer `//`/`%` also lower their
+    # floor corrections through lt/ne over exact values.
+    a, b = ins
+    shape = np.broadcast_shapes(a.shape, b.shape)
+    op = _CMP_NP.get(eqn.primitive.name)
+    if op is not None and _is_exact(a) and _is_exact(b):
+        v = op(np.broadcast_to(a.lo, shape),
+               np.broadcast_to(b.lo, shape)).astype(np.int64)
+        return [IV(v.copy(), v.copy())]
     return [IV.full(shape, 0, 1)]
+
+
+def _h_sign(it, eqn, ins):
+    # sign is monotone, so endpoint evaluation is sound and exact on
+    # degenerate intervals (jnp floor_div/floor_mod corrections use it)
+    a = ins[0]
+    return [IV(np.sign(a.lo).copy(), np.sign(a.hi).copy())]
+
+
+def _h_div(it, eqn, ins):
+    # jax integer `div` rounds toward zero == floor for non-negative
+    # operands, so monotone endpoint division is exact on degenerate
+    # intervals and sound everywhere non-negative
+    a, b = ins
+    if a.min_lo() >= 0 and b.min_lo() >= 1:
+        shape = np.broadcast_shapes(a.shape, b.shape)
+        return [IV(np.broadcast_to(a.lo, shape) // np.broadcast_to(b.hi, shape),
+                   np.broadcast_to(a.hi, shape) // np.broadcast_to(b.lo, shape))]
+    return it.unknown(eqn, ins)
+
+
+def _h_rem(it, eqn, ins):
+    a, b = ins
+    if a.min_lo() >= 0 and b.min_lo() >= 1:
+        shape = np.broadcast_shapes(a.shape, b.shape)
+        if _is_exact(a) and _is_exact(b):
+            v = np.broadcast_to(a.lo, shape) % np.broadcast_to(b.lo, shape)
+            return [IV(v.copy(), v.copy())]
+        hi = np.minimum(np.broadcast_to(a.hi, shape),
+                        np.broadcast_to(b.hi, shape) - 1).copy()
+        return [IV(np.zeros_like(hi), hi)]
+    return it.unknown(eqn, ins)
 
 
 def _h_select_n(it, eqn, ins):
@@ -608,13 +682,28 @@ def _h_max(it, eqn, ins):
 
 def _h_dot_general(it, eqn, ins):
     a, b = ins
-    (lc, _rc), _batch = eqn.params["dimension_numbers"]
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    shape = _aval_shape(eqn.outvars[0].aval)
+    if not lb and not rb and a.min_lo() >= 0 and b.min_lo() >= 0:
+        # precise non-negative interval matmul: every product term is
+        # monotone in both endpoints, so lo = lo.lo and hi = hi.hi,
+        # contracted per element.  This is what proves the MXU column
+        # budget: an exact 0/1 band row sums only its own diagonal's
+        # outer products (31 * 8194^2 < 2^31), where the k*max*max
+        # envelope below would claim 961 * 8194^2.  float64 is exact
+        # here (sums stay far below 2^53) and _i64 saturates the cast.
+        lo = np.tensordot(a.lo.astype(np.float64),
+                          b.lo.astype(np.float64), axes=(lc, rc))
+        hi = np.tensordot(a.hi.astype(np.float64),
+                          b.hi.astype(np.float64), axes=(lc, rc))
+        if np.shape(lo) == shape:
+            return [IV(_i64(lo), _i64(hi))]
+    # coarse envelope (mixed signs / batch dims): k * max|a| * max|b|
     k = 1
     for d in lc:
         k *= a.shape[d]
     mag = float(k) * max(abs(a.min_lo()), abs(a.max_hi())) \
         * max(abs(b.min_lo()), abs(b.max_hi()))
-    shape = _aval_shape(eqn.outvars[0].aval)
     lo = 0.0 if (a.min_lo() >= 0 and b.min_lo() >= 0) else -mag
     return [IV.full(shape, int(_i64(np.float64(lo))),
                     int(_i64(np.float64(mag))))]
@@ -829,6 +918,7 @@ _HANDLERS = {
     "shift_left": _h_shl,
     "eq": _h_cmp, "ne": _h_cmp, "lt": _h_cmp, "le": _h_cmp,
     "gt": _h_cmp, "ge": _h_cmp,
+    "div": _h_div, "rem": _h_rem, "sign": _h_sign,
     "select_n": _h_select_n,
     "broadcast_in_dim": _h_broadcast_in_dim,
     "reshape": _h_reshape, "squeeze": _h_reshape,
@@ -879,6 +969,25 @@ def caps_iv(shape, kind="quasi", bound=None) -> IV:
     return IV(np.zeros(shape, dtype=np.int64), hi)
 
 
+def _limbs_mod():
+    from lighthouse_tpu.crypto.bls.jax_backend import limbs as L
+    return L
+
+
+def caps13_iv(shape, kind="quasi13") -> IV:
+    """Per-limb input interval for a (31, T) 13-bit limb plane.
+
+    kind "strict13" caps rows at 2^13 - 1, "quasi13" at limbs.SPEC13's
+    QMAX13 = 2^13 + 2 — the declared representation contract of the MXU
+    re-limb (``_to13`` actually proves <= 8193; the extra headroom keeps
+    the contract independent of the conversion's incidental tightness).
+    """
+    L = _limbs_mod()
+    base = (1 << 13) - 1 if kind == "strict13" else int(L.SPEC13.qmax)
+    return IV(np.zeros(shape, dtype=np.int64),
+              np.full(shape, base, dtype=np.int64))
+
+
 def bits_iv(shape) -> IV:
     return IV.full(shape, 0, 1)
 
@@ -896,9 +1005,12 @@ _FP_PATH = "lighthouse_tpu/crypto/bls/jax_backend/fp.py"
 _PF_PATH = "lighthouse_tpu/crypto/bls/jax_backend/pallas_fp.py"
 _PM_PATH = "lighthouse_tpu/crypto/bls/jax_backend/pallas_miller.py"
 _PW_PATH = "lighthouse_tpu/crypto/bls/jax_backend/pallas_wsm.py"
+_PMX_PATH = "lighthouse_tpu/crypto/bls/jax_backend/pallas_mxu.py"
 
 STRICT_CONTRACT = "strict"
 QUASI_CONTRACT = "quasi"
+STRICT13_CONTRACT = "strict13"   # < 2^13 (MXU plane, post carry chain)
+QUASI13_CONTRACT = "quasi13"     # <= QMAX13 = 2^13 + 2 (MXU plane)
 
 
 def _u32(shape):
@@ -1014,6 +1126,72 @@ def _build_wsm(ncoords):
     return fn, tuple(args), ivs
 
 
+def _build_mxu_mont():
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+
+    def fn(x, y):
+        return PF.mont_mul_limbs(x, y, interpret=True, mxu=True)
+
+    a = _u32((26, _TILE))
+    return fn, (a, a), [caps_iv((26, _TILE)), caps_iv((26, _TILE))]
+
+
+def _build_mxu_mont_sqr():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_mxu as PMX
+
+    def kernel(a_ref, p_ref, pp_ref, o_ref):
+        a = a_ref[:]
+        o_ref[:] = PMX.mont_core_mxu(a, a, p_ref[:], pp_ref[:])
+
+    p = jnp.broadcast_to(jnp.asarray(PF._P_COLS, dtype=jnp.uint32),
+                         (26, _TILE))
+    pp = jnp.broadcast_to(jnp.asarray(PF._PP_COLS, dtype=jnp.uint32),
+                          (26, _TILE))
+
+    def fn(a, pc, ppc):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((26, _TILE), jnp.uint32),
+            interpret=True,
+        )(a, pc, ppc)
+
+    return fn, (_u32((26, _TILE)), p, pp), [
+        caps_iv((26, _TILE)), IV.const(np.asarray(p)), IV.const(np.asarray(pp)),
+    ]
+
+
+def _build_mxu_megachain():
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_fp as PF
+
+    def fn(x):
+        return PF.pow_chain_limbs(x, 0x1234, interpret=True, mxu=True)
+
+    a = _u32((26, _TILE))
+    return fn, (a,), [caps_iv((26, _TILE))]
+
+
+def _build_mxu_component(which):
+    """Standalone traces of the MXU re-limb/dot building blocks at their
+    *declared* representation caps — stronger than the derived bounds the
+    whole-kernel runs propagate, so the contracts stay meaningful if the
+    conversions ever get looser."""
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_mxu as PMX
+    if which == "to13":
+        a = _u32((26, _TILE))
+        return (lambda x: PMX._to13(x)), (a,), [caps_iv((26, _TILE))]
+    if which == "to15":
+        a = _u32((31, _TILE))
+        return (lambda x: PMX._to15(x)), (a,), \
+            [caps13_iv((31, _TILE), "strict13")]
+    a = _u32((31, _TILE))
+    return (lambda x, y: PMX._dot_cols(x, y)), (a, a), \
+        [caps13_iv((31, _TILE)), caps13_iv((31, _TILE))]
+
+
 def _build_xla_mont():
     from lighthouse_tpu.crypto.bls.jax_backend import fp as F
 
@@ -1100,6 +1278,40 @@ def build_live_programs() -> list:
             note="fp2 Karatsuba pow chain; exit bounds <= (3.2P, 5.2P)",
         ),
         RangeProgram(
+            "mxu_mont_mul", _PMX_PATH, _build_mxu_mont,
+            contracts=((0, STRICT_CONTRACT),),
+            note="13-bit dot-product Montgomery kernel, ALL quasi inputs; "
+                 "the int32 MXU column budget rides the precise "
+                 "dot_general transfer (exact iota-built 0/1 band)",
+        ),
+        RangeProgram(
+            "mxu_mont_sqr", _PMX_PATH, _build_mxu_mont_sqr,
+            contracts=((0, STRICT_CONTRACT),),
+            note="MXU square (mont_core_mxu(a, a)), ALL quasi inputs",
+        ),
+        RangeProgram(
+            "mxu_megachain_w4", _PMX_PATH, _build_mxu_megachain,
+            contracts=((0, QUASI_CONTRACT),), clamp_sub=True,
+            note="fused pow chain on the MXU cores (mxu=True route); "
+                 "same exit contract as the VPU megachain",
+        ),
+        RangeProgram(
+            "mxu_to13", _PMX_PATH, lambda: _build_mxu_component("to13"),
+            contracts=((0, QUASI13_CONTRACT),),
+            note="15->13 re-limb: quasi-15 in, quasi-13 (<= QMAX13) out",
+        ),
+        RangeProgram(
+            "mxu_to15", _PMX_PATH, lambda: _build_mxu_component("to15"),
+            contracts=((0, STRICT_CONTRACT),),
+            note="13->15 bit regroup: strict-13 in, strict-15 out",
+        ),
+        RangeProgram(
+            "mxu_dot_cols", _PMX_PATH, lambda: _build_mxu_component("dot"),
+            contracts=((0, QUASI13_CONTRACT),),
+            note="61-column banded matmul at the declared quasi-13 cap: "
+                 "31 * QMAX13^2 < 2^31 int32 budget",
+        ),
+        RangeProgram(
             "pallas_miller_dbl", _PM_PATH, lambda: _build_miller("dbl"),
             contracts=tuple((i, QUASI_CONTRACT) for i in range(18)),
             clamp_sub=True, heavy=True,
@@ -1174,6 +1386,8 @@ def _default_ivs(closed, provided):
             out.append(IV.full(shape, 0, 15))
         elif dt == "uint32" and len(shape) == 2 and shape[0] == 26:
             out.append(caps_iv(shape))
+        elif dt == "uint32" and len(shape) == 2 and shape[0] == 31:
+            out.append(caps13_iv(shape))
         else:
             out.append(IV.full(shape, rng[0], rng[1]))
     return out
@@ -1201,6 +1415,10 @@ def analyze_program(prog: RangeProgram) -> tuple:
             label, cap = kind
         elif kind == STRICT_CONTRACT:
             label, cap = "strict", F.MASK
+        elif kind == STRICT13_CONTRACT:
+            label, cap = "strict13", (1 << 13) - 1
+        elif kind == QUASI13_CONTRACT:
+            label, cap = "quasi13", int(_limbs_mod().SPEC13.qmax)
         else:
             label, cap = "quasi", F.QMAX
         if iv.max_hi() > cap or iv.min_lo() < 0:
@@ -1214,6 +1432,7 @@ def analyze_program(prog: RangeProgram) -> tuple:
         "eqns": interp.eqn_count,
         "max_any_log2": log2_or_zero(interp.max_any),
         "max_acc_log2": log2_or_zero(interp.max_acc),
+        "max_dot_log2": log2_or_zero(interp.max_dot),
         "out_caps": [iv.max_hi() for iv in outs],
         "contracts_ok": contracts_ok,
         "note": prog.note,
@@ -1383,9 +1602,13 @@ def mxu_report(program_reports: dict) -> dict:
         per_kernel[name] = {
             "max_acc_log2": acc,
             "max_any_log2": rep["max_any_log2"],
+            "max_dot_log2": rep.get("max_dot_log2", 0.0),
             "f32_ok": acc < 24,
             "i32_ok": acc < 31,
         }
+    L = _limbs_mod()
+    q13, nl13 = int(L.SPEC13.qmax), int(L.SPEC13.n)
+    col13 = nl13 * q13 * q13
     return {
         "budgets": {"f32_mantissa_log2": 24, "i32_log2": 31},
         "current_rep": {
@@ -1397,14 +1620,25 @@ def mxu_report(program_reports: dict) -> dict:
         "limb_split_table": table,
         "max_w_f32": w_f32,
         "max_w_i32": w_i32,
+        # the split pallas_mxu ships: w=13 with one spill row (quasi-15
+        # inputs overhang 2^390 by up to 2^-15), proved by the mxu_*
+        # programs above rather than read off the generic table
+        "selected_split": {
+            "w": 13, "limbs": nl13, "qmax": q13,
+            "col_log2": log2_or_zero(col13),
+            "i32_ok": col13 < I32_BUDGET,
+            "kernels": ["mxu_mont_mul", "mxu_mont_sqr",
+                        "mxu_megachain_w4"],
+        },
         "per_kernel": per_kernel,
         "conclusion": (
-            f"current {F.BITS}-bit limbs cannot MXU-accumulate a "
-            f"schoolbook column without the plo/phi split "
-            f"(2^{log2_or_zero(direct_col)} > 2^31); ROADMAP item 1 "
-            f"needs a re-split to w<={w_f32} ({-(-FIELD_BITS // w_f32)} "
-            f"limbs) for f32 dot-products or w<={w_i32} "
-            f"({-(-FIELD_BITS // w_i32)} limbs) for int32 accumulation"
+            f"direct {F.BITS}-bit columns cannot MXU-accumulate "
+            f"(2^{log2_or_zero(direct_col)} > 2^31); the shipped MXU "
+            f"path (pallas_mxu, LIGHTHOUSE_TPU_MXU=1) re-limbs to w=13 "
+            f"({nl13} limbs incl. the spill row, column ceiling "
+            f"2^{log2_or_zero(col13)} < 2^31, int32-proved by "
+            f"mxu_mont_mul/mxu_dot_cols); f32 dot-products would need "
+            f"w<={w_f32} ({-(-FIELD_BITS // w_f32)} limbs)"
         ),
     }
 
@@ -1412,6 +1646,53 @@ def mxu_report(program_reports: dict) -> dict:
 # ---------------------------------------------------------------------------
 # Audit-family entry points
 # ---------------------------------------------------------------------------
+
+
+_CACHE_FILE = ".range_proof_cache.json"
+_CACHE_VERSION = 1
+# per-generate() hit/miss side channel (tests and tooling read it) —
+# kept OUT of the report dict so cold and warm reports stay
+# byte-identical and the drift check cannot tell them apart
+_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def _proof_fingerprint(root: str) -> str:
+    """Content hash of everything a live program verdict depends on.
+
+    Coarse by design: one hash over the whole kernel package, this
+    module, and the jax/numpy versions.  Any kernel edit invalidates
+    every cached verdict (sound, and the cold run is the status quo);
+    an untouched tree replays all of them (the >=5x warm win the audit
+    wall-time needs — the traces are minutes, the hash is milliseconds).
+    """
+    import hashlib
+
+    import jax
+    h = hashlib.sha256()
+    h.update(
+        f"v{_CACHE_VERSION}|jax {jax.__version__}|np {np.__version__}"
+        .encode()
+    )
+    deps = [
+        "lighthouse_tpu/analysis/range_lint.py",
+        "lighthouse_tpu/analysis/report.py",
+        "lighthouse_tpu/crypto/bls/params.py",
+    ]
+    kdir = "lighthouse_tpu/crypto/bls/jax_backend"
+    full_kdir = os.path.join(root, kdir)
+    if os.path.isdir(full_kdir):
+        deps.extend(
+            f"{kdir}/{fn}" for fn in sorted(os.listdir(full_kdir))
+            if fn.endswith(".py")
+        )
+    for rel in deps:
+        h.update(rel.encode())
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"?")
+    return h.hexdigest()
 
 
 def _load_defs(root: str, rel_path: str):
@@ -1438,7 +1719,18 @@ def generate(root: str, cfg, only: tuple = ()) -> tuple:
 
     ``only`` restricts to named programs (test tiers use it to skip the
     minutes-scale Miller traces).
+
+    Per-program verdicts (violations + report entry) are cached in
+    ``.range_proof_cache.json`` keyed by ``_proof_fingerprint``: warm
+    re-audits of an untouched tree replay them without re-tracing, and
+    a replayed report is byte-identical to a fresh one (entries are
+    json-round-tripped before first use).  ``cfg.range_cache = False``
+    (CLI ``--no-cache``) bypasses read AND write; fixture corpora
+    (``range_defs``) are never cached — their programs are trivial and
+    their verdicts must not share a file with the live tree's.
     """
+    _CACHE_STATS["hits"] = 0
+    _CACHE_STATS["misses"] = 0
     try:
         import jax  # noqa: F401
     except Exception as exc:  # pragma: no cover - jax is baked in
@@ -1451,19 +1743,55 @@ def generate(root: str, cfg, only: tuple = ()) -> tuple:
     programs, claim_sets = _resolve_registry(root, cfg)
     if only:
         programs = [p for p in programs if p.name in only]
+    use_cache = bool(getattr(cfg, "range_cache", True)) \
+        and not getattr(cfg, "range_defs", None)
+    cache_path = os.path.join(root, _CACHE_FILE)
+    fingerprint = _proof_fingerprint(root) if use_cache else ""
+    cached: dict = {}
+    if use_cache:
+        try:
+            with open(cache_path, encoding="utf-8") as f:
+                disk = json.load(f)
+            if disk.get("fingerprint") == fingerprint:
+                cached = dict(disk.get("programs") or {})
+        except (OSError, ValueError):
+            cached = {}
+    dirty = False
     prog_reports: dict = {}
     for prog in programs:
-        try:
-            vios, rep = analyze_program(prog)
-        except Exception as exc:
-            violations.append(Violation(
-                rule=RULE_INTERP, path=prog.path, line=0,
-                symbol=prog.name,
-                message=f"program failed to trace/analyze: {exc!r}",
-            ))
-            continue
+        entry = cached.get(prog.name)
+        if entry is not None:
+            _CACHE_STATS["hits"] += 1
+            vios = [Violation(**v) for v in entry["violations"]]
+            rep = entry["report"]
+        else:
+            _CACHE_STATS["misses"] += 1
+            try:
+                vios, rep = analyze_program(prog)
+            except Exception as exc:
+                violations.append(Violation(
+                    rule=RULE_INTERP, path=prog.path, line=0,
+                    symbol=prog.name,
+                    message=f"program failed to trace/analyze: {exc!r}",
+                ))
+                continue
+            rep = json.loads(json.dumps(rep))
+            if use_cache:
+                cached[prog.name] = {
+                    "violations": [v.to_dict() for v in vios],
+                    "report": rep,
+                }
+                dirty = True
         violations.extend(vios)
         prog_reports[prog.name] = rep
+    if use_cache and dirty:
+        try:
+            with open(cache_path, "w", encoding="utf-8") as f:
+                json.dump(
+                    {"fingerprint": fingerprint, "programs": cached}, f
+                )
+        except OSError:
+            pass   # unwritable cache just means the next run is cold too
     checks_out: list = []
     for claims in claim_sets:
         vios, checks = lfp_check(claims)

@@ -366,7 +366,11 @@ class JaxBackend:
         self.device_h2c = device_h2c
 
     def _kernel(self, B: int):
-        key = (B, self.device_h2c)
+        # mxu joins the cache key AND the compile fingerprint: flipping
+        # LIGHTHOUSE_TPU_MXU (bench A/Bs use set_mxu in-process) selects
+        # a different Mosaic program for every Montgomery product in the
+        # trace, so a stale cached executable would silently A/A.
+        key = (B, self.device_h2c, F.mxu_enabled())
         if key not in self._kernels:
             import jax
 
@@ -383,6 +387,7 @@ class JaxBackend:
                 fn,
                 program_fingerprint(
                     fn.__name__, B=B, device_h2c=self.device_h2c,
+                    mxu=F.mxu_enabled(),
                 ),
                 donate_argnums=donate,
             )

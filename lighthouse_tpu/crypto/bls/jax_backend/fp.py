@@ -497,6 +497,40 @@ def miller_fused_active() -> bool:
     )
 
 
+_MXU_MODE: bool | None = None
+
+
+def mxu_enabled() -> bool:
+    """LIGHTHOUSE_TPU_MXU=1 routes every Montgomery product — the
+    standalone mont_mul kernel, the megachains, and the fused Miller
+    loop — through the 13-bit re-limbed dot-product core
+    (pallas_mxu.py) that runs the schoolbook column accumulation on the
+    MXU instead of the VPU.  Interpret-proven byte-identical to the VPU
+    kernels and range-proven under the int32 2^31 MXU budget; flips to
+    default-on once the on-chip A/B (tpu_keeper agenda r6) lands."""
+    global _MXU_MODE
+    if _MXU_MODE is None:
+        import os
+
+        _MXU_MODE = os.environ.get("LIGHTHOUSE_TPU_MXU", "") == "1"
+    return _MXU_MODE
+
+
+def set_mxu(enabled: bool) -> None:
+    """In-process A/B toggle (mirrors set_chains)."""
+    global _MXU_MODE
+    _MXU_MODE = enabled
+
+
+def mxu_active() -> bool:
+    """Gate for the MXU dot-product Montgomery core: pallas on + opted
+    in + a real TPU backend (interpret mode is reached explicitly by
+    tests and the CPU bench fallback)."""
+    return (
+        pallas_enabled() and mxu_enabled() and _device_backend()
+    )
+
+
 def mont_mul(a: LFp, b: LFp) -> LFp:
     """Montgomery product a*b*R^-1 mod P (strict limbs out)."""
     prod = a.bound * b.bound

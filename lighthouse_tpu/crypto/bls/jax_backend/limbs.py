@@ -1,0 +1,151 @@
+"""Width-parameterized limb representations for the BLS12-381 base field.
+
+Historically the limb width (15 bits x 26 limbs) was hard-coded across
+fp.py / pallas_fp.py; the MXU remap (pallas_mxu.py) needs a second split
+— 13-bit limbs, the widest int32-safe width per RANGE_REPORT.json's
+``mxu`` budget table — so the width becomes a first-class parameter
+here.
+
+The load-bearing identity: **26 x 15 = 390 = 30 x 13**, so both splits
+share the Montgomery radix R = 2^390.  The Montgomery domain, R1 (one in
+Montgomery form), R2, and P' = -P^-1 mod R are literally the *same
+integers* under both widths; switching splits is pure limb regrouping —
+no domain conversion, no extra Montgomery multiplies at the boundary.
+
+The 13-bit *plane* carries 31 limbs, one more than the 30 that span R:
+quasi-normalized 15-bit inputs (limbs <= fp.QMAX = 32896) encode values
+up to ~(1 + 2^-15) * 2^390, i.e. just over 390 bits, and the top
+conversion chunk of limb 25 (bit position 375, offset 11 inside 13-bit
+column 28) spills into column 30.  31 x 13 = 403 bits covers it; the
+column budget 31 * QMAX13^2 = 2,081,390,716 < 2^31 still fits the int32
+MXU accumulator with ~3.1% margin (machine-checked by
+analysis/range_lint's mxu report).
+
+Everything here is host-side numpy/int — the device kernels
+(pallas_fp.py, pallas_mxu.py) bake these constants in as numpy arrays.
+Exactness of the derivations is asserted at import time from first
+principles (no dependence on fp.py; tests cross-check the two).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from .. import params
+
+P_INT = params.P
+
+R_BITS = 390  # = 26*15 = 30*13: the shared Montgomery radix exponent
+R_INT = 1 << R_BITS
+R1_INT = R_INT % P_INT  # 1 in Montgomery form
+R2_INT = R_INT * R_INT % P_INT
+PPRIME_INT = (-pow(P_INT, -1, R_INT)) % R_INT  # -P^-1 mod R
+
+# Derivation checks, exact integer arithmetic (the "re-derived Montgomery
+# constants" contract: these must hold for ANY split sharing R = 2^390).
+assert R_INT > 512 * P_INT  # bound-tracking headroom (fp.MAX_BOUND)
+assert (PPRIME_INT * P_INT) % R_INT == R_INT - 1  # P*P' == -1 mod R
+assert (R1_INT - R_INT) % P_INT == 0 and 0 <= R1_INT < P_INT
+assert (R2_INT - R_INT * R_INT) % P_INT == 0 and 0 <= R2_INT < P_INT
+
+
+@dataclasses.dataclass(frozen=True)
+class LimbSpec:
+    """A little-endian base-2^bits limb plane for field values.
+
+    ``n`` is the plane height (limb count); it may exceed the
+    ``radix_limbs`` that span R when quasi-normalized values can
+    overshoot 2^390 (the 13-bit plane).  ``qmax`` is the quasi-
+    normalized per-limb bound the kernels are proven against.
+    """
+
+    bits: int
+    n: int
+    qmax: int
+
+    def __post_init__(self):
+        assert R_BITS % self.bits == 0, "split must divide the radix"
+        assert self.n >= self.radix_limbs
+        assert self.qmax > self.mask, "qmax must admit strict limbs"
+
+    @property
+    def mask(self) -> int:
+        return (1 << self.bits) - 1
+
+    @property
+    def radix_limbs(self) -> int:
+        """Limbs spanning exactly R = 2^390 (carry-chain truncation point)."""
+        return R_BITS // self.bits
+
+    @property
+    def span_bits(self) -> int:
+        return self.bits * self.n
+
+    # -- codecs ------------------------------------------------------------
+
+    def int_to_limbs(self, x: int) -> np.ndarray:
+        """Non-negative int < 2^span_bits -> (n,) uint32 strict limbs."""
+        assert 0 <= x < (1 << self.span_bits)
+        return np.array(
+            [(x >> (self.bits * i)) & self.mask for i in range(self.n)],
+            dtype=np.uint32,
+        )
+
+    def limbs_to_int(self, limbs) -> int:
+        arr = np.asarray(limbs, dtype=np.uint64)
+        assert arr.shape[0] == self.n
+        return sum(int(v) << (self.bits * i) for i, v in enumerate(arr))
+
+    def limbs_to_ints(self, limbs) -> list:
+        arr = np.asarray(limbs)
+        flat = arr.reshape(self.n, -1)
+        return [self.limbs_to_int(flat[:, j]) for j in range(flat.shape[1])]
+
+    # -- per-width Montgomery constants ------------------------------------
+
+    @functools.cached_property
+    def p_limbs(self) -> np.ndarray:
+        return self.int_to_limbs(P_INT)
+
+    @functools.cached_property
+    def pprime_limbs(self) -> np.ndarray:
+        return self.int_to_limbs(PPRIME_INT)
+
+    @functools.cached_property
+    def r1_limbs(self) -> np.ndarray:
+        return self.int_to_limbs(R1_INT)
+
+
+# The production 15-bit split (fp.py's native plane).
+SPEC15 = LimbSpec(bits=15, n=26, qmax=(1 << 15) + (1 << 7))
+
+# The MXU 13-bit split: widest int32-safe width (RANGE_REPORT mxu table),
+# 31-limb plane (see module docstring), qmax chosen one over the proven
+# device bounds (_to13 emits <= 8193; compressed dot columns <= 8192).
+QMAX13 = (1 << 13) + 2
+SPEC13 = LimbSpec(bits=13, n=31, qmax=QMAX13)
+
+# The int32 accumulator budget that makes 13 bits the widest safe split:
+# every schoolbook column is a sum of <= 31 products of quasi limbs.
+assert SPEC13.n * QMAX13 * QMAX13 < 1 << 31
+# ...and 14 bits is not, even at strict limbs (ceil(381/14) = 28 limbs):
+assert 28 * ((1 << 14) - 1) ** 2 >= 1 << 31
+
+
+def convert(limbs, src: LimbSpec, dst: LimbSpec) -> np.ndarray:
+    """Exact value-preserving re-limb (host reference codec).
+
+    Accepts quasi-normalized input (any uint32 limbs); output is strict
+    in ``dst``.  The device converters in pallas_mxu.py are differential-
+    tested against this.
+    """
+    arr = np.asarray(limbs)
+    flat = arr.reshape(src.n, -1)
+    out = np.empty((dst.n, flat.shape[1]), dtype=np.uint32)
+    for j in range(flat.shape[1]):
+        v = sum(int(x) << (src.bits * i) for i, x in enumerate(flat[:, j]))
+        out[:, j] = dst.int_to_limbs(v)
+    return out.reshape((dst.n,) + arr.shape[1:])

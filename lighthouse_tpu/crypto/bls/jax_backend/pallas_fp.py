@@ -142,6 +142,31 @@ def _mont_kernel(a_ref, b_ref, p_ref, pp_ref, o_ref):
     o_ref[:] = _mont_core(a_ref[:], b_ref[:], p_ref[:], pp_ref[:])
 
 
+def _mont_kernel_mxu(a_ref, b_ref, p_ref, pp_ref, o_ref):
+    """Same operand contract as _mont_kernel, column sums on the MXU
+    (13-bit re-limbed dot-product core, pallas_mxu.py)."""
+    from . import pallas_mxu
+
+    o_ref[:] = pallas_mxu.mont_core_mxu(
+        a_ref[:], b_ref[:], p_ref[:], pp_ref[:]
+    )
+
+
+def _core_pair(mxu: bool):
+    """(mul, sqr) cores for a kernel family: the VPU schoolbook pair or
+    the MXU dot-product core (which has no triangle trick — sqr is
+    mul(a, a); the dot path's win is the matmul, not the product
+    count).  Every fused kernel family threads ``mxu`` through its
+    lru_cache factory key so both programs can coexist in one
+    process."""
+    if mxu:
+        from . import pallas_mxu
+
+        mont = pallas_mxu.mont_core_mxu
+        return mont, lambda a, pl_, pp: mont(a, a, pl_, pp)
+    return _mont_core, _mont_sqr_core
+
+
 def _select_power(d, powers):
     """Value-level one-hot select of powers[d] for a traced digit d —
     Mosaic has no dynamic gather over a trace-time list, so this is
@@ -153,7 +178,7 @@ def _select_power(d, powers):
     return sel
 
 
-def _make_megachain_kernel(w: int, n_digits: int):
+def _make_megachain_kernel(w: int, n_digits: int, mxu: bool = False):
     """The WHOLE exponent chain as ONE Pallas program: the MSB-first
     base-2^w digit tape rides in as a scalar-prefetch operand (SMEM),
     the 2^w-entry power table is built in-kernel (2^w - 2 Montgomery
@@ -170,19 +195,21 @@ def _make_megachain_kernel(w: int, n_digits: int):
     Digit 0 multiplies by the Montgomery one (value-preserving), so the
     loop body is uniform and needs no predication."""
 
+    mont, sqr = _core_pair(mxu)
+
     def megachain_kernel(tape_ref, base_ref, p_ref, pp_ref, one_ref,
                          o_ref):
         base = base_ref[:]
         pl_, pp = p_ref[:], pp_ref[:]
         powers = [one_ref[:], base]
         for _ in range(2, 1 << w):
-            powers.append(_mont_core(powers[-1], base, pl_, pp))
+            powers.append(mont(powers[-1], base, pl_, pp))
 
         def step(i, acc):
             for _ in range(w):
-                acc = _mont_sqr_core(acc, pl_, pp)  # triangle sqr (~-16%)
+                acc = sqr(acc, pl_, pp)  # triangle sqr (~-16%) on VPU
             sel = _select_power(tape_ref[i], powers)
-            return _mont_core(acc, sel, pl_, pp)
+            return mont(acc, sel, pl_, pp)
 
         acc = _select_power(tape_ref[0], powers)
         o_ref[:] = jax.lax.fori_loop(1, n_digits, step, acc)
@@ -191,7 +218,8 @@ def _make_megachain_kernel(w: int, n_digits: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _mont_call(n_padded: int, tile: int, interpret: bool):
+def _mont_call(n_padded: int, tile: int, interpret: bool,
+               mxu: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -201,7 +229,7 @@ def _mont_call(n_padded: int, tile: int, interpret: bool):
     const_spec = pl.BlockSpec((26, tile), lambda i: (0, 0),
                               memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        _mont_kernel,
+        _mont_kernel_mxu if mxu else _mont_kernel,
         out_shape=jax.ShapeDtypeStruct((26, n_padded), jnp.uint32),
         grid=grid,
         in_specs=[spec, spec, const_spec, const_spec],
@@ -224,30 +252,30 @@ def _sub_biased(a, b, bias):
     return _compress1((a + bias) - b)
 
 
-def _fp2_sqr_core(a0, a1, pl_, pp, b16):
+def _fp2_sqr_core(a0, a1, pl_, pp, b16, mont=_mont_core):
     """(a0 + a1·u)²: real (a0+a1)(a0-a1), imag 2·a0·a1 (u² = -1).
     Worst-case input is post-mul (a0 <= ~3.2P, a1 <= ~5.2P): the k=16
     bias covers the subtrahend; outputs re-normalize to (<=1.4P, <=2.4P)."""
     s = _compress1(a0 + a1)
     d = _sub_biased(a0, a1, b16)
-    r0 = _mont_core(s, d, pl_, pp)
-    t = _mont_core(a0, a1, pl_, pp)
+    r0 = mont(s, d, pl_, pp)
+    t = mont(a0, a1, pl_, pp)
     return r0, _compress1(t + t)
 
 
-def _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2):
+def _fp2_mul_core(a0, a1, b0, b1, pl_, pp, b2, mont=_mont_core):
     """Karatsuba: v0 - v1 + (cross - v0 - v1)·u.  The v's are Montgomery
     outputs (< 1.2P), so k=2 biases suffice; outputs stay <= (3.2P, 5.2P)
     — inside the square's envelope above."""
-    v0 = _mont_core(a0, b0, pl_, pp)
-    v1 = _mont_core(a1, b1, pl_, pp)
-    m = _mont_core(_compress1(a0 + a1), _compress1(b0 + b1), pl_, pp)
+    v0 = mont(a0, b0, pl_, pp)
+    v1 = mont(a1, b1, pl_, pp)
+    m = mont(_compress1(a0 + a1), _compress1(b0 + b1), pl_, pp)
     r0 = _sub_biased(v0, v1, b2)
     r1 = _sub_biased(_sub_biased(m, v0, b2), v1, b2)
     return r0, r1
 
 
-def _make_fp2_megachain_kernel(w: int, n_digits: int):
+def _make_fp2_megachain_kernel(w: int, n_digits: int, mxu: bool = False):
     """Fp2 whole-chain program, same digit-tape design as
     _make_megachain_kernel (the power table is built in-kernel with
     2^w - 2 Karatsuba multiplies; powers[0] is the Montgomery one so a
@@ -260,6 +288,8 @@ def _make_fp2_megachain_kernel(w: int, n_digits: int):
     across fori_loop iterations exactly as it did across the old
     stacked per-digit calls."""
 
+    mont, _ = _core_pair(mxu)
+
     def fp2_megachain_kernel(tape_ref, a0_ref, a1_ref, p_ref, pp_ref,
                              b16_ref, b2_ref, one_ref, o0_ref, o1_ref):
         a0, a1 = a0_ref[:], a1_ref[:]
@@ -268,17 +298,20 @@ def _make_fp2_megachain_kernel(w: int, n_digits: int):
         powers = [(one_ref[:], jnp.zeros_like(a0)), (a0, a1)]
         for _ in range(2, 1 << w):
             p0, p1 = powers[-1]
-            powers.append(_fp2_mul_core(p0, p1, a0, a1, pl_, pp, b2))
+            powers.append(
+                _fp2_mul_core(p0, p1, a0, a1, pl_, pp, b2, mont=mont)
+            )
         pow0 = [p[0] for p in powers]
         pow1 = [p[1] for p in powers]
 
         def step(i, carry):
             c0, c1 = carry
             for _ in range(w):
-                c0, c1 = _fp2_sqr_core(c0, c1, pl_, pp, b16)
+                c0, c1 = _fp2_sqr_core(c0, c1, pl_, pp, b16, mont=mont)
             d = tape_ref[i]
             return _fp2_mul_core(c0, c1, _select_power(d, pow0),
-                                 _select_power(d, pow1), pl_, pp, b2)
+                                 _select_power(d, pow1), pl_, pp, b2,
+                                 mont=mont)
 
         d0 = tape_ref[0]
         acc = (_select_power(d0, pow0), _select_power(d0, pow1))
@@ -289,7 +322,7 @@ def _make_fp2_megachain_kernel(w: int, n_digits: int):
 
 @functools.lru_cache(maxsize=32)
 def _fp2_megachain_call(n_padded: int, tile: int, w: int, n_digits: int,
-                        interpret: bool):
+                        interpret: bool, mxu: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -306,7 +339,7 @@ def _fp2_megachain_call(n_padded: int, tile: int, w: int, n_digits: int,
         out_specs=(spec, spec),
     )
     return pl.pallas_call(
-        _make_fp2_megachain_kernel(w, n_digits),
+        _make_fp2_megachain_kernel(w, n_digits, mxu),
         out_shape=(out_shape, out_shape),
         grid_spec=grid_spec,
         interpret=interpret,
@@ -314,7 +347,8 @@ def _fp2_megachain_call(n_padded: int, tile: int, w: int, n_digits: int,
 
 
 def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
-                  w: int = CHAIN_WINDOW, interpret: bool | None = None):
+                  w: int = CHAIN_WINDOW, interpret: bool | None = None,
+                  mxu: bool | None = None):
     """(a0 + a1·u)^e for static MSB-first bits (leading bit must be 1);
     inputs reduced (bound <= 2).  ONE pallas dispatch: the digit tape is
     a scalar-prefetch operand, power table and window walk live in the
@@ -324,6 +358,8 @@ def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
     assert bits and bits[0] == 1
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if mxu is None:
+        mxu = F.mxu_enabled()
     n = a0_limbs.shape[-1]
     tile = pick_tile(n)
     n_padded = -(-n // tile) * tile
@@ -341,7 +377,8 @@ def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
     digits = _window_digits(
         "".join("1" if b else "0" for b in bits), w)
     tape = jnp.asarray(digits, dtype=jnp.int32)
-    call = _fp2_megachain_call(n_padded, tile, w, len(digits), interpret)
+    call = _fp2_megachain_call(n_padded, tile, w, len(digits), interpret,
+                               mxu)
     acc0, acc1 = call(tape, a0_limbs, a1_limbs, *consts, one0)
     if n_padded != n:
         return acc0[:, :n], acc1[:, :n]
@@ -350,7 +387,7 @@ def fp2_pow_chain(a0_limbs, a1_limbs, bits: tuple[int, ...],
 
 @functools.lru_cache(maxsize=64)
 def _megachain_call(n_padded: int, tile: int, w: int, n_digits: int,
-                    interpret: bool):
+                    interpret: bool, mxu: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -365,7 +402,7 @@ def _megachain_call(n_padded: int, tile: int, w: int, n_digits: int,
         out_specs=spec,
     )
     return pl.pallas_call(
-        _make_megachain_kernel(w, n_digits),
+        _make_megachain_kernel(w, n_digits, mxu),
         out_shape=jax.ShapeDtypeStruct((26, n_padded), jnp.uint32),
         grid_spec=grid_spec,
         interpret=interpret,
@@ -381,7 +418,8 @@ def _window_digits(bitstr: str, w: int) -> list[int]:
 
 
 def pow_chain_limbs(base_limbs, exponent: int,
-                    interpret: bool | None = None, w: int = CHAIN_WINDOW):
+                    interpret: bool | None = None, w: int = CHAIN_WINDOW,
+                    mxu: bool | None = None):
     """base^exponent (Montgomery domain) as ONE pallas dispatch: the
     MSB-first base-2^w digit tape is a scalar-prefetch operand, the
     power table is built in-kernel, and a fori_loop runs w squares + one
@@ -398,6 +436,8 @@ def pow_chain_limbs(base_limbs, exponent: int,
     still execute on CPU."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if mxu is None:
+        mxu = F.mxu_enabled()
     digits = _window_digits(bin(exponent)[2:], w)
     tape = jnp.asarray(digits, dtype=jnp.int32)
 
@@ -417,14 +457,19 @@ def pow_chain_limbs(base_limbs, exponent: int,
             np.asarray(F.int_to_limbs(F.R1_INT)).reshape(26, 1),
             dtype=jnp.uint32),
         (26, tile))
-    call = _megachain_call(n_padded, tile, w, len(digits), interpret)
+    call = _megachain_call(n_padded, tile, w, len(digits), interpret, mxu)
     acc = call(tape, base_limbs, p_tile, pp_tile, one)
     return acc[:, :n] if n_padded != n else acc
 
 
-def mont_mul_limbs(a_limbs, b_limbs, interpret: bool = False):
+def mont_mul_limbs(a_limbs, b_limbs, interpret: bool = False,
+                   mxu: bool | None = None):
     """(26, N) x (26, N) quasi limbs -> (26, N) strict Montgomery product.
-    Pads N up to a lane multiple; slices back."""
+    Pads N up to a lane multiple; slices back.  mxu=None resolves from
+    the LIGHTHOUSE_TPU_MXU gate (fp.mxu_enabled); True routes the column
+    accumulation through the 13-bit dot-product core (pallas_mxu.py)."""
+    if mxu is None:
+        mxu = F.mxu_enabled()
     n = a_limbs.shape[-1]
     tile = pick_tile(n)
     n_padded = -(-n // tile) * tile
@@ -438,7 +483,7 @@ def mont_mul_limbs(a_limbs, b_limbs, interpret: bool = False):
     pp_tile = jnp.broadcast_to(
         jnp.asarray(_PP_COLS, dtype=jnp.uint32), (26, tile)
     )
-    out = _mont_call(n_padded, tile, interpret)(
+    out = _mont_call(n_padded, tile, interpret, mxu)(
         a_limbs, b_limbs, p_tile, pp_tile
     )
     return out[:, :n] if n_padded != n else out

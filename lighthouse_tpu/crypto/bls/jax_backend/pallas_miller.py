@@ -69,15 +69,18 @@ N_CONSTS = 3 + len(_CTX_KS)  # p, pp, one, biases
 class _Ctx:
     """In-kernel constants (pallas forbids closure constants: they ride
     as trailing const-spec inputs, one (26, tile) block reused by every
-    grid step)."""
+    grid step) plus the Montgomery core pair — VPU schoolbook by
+    default, the MXU dot-product core (pallas_mxu) when the step kernel
+    was built with mxu=True."""
 
-    def __init__(self, const_refs):
+    def __init__(self, const_refs, mxu: bool = False):
         self.p = const_refs[0][:]
         self.pp = const_refs[1][:]
         self.one = const_refs[2][:]
         self.bias = {
             k: const_refs[3 + i][:] for i, k in enumerate(_CTX_KS)
         }
+        self.mont, self.msqr = PF._core_pair(mxu)
 
 
 def _const_arrays(tile: int):
@@ -134,7 +137,7 @@ def kmul(ctx, a: KFp, b: KFp) -> KFp:
         f"in-kernel mont product bound {prod} > {F.MAX_MUL_PRODUCT}"
     )
     return KFp(
-        PF._mont_core(a.cols, b.cols, ctx.p, ctx.pp),
+        ctx.mont(a.cols, b.cols, ctx.p, ctx.pp),
         prod / F.MONT_DIVISOR + F.MONT_EPS,
     )
 
@@ -143,7 +146,7 @@ def ksqr(ctx, a: KFp) -> KFp:
     prod = a.bound * a.bound
     assert prod <= F.MAX_MUL_PRODUCT
     return KFp(
-        PF._mont_sqr_core(a.cols, ctx.p, ctx.pp),
+        ctx.msqr(a.cols, ctx.p, ctx.pp),
         prod / F.MONT_DIVISOR + F.MONT_EPS,
     )
 
@@ -417,11 +420,11 @@ def _f12_lanes(f):
     ]
 
 
-def _step_dbl_kernel(*refs):
+def _step_dbl_kernel(*refs, mxu: bool = False):
     # refs: f(12) T(6) xp yp consts(N_CONSTS) | out: f'(12) T'(6)
     n_in = _F12 + _TPT + 2 + N_CONSTS
     ins, outs = refs[:n_in], refs[n_in:]
-    ctx = _Ctx(ins[_F12 + _TPT + 2 :])
+    ctx = _Ctx(ins[_F12 + _TPT + 2 :], mxu=mxu)
     f = _read_f12(ins, 0)
     Tpt = tuple(
         (KFp(ins[_F12 + 2 * i][:], 2.0), KFp(ins[_F12 + 2 * i + 1][:], 2.0))
@@ -443,11 +446,11 @@ def _step_dbl_kernel(*refs):
         ref[:] = v.cols
 
 
-def _step_add_kernel(*refs):
+def _step_add_kernel(*refs, mxu: bool = False):
     # refs: f(12) T(6) q(4) xp yp bit consts(N_CONSTS) | out: f'(12) T'(6)
     n_in = _F12 + _TPT + 4 + 2 + 1 + N_CONSTS
     ins, outs = refs[:n_in], refs[n_in:]
-    ctx = _Ctx(ins[_F12 + _TPT + 4 + 2 + 1 :])
+    ctx = _Ctx(ins[_F12 + _TPT + 4 + 2 + 1 :], mxu=mxu)
     f = _read_f12(ins, 0)
     Tpt = tuple(
         (KFp(ins[_F12 + 2 * i][:], 2.0), KFp(ins[_F12 + 2 * i + 1][:], 2.0))
@@ -478,7 +481,8 @@ def _step_add_kernel(*refs):
 
 
 @functools.lru_cache(maxsize=8)
-def _dbl_call(n_padded: int, tile: int, interpret: bool):
+def _dbl_call(n_padded: int, tile: int, interpret: bool,
+              mxu: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -492,7 +496,7 @@ def _dbl_call(n_padded: int, tile: int, interpret: bool):
         for _ in range(_F12 + _TPT)
     )
     return pl.pallas_call(
-        _step_dbl_kernel,
+        functools.partial(_step_dbl_kernel, mxu=mxu),
         out_shape=out_shape,
         grid=grid,
         in_specs=[spec] * n_in + [const_spec] * N_CONSTS,
@@ -502,7 +506,8 @@ def _dbl_call(n_padded: int, tile: int, interpret: bool):
 
 
 @functools.lru_cache(maxsize=8)
-def _add_call(n_padded: int, tile: int, interpret: bool):
+def _add_call(n_padded: int, tile: int, interpret: bool,
+              mxu: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -518,7 +523,7 @@ def _add_call(n_padded: int, tile: int, interpret: bool):
         for _ in range(_F12 + _TPT)
     )
     return pl.pallas_call(
-        _step_add_kernel,
+        functools.partial(_step_add_kernel, mxu=mxu),
         out_shape=out_shape,
         grid=grid,
         in_specs=[spec] * n_in + [bit_spec] + [const_spec] * N_CONSTS,
@@ -581,8 +586,9 @@ def miller_loop_fused(p_aff, q_aff):
     q_arr = jnp.stack(all_in[_F12 + _TPT : _F12 + _TPT + 4])
     xp_a, yp_a = all_in[-2], all_in[-1]
 
-    dbl = _dbl_call(n_padded, tile, interpret)
-    add = _add_call(n_padded, tile, interpret)
+    mxu = F.mxu_enabled()
+    dbl = _dbl_call(n_padded, tile, interpret, mxu)
+    add = _add_call(n_padded, tile, interpret, mxu)
     bits = jnp.array(_PR._X_BITS[1:], dtype=jnp.uint32)
     consts = _const_arrays(tile)
 

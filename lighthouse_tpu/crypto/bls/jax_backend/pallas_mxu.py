@@ -1,0 +1,198 @@
+"""MXU-mapped Montgomery multiply: 13-bit re-limbed dot-product kernel.
+
+Every kernel before this one ran limb arithmetic on the VPU while the
+MXU — the chip's dominant FLOPs engine — sat idle (ROADMAP item 1).
+This module remaps the schoolbook column accumulation onto the MXU as a
+small matmul:
+
+    column_t = S @ outer(a, b).reshape(n*n, T)
+
+where ``S`` is a static 0/1 *banded reduction matrix* (row k has ones at
+every flattened (i, j) with i + j == k) shared by all lanes, and the
+batch T rides the matmul's N dimension.  That sidesteps the objection
+that killed the earlier int8 sketch (both matmul operands varying per
+lane): the per-lane data enters as the (n*n, T) right-hand side, the
+weights are the lane-invariant band structure.
+
+Why 13 bits: RANGE_REPORT.json proves the native 26x15 representation
+peaks at ~2^34.7 per column — over the int32 2^31 MXU accumulator — so
+operands are re-limbed to the 13-bit split (limbs.SPEC13).  26*15 =
+390 = 30*13, so both splits share R = 2^390: the Montgomery constants
+are the same integers and the 15<->13 conversion is pure limb
+regrouping (exact, in-kernel, a handful of shifts/masks per limb).
+Column ceiling: 31 * 8193 * 8193 < 2^30.96 < 2^31, machine-checked by
+analysis/range_lint's dot_general transfer handler.
+
+Contract (mirrors pallas_fp.mont_mul_limbs): (26, T) quasi-normalized
+15-bit uint32 limbs in, bound-product <= 2000 in units of P, STRICT
+15-bit limbs out, value = a*b*R^-1 + kP within the same
+MONT_DIVISOR/MONT_EPS envelope fp.mont_mul labels.  Note the *bytes*
+may differ from the VPU kernel on a ~2^-13 sliver of inputs: both
+truncate m = t*P' mod R to a quasi-normalized representative, and the
+two planes can disagree by exactly R there (output shifted by one P,
+still in-envelope).  The differential corpus in tests/test_pallas_fp.py
+pins byte-identity on random + all-QMAX inputs.
+
+Enable with LIGHTHOUSE_TPU_MXU=1 (fp.mont_mul, the megachains, and the
+fused Miller loop all route through fp.mxu_enabled()).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import limbs as L
+from . import pallas_fp as PF
+
+BITS13 = 13
+NL13 = L.SPEC13.n  # 31: 30 limbs span R=2^390 + 1 spill limb (quasi-15 in)
+NCOLS = 2 * NL13 - 1  # 61 schoolbook columns
+M13 = np.uint32((1 << BITS13) - 1)
+MASK15 = np.uint32((1 << 15) - 1)
+
+# Montgomery constants on the 13-bit plane — the SAME integers as the
+# 15-bit plane's (shared R = 2^390), re-limbed.  P and P' span 30 limbs
+# (< 2^390); the 31st row is the plane's spill limb, identically zero.
+# Host-side reference only: the kernels receive the 15-bit constants as
+# operands (pallas_call forbids captured array constants) and re-limb
+# them in-kernel with _to13 — exact in value, quasi-13 limbs.
+_P13 = L.SPEC13.p_limbs.reshape(NL13, 1)
+_PP13 = L.SPEC13.pprime_limbs.reshape(NL13, 1)
+
+
+def _band_matrix():
+    """(61, 961) 0/1 reduction matrix: S[k, 31*i + j] = [i + j == k].
+
+    S @ outer(a, b).reshape(961, T) computes every schoolbook column sum
+    in one matmul; S is lane-invariant, so it sits in the MXU weights
+    while T rides the N dimension.  Built from iota inside the traced
+    kernel (pallas_call forbids captured array constants; the compare
+    folds to a constant band at compile time)."""
+    k = jax.lax.broadcasted_iota(jnp.int32, (NCOLS, NL13 * NL13), 0)
+    flat = jax.lax.broadcasted_iota(jnp.int32, (NCOLS, NL13 * NL13), 1)
+    return ((flat // NL13 + flat % NL13) == k).astype(jnp.int32)
+
+
+def _compress13(cols):
+    """One 13-bit carry pass (the pad+slice idiom of PF._compress1 —
+    Mosaic has no scatter-add).  The top row's carry-out is statically
+    zero for every use here: raw column 60 is a[30]*b[30] <= ~2^4 and
+    stays < 2^13 through all passes (range_lint-verified)."""
+    lo = cols & M13
+    hi = cols >> BITS13
+    return lo + jnp.pad(hi[:-1], ((1, 0), (0, 0)))
+
+
+def _to13(a15):
+    """(26, T) quasi-15 limbs -> (31, T) quasi-13 limbs (<= 8193), exact
+    in value.  Quasi limbs are NOT bit fields, so this is not a regroup:
+    each 15-bit limb lands at bit position 15*i = 13*q + r and is split
+    into three 13-bit chunks accumulated at columns q, q+1, q+2 (the
+    third only when r >= 11 can make it nonzero).  Column sums stay
+    <= 2 full chunks + 1 spill < 2^14; one carry pass quasi-normalizes."""
+    cols = [[] for _ in range(NL13)]
+    for i in range(26):
+        q, r = divmod(15 * i, BITS13)
+        v = a15[i] << r  # <= QMAX << 12 < 2^27.1
+        cols[q].append(v & M13)
+        cols[q + 1].append((v >> BITS13) & M13)
+        if (int(L.SPEC15.qmax) << r) >> 26:
+            cols[q + 2].append(v >> 26)
+    stacked = jnp.stack(
+        [functools.reduce(lambda x, y: x + y, c) for c in cols], axis=0
+    )
+    return _compress13(stacked)
+
+
+def _to15(a13):
+    """(31, T) STRICT 13-bit limbs of a value < 2^390 -> (26, T) strict
+    15-bit limbs, exact.  Strict limbs ARE bit fields, so this is a pure
+    regroup: out[q] collects bits [15q, 15q+15) from the two (or, when
+    15q falls 12 bits into a 13-bit limb, three) straddling source
+    limbs — disjoint bit ranges, so plain adds then one 15-bit mask."""
+    rows = []
+    for q in range(26):
+        pos = 15 * q
+        j, r = divmod(pos, BITS13)
+        acc = a13[j] >> r
+        acc = acc + (a13[j + 1] << (BITS13 - r))
+        if 2 * BITS13 - r < 15:  # r == 12: a third limb straddles the window
+            acc = acc + (a13[j + 2] << (2 * BITS13 - r))
+        rows.append(acc & MASK15)
+    return jnp.stack(rows, axis=0)
+
+
+def _dot_cols(a13, b13):
+    """All 61 schoolbook columns of a 31x31 limb product as ONE matmul.
+
+    The (31, 31, T) outer product (uint32, products <= 8193^2 < 2^27)
+    flattens to the (961, T) right-hand side; the static band matrix
+    contracts it on the MXU with int32 accumulation
+    (preferred_element_type) — column sums <= 31 * 8193^2 < 2^31, the
+    budget the whole re-limbing exists to meet.  Three 13-bit carry
+    passes bring the columns back to quasi-13 (<= 8192)."""
+    T = a13.shape[1]
+    outer = (a13[:, None, :] * b13[None, :, :]).reshape(NL13 * NL13, T)
+    s_band = _band_matrix()
+    t = jax.lax.dot_general(
+        s_band,
+        outer.astype(jnp.int32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    cols = t.astype(jnp.uint32)
+    return _compress13(_compress13(_compress13(cols)))
+
+
+def _pad_row(x):
+    """Append the zero spill row taking a (30, T) slice back to the
+    31-row plane (full-width pad, not scatter)."""
+    return jnp.pad(x, ((0, NL13 - x.shape[0]), (0, 0)))
+
+
+def mont_core_mxu(a15, b15, pl15, pp15):
+    """One full Montgomery product on in-kernel (26, T) quasi-15 values
+    -> strict 15-bit limbs.  Same operand signature as PF._mont_core
+    (the 15-bit P / P' constant tiles ride in as refs and are re-limbed
+    in-kernel — exact in value, so the Montgomery algebra is untouched),
+    same algebra, on the 13-bit plane with MXU column sums:
+
+      t = a*b                 (61 quasi-13 columns)
+      m = (t * P') mod R      (columns 0..29 of the dot — truncation at
+                               the 30-limb radix boundary drops exact
+                               multiples of 2^390)
+      u = m * P
+      s = t + u; out = s / R  (61-step carry chain; low 30 columns
+                               vanish, columns 30..60 are the result)
+    """
+    a13 = _to13(a15)
+    b13 = _to13(b15)
+    pp13 = _to13(pp15)
+    p13 = _to13(pl15)
+    t = _dot_cols(a13, b13)  # (61, T) <= 8192
+    m = _dot_cols(_pad_row(t[:30]), pp13)[:30]
+    u = _dot_cols(_pad_row(m), p13)
+    s = t + u  # <= 2 * 8192 = 2^14 per column
+    carry = jnp.zeros((s.shape[1],), dtype=jnp.uint32)
+    out_rows = []
+    for k in range(NCOLS):
+        tcol = s[k] + carry
+        carry = tcol >> BITS13
+        if k >= 30:
+            out_rows.append(tcol & M13)
+    out13 = jnp.stack(out_rows, axis=0)  # (31, T) strict, value < 2^390
+    return _to15(out13)
+
+
+def mont_mul_limbs(a_limbs, b_limbs, interpret: bool = False):
+    """(26, N) x (26, N) quasi limbs -> (26, N) strict Montgomery
+    product via the MXU dot kernel — the explicit-route entry for tests
+    and bench A/Bs.  Delegates to pallas_fp.mont_mul_limbs(mxu=True):
+    there is ONE kernel-call family keyed on (shape, interpret, mxu),
+    so padding/tiling stay identical to the VPU path by construction."""
+    return PF.mont_mul_limbs(a_limbs, b_limbs, interpret=interpret,
+                             mxu=True)

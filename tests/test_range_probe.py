@@ -110,6 +110,72 @@ def test_megachain_probe():
     _assert_runtime_below_static(chain, 1, caps)
 
 
+# -- MXU 13-bit dot-product core (pallas_mxu.py) ---------------------------
+
+
+def _static_caps13(fn, n_args):
+    """Interval-analyze over quasi-13 inputs (the 31-row MXU plane)."""
+    from lighthouse_tpu.crypto.bls.jax_backend import limbs as LB
+
+    nl13 = LB.SPEC13.n
+    prog = range_lint.RangeProgram(
+        f"probe13_{getattr(fn, '__name__', 'fn')}",
+        "tests/test_range_probe.py",
+        lambda: (fn, tuple(np.zeros((nl13, T), np.uint32)
+                           for _ in range(n_args)),
+                 [range_lint.caps13_iv((nl13, T))] * n_args),
+    )
+    violations, rep = range_lint.analyze_program(prog)
+    assert not violations, [str(v) for v in violations]
+    return rep
+
+
+def test_mxu_to13_probe():
+    """Re-limbing converter: static caps hold the proven 8193 bound and
+    dominate runtime maxima on random + all-QMAX quasi-15 inputs."""
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_mxu as PMX
+
+    caps = _static_caps(PMX._to13, 1)
+    assert max(caps) <= 8193  # the quasi-13 entry contract
+    _assert_runtime_below_static(PMX._to13, 1, caps)
+
+
+def test_mxu_dot_cols_probe():
+    """The banded-matmul column accumulator: the static dot-product
+    interval stays under the int32 2^31 MXU budget (the bound the whole
+    13-bit re-limbing exists to meet) and dominates runtime, including
+    the adversarial all-quasi-13-max plane."""
+    from lighthouse_tpu.crypto.bls.jax_backend import limbs as LB
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_mxu as PMX
+
+    rep = _static_caps13(PMX._dot_cols, 2)
+    assert 0 < rep["max_dot_log2"] < 31  # int32 accumulator budget
+    assert max(rep["out_caps"]) <= 8192  # compressed quasi-13 exit
+    nl13, q13 = LB.SPEC13.n, int(LB.SPEC13.qmax)
+    rng = np.random.default_rng(SEED)
+    for args in (
+        tuple(rng.integers(0, q13 + 1, size=(nl13, T), dtype=np.uint32)
+              for _ in range(2)),
+        tuple(np.full((nl13, T), q13, dtype=np.uint32) for _ in range(2)),
+    ):
+        out = np.asarray(PMX._dot_cols(*(np.asarray(a) for a in args)))
+        got = int(out.max())
+        assert got <= max(rep["out_caps"])
+
+
+def test_mxu_mont_mul_kernel_probe():
+    """The full MXU Montgomery kernel through pallas_call: strict 15-bit
+    exit contract statically, runtime dominated on random + all-QMAX."""
+    from lighthouse_tpu.crypto.bls.jax_backend import pallas_mxu as PMX
+
+    def mul(a, b):
+        return PMX.mont_mul_limbs(a, b, interpret=True)
+
+    caps = _static_caps(mul, 2)
+    assert max(caps) < (1 << 15)
+    _assert_runtime_below_static(mul, 2, caps)
+
+
 def test_fp_sub_top_limb_domination_regression():
     """A bound-2.0 subtrahend can carry top limb 104, one above the
     bias-2 table's borrowed top limb 103: the old ``k >= bound`` rule

@@ -14,6 +14,7 @@ live-tree proofs: strict/quasi output contracts and the exact LFp
 bound algebra hold on the real kernels.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -287,6 +288,10 @@ FAST_RANGE_PROGRAMS = (
     "pallas_mont_mul", "pallas_mont_sqr", "xla_mont_mul", "xla_fp_add",
     "xla_fp_sub_k2", "xla_fp_sub_k256", "pallas_ksub_k2",
     "pallas_ksub_k256",
+    # the MXU 13-bit dot-product core: op-level converters + the full
+    # kernel pair (seconds, not the minutes-scale megachain trace)
+    "mxu_mont_mul", "mxu_mont_sqr", "mxu_to13", "mxu_to15",
+    "mxu_dot_cols",
 )
 
 
@@ -346,6 +351,70 @@ def test_live_mxu_report_budgets(live_range_fast):
     rows = {r["w"]: r for r in mxu["limb_split_table"]}
     assert rows[9]["f32_ok"] and not rows[10]["f32_ok"]
     assert rows[13]["i32_ok"] and not rows[14]["i32_ok"]
+
+
+def test_live_mxu_selected_split_proved(live_range_fast):
+    """The shipped 13-bit re-limbing: selected split within budget, and
+    the MXU kernel programs prove int32 safety (max dot-product interval
+    under 2^31) with the strict 15-bit exit contract."""
+    _violations, report = live_range_fast
+    sel = report["mxu"]["selected_split"]
+    assert sel["w"] == 13 and sel["limbs"] == 31  # incl. the spill row
+    assert sel["i32_ok"] is True and sel["col_log2"] < 31
+    assert "mxu_mont_mul" in sel["kernels"]
+    for name in ("mxu_mont_mul", "mxu_mont_sqr", "mxu_dot_cols"):
+        prog = report["programs"][name]
+        assert 0 < prog["max_dot_log2"] < 31, (name, prog["max_dot_log2"])
+    for name in ("mxu_mont_mul", "mxu_mont_sqr"):
+        assert report["programs"][name]["contracts_ok"]
+        assert max(report["programs"][name]["out_caps"]) < (1 << 15)
+    # the converters hold their entry contracts
+    assert max(report["programs"]["mxu_to13"]["out_caps"]) <= 8193
+    assert max(report["programs"]["mxu_to15"]["out_caps"]) < (1 << 15)
+
+
+# -- range family: proof cache (the >=5x warm-audit win) -------------------
+
+
+def test_range_proof_cache_warm_agrees_with_cold(tmp_path, monkeypatch):
+    """Cold trace and warm replay must be indistinguishable: identical
+    violations, byte-identical report (so the RANGE_REPORT drift check
+    cannot tell them apart), with the warm run all cache hits."""
+    monkeypatch.setattr(range_lint, "_CACHE_FILE",
+                        str(tmp_path / "proofcache.json"))
+    only = ("mxu_to13", "mxu_to15")
+    v_cold, r_cold = range_lint.generate(REPO, AuditConfig(), only=only)
+    assert dict(range_lint._CACHE_STATS) == {"hits": 0, "misses": 2}
+    v_warm, r_warm = range_lint.generate(REPO, AuditConfig(), only=only)
+    assert dict(range_lint._CACHE_STATS) == {"hits": 2, "misses": 0}
+    assert [v.to_dict() for v in v_cold] == [v.to_dict() for v in v_warm]
+    assert json.dumps(r_cold, sort_keys=True) == json.dumps(
+        r_warm, sort_keys=True)
+
+
+def test_range_proof_cache_opt_out_never_touches_disk(tmp_path,
+                                                      monkeypatch):
+    """range_cache=False (the --no-cache flag) neither reads nor writes
+    the cache file and reports zero hits."""
+    monkeypatch.setattr(range_lint, "_CACHE_FILE",
+                        str(tmp_path / "proofcache.json"))
+    range_lint.generate(REPO, AuditConfig(range_cache=False),
+                        only=("mxu_to13",))
+    assert not (tmp_path / "proofcache.json").exists()
+    assert range_lint._CACHE_STATS["hits"] == 0
+
+
+def test_range_proof_cache_invalidates_on_kernel_edit(tmp_path,
+                                                      monkeypatch):
+    """A fingerprint mismatch (any kernel/lint edit) must force fresh
+    traces instead of replaying stale verdicts."""
+    monkeypatch.setattr(range_lint, "_CACHE_FILE",
+                        str(tmp_path / "proofcache.json"))
+    range_lint.generate(REPO, AuditConfig(), only=("mxu_to13",))
+    monkeypatch.setattr(range_lint, "_proof_fingerprint",
+                        lambda root: "edited-tree")
+    range_lint.generate(REPO, AuditConfig(), only=("mxu_to13",))
+    assert dict(range_lint._CACHE_STATS) == {"hits": 0, "misses": 1}
 
 
 # -- range family: full registry + report drift (slow) --------------------

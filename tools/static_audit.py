@@ -25,6 +25,8 @@ Usage:
     tools/pyrun tools/static_audit.py --only range    # kernel proofs only
     tools/pyrun tools/static_audit.py --write-range-report
                                                       # refresh RANGE_REPORT.json
+    tools/pyrun tools/static_audit.py --no-cache      # fresh range traces
+                                                      # (skip .range_proof_cache.json)
     tools/pyrun tools/static_audit.py --paths tests/fixtures/lint \\
         --config tests/fixtures/lint/lint.toml        # fixture corpus
 """
@@ -96,6 +98,10 @@ def main(argv=None) -> int:
                     help="regenerate the checked-in range report "
                          "(RANGE_REPORT.json) from the live kernels and "
                          "exit")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not update the range proof cache "
+                         "(.range_proof_cache.json); forces fresh kernel "
+                         "traces")
     ap.add_argument("--quiet", action="store_true",
                     help="print only the verdict line, not the report")
     ap.add_argument("--no-history", action="store_true",
@@ -111,6 +117,8 @@ def main(argv=None) -> int:
         cfg = load_config(args.config)
     else:
         cfg = AuditConfig()
+    if args.no_cache:
+        cfg.range_cache = False
 
     if args.write_range_report:
         from lighthouse_tpu.analysis import range_lint

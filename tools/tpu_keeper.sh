@@ -1,7 +1,10 @@
 #!/bin/bash
-# Round-5 relay keeper: probe the axon TPU relay on a cadence; the moment
-# it answers, run the serialized measurement session (tools/tpu_session.py)
-# exactly once.  All TPU access stays inside this one process tree.
+# Relay keeper: probe the axon TPU relay on a cadence; the moment it
+# answers, run the current serialized measurement agenda (tools/
+# tpu_session.py --agenda r6: dispatch audit, baseline refresh, the
+# MXU-vs-VPU Montgomery core A/B via BENCH_MXU=1, headline in the
+# winning arm, entry warm) exactly once.  All TPU access stays inside
+# this one process tree.
 cd /root/repo
 PROBE=/tmp/tpu_probe.py
 cat > "$PROBE" <<'EOF'
@@ -27,7 +30,7 @@ while true; do
   echo "[keeper] probe attempt $n at $(date -u +%H:%M:%SZ)"
   if python "$PROBE"; then
     echo "[keeper] relay ALIVE — starting measurement session"
-    python tools/tpu_session.py
+    python tools/tpu_session.py --agenda r6
     echo "[keeper] session finished at $(date -u +%H:%M:%SZ); exiting"
     exit 0
   fi

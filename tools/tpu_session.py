@@ -1,25 +1,44 @@
 #!/usr/bin/env python3
-"""Serialized TPU measurement session for round 5 (VERDICT r4 items 1-2).
+"""Serialized TPU measurement sessions, driven by declarative agendas.
 
-The single v5e chip is reached via a relay that wedges when two processes
-touch it concurrently or when a mid-compile process is killed, so ALL
-hardware measurements for the round run from this ONE process, serially,
-each stage as a bench.py/epoch-bench child with its own in-process
-watchdog (a hang becomes a JSON error line + clean exit, never an
-external kill).  Results append to TPU_SESSION_r05.jsonl; successful
-verify measurements also land in BENCH_HISTORY.jsonl via bench.py.
+The single v5e chip is reached via a relay that wedges when two
+processes touch it concurrently or when a mid-compile process is
+killed, so ALL hardware measurements for a round run from this ONE
+process, serially, each stage as a bench.py / tool child with its own
+in-process watchdog (a hang becomes a JSON error line + clean exit,
+never an external kill).  Stage results append to the round ledger
+(TPU_SESSION_<round>.jsonl); successful verify measurements also land
+in BENCH_HISTORY.jsonl via bench.py.
 
-Agenda (stop early if the relay dies):
-  1. B=512  chains=0  - baseline refresher (warm cache from r3)
-  2. B=512  chains=1  - the A/B the last two verdicts asked for
-  3. B=4096 chains=best
-  4. B=8192 chains=best
-  5. epoch attestation batch (north-star #2), device path
-  6. B=512  chains=best device_h2c=1 - system-balanced config
+This file consolidates the four accreted round-5 scripts
+(tpu_session.py / 2 / 3 / 4) into one driver: an agenda is a LIST OF
+STAGE DICTS, so adding a measurement campaign is one AGENDAS entry,
+not a fifth script.  The historical r5 agendas are kept declaratively
+for provenance (what each ledger section ran); ``r6`` is the live one.
+
+Usage:
+    python tools/tpu_session.py --agenda r6      # the current campaign
+    python tools/tpu_session.py --list           # show agendas + stages
+
+Stage kinds:
+    bench           one bench.py TPU child.  Keys: batch, chains,
+                    miller, device_h2c, wsm (gate envs), mxu
+                    (LIGHTHOUSE_TPU_MXU), bench_mxu (BENCH_MXU=1 — the
+                    in-child MXU-vs-VPU mont_mul microbench + verify
+                    sweep), pipeline (BENCH_PIPELINE=1), timeout.
+                    chains/miller/mxu accept "auto": resolved from the
+                    round ledger (best measured config / A-B winner).
+                    abort_on_fail: stop the agenda when the stage fails
+                    (relay presumed dead).
+    epoch           tools/epoch_attestation_bench.py child.
+    dispatch_audit  static program-count audit (CPU trace, no Mosaic).
+    entry_warm      compile-run __graft_entry__.entry() exactly as the
+                    driver's graft check does (warms .jax_cache).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -27,13 +46,24 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# module state bound by main(); r5 default keeps ad-hoc REPL use of the
+# helpers appending to the historical ledger
+_ROUND = "r05"
+
+
+def _ledger() -> str:
+    return os.path.join(ROOT, f"TPU_SESSION_{_ROUND}.jsonl")
+
+
+# kept for provenance tooling that greps the r5 ledger path
 LOG = os.path.join(ROOT, "TPU_SESSION_r05.jsonl")
 
 
 def log(obj: dict) -> None:
     obj = dict(obj)
     obj["at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    with open(LOG, "a") as f:
+    with open(_ledger(), "a") as f:
         f.write(json.dumps(obj) + "\n")
     print(json.dumps(obj), flush=True)
 
@@ -71,8 +101,10 @@ def _run_child(
 
 
 def run_bench_child(
-    batch: int, chains: bool, device_h2c: bool = False,
-    miller: bool = False, timeout: float = 4000,
+    batch: int, chains: bool = False, device_h2c: bool = False,
+    miller: bool = True, wsm: bool = False, mxu: bool = False,
+    bench_mxu: bool = False, pipeline: bool = False,
+    timeout: float = 4000,
 ) -> dict | None:
     env = dict(os.environ)
     env["BENCH_CHILD"] = "tpu"
@@ -82,11 +114,19 @@ def run_bench_child(
     env["BENCH_COMPILE_TIMEOUT"] = str(timeout - 300)
     env["LIGHTHOUSE_TPU_CHAINS"] = "1" if chains else "0"
     env["LIGHTHOUSE_TPU_MILLER"] = "1" if miller else "0"
+    env["LIGHTHOUSE_TPU_WSM"] = "1" if wsm else "0"
+    env["LIGHTHOUSE_TPU_MXU"] = "1" if mxu else "0"
     env["BENCH_DEVICE_H2C"] = "1" if device_h2c else ""
+    if bench_mxu:
+        env["BENCH_MXU"] = "1"
+    if pipeline:
+        env["BENCH_PIPELINE"] = "1"
     return _run_child(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         f"verify B={batch} chains={int(chains)} miller={int(miller)} "
-        f"h2c={int(device_h2c)}",
+        f"wsm={int(wsm)} mxu={int(mxu)} h2c={int(device_h2c)}"
+        + (" +BENCH_MXU" if bench_mxu else "")
+        + (" +pipeline" if pipeline else ""),
         env,
         timeout,
     )
@@ -104,56 +144,243 @@ def run_epoch_bench(timeout: float = 4500) -> dict | None:
     )
 
 
-def ok(res: dict | None) -> bool:
-    return bool(res) and res.get("value", 0) > 0 and "TPU" in str(res.get("device", ""))
-
-
-def main() -> None:
-    log({"stage": "session start", "pid": os.getpid()})
-
-    base = run_bench_child(512, chains=False)
-    if not ok(base):
-        log({"stage": "abort", "why": "baseline B=512 failed; relay presumed dead"})
-        return
-    ab = run_bench_child(512, chains=True, timeout=5500)
-    chains_best = ok(ab) and ab["value"] > base["value"]
-    log(
-        {
-            "stage": "A/B verdict",
-            "chains_off": base.get("value"),
-            "chains_on": (ab or {}).get("value"),
-            "chains_win": chains_best,
-        }
-    )
-
-    # the fused Miller-step kernels: the biggest single-chip lever
-    # (dispatch-bound at B>=4096) — one generous-timeout shot; Mosaic
-    # compiles of the two ~160-mul kernels are the unknown
-    mil = run_bench_child(512, chains=chains_best, miller=True, timeout=7000)
-    miller_best = ok(mil) and mil["value"] > max(
-        base.get("value", 0), (ab or {}).get("value", 0)
-    )
-    log(
-        {
-            "stage": "miller verdict",
-            "miller_on": (mil or {}).get("value"),
-            "miller_win": miller_best,
-        }
-    )
-
-    r4096 = run_bench_child(
-        4096, chains=chains_best, miller=miller_best, timeout=7000
-    )
-    if ok(r4096):
-        run_bench_child(
-            8192, chains=chains_best, miller=miller_best, timeout=7000
+def run_dispatch_audit(timeout: float = 1800) -> None:
+    """Static program-count audit (CPU trace only, no Mosaic): the
+    BENCH_HISTORY row the dispatch-budget acceptance criterion reads."""
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "dispatch_audit.py"),
+             "--quick"],
+            cwd=ROOT, capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
+        out = (proc.stdout + proc.stderr)[-500:]
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        out, rc = f"timeout {timeout}s", -1
+    log({"stage": "dispatch audit (static)", "rc": rc,
+         "wall_sec": round(time.time() - t0, 1), "tail": out})
 
-    run_epoch_bench()
 
-    run_bench_child(512, chains=chains_best, device_h2c=True, timeout=5500)
-    log({"stage": "session done"})
+def run_entry_warm(timeout: float = 5500) -> None:
+    """Compile-run entry() exactly as the driver's graft check does."""
+    code = (
+        "import __graft_entry__ as G, jax; "
+        "G._enable_compile_cache(jax); "
+        "fn, args = G.entry(); "
+        "import time; t0=time.time(); "
+        "r = jax.jit(fn)(*args); "
+        "getattr(r, 'block_until_ready', lambda: r)(); "
+        "print('entry warm ok in %.1fs' % (time.time()-t0))"
+    )
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=ROOT, capture_output=True,
+            text=True, timeout=timeout,
+        )
+        out = (proc.stdout + proc.stderr)[-300:]
+    except subprocess.TimeoutExpired:
+        out = f"timeout {timeout}s"
+    log({"stage": "entry warm (B=4 h2c, production defaults)",
+         "wall_sec": round(time.time() - t0, 1), "tail": out})
+
+
+def ok(res: dict | None) -> bool:
+    return bool(res) and res.get("value", 0) > 0 \
+        and "TPU" in str(res.get("device", ""))
+
+
+# ---------------------------------------------------------------------------
+# Ledger readers: resolve "auto" stage parameters from measured history
+# ---------------------------------------------------------------------------
+
+
+def _ledger_rows() -> list[dict]:
+    rows = []
+    try:
+        with open(_ledger()) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return rows
+
+
+def best_b512() -> tuple[float, bool, bool]:
+    """(value, chains, miller) of the best successful non-h2c non-wsm
+    B=512 verify in this round's ledger."""
+    best = (0.0, False, False)
+    for d in _ledger_rows():
+        r = d.get("result") or {}
+        if (isinstance(r, dict) and r.get("batch") == 512
+                and r.get("value", 0) > best[0]
+                and not r.get("device_h2c")
+                and not r.get("wsm")
+                and "TPU" in str(r.get("device", ""))):
+            best = (r["value"], bool(r.get("chains")),
+                    bool(r.get("miller_fused")))
+    return best
+
+
+def mxu_won() -> bool:
+    """Did the most recent BENCH_MXU A/B in this round's ledger favour
+    the MXU core?  Verify-sweep speedups decide; the mont_mul microbench
+    breaks the tie when no verify rows were measured."""
+    for d in reversed(_ledger_rows()):
+        r = d.get("result") or {}
+        m = r.get("mxu") if isinstance(r, dict) else None
+        if not isinstance(m, dict):
+            continue
+        verify = m.get("verify") or []
+        if verify:
+            ups = [v.get("mxu_speedup", 0) for v in verify]
+            return sum(1 for s in ups if s > 1.0) * 2 > len(ups)
+        mm = m.get("mont_mul") or {}
+        return mm.get("mxu_speedup", 0) > 1.0
+    return False
+
+
+def _resolve(stage: dict) -> dict:
+    """Materialize "auto" parameters from the ledger at execution time."""
+    st = dict(stage)
+    if st.get("chains") == "auto" or st.get("miller") == "auto":
+        _val, chains, miller = best_b512()
+        if st.get("chains") == "auto":
+            st["chains"] = chains
+        if st.get("miller") == "auto":
+            st["miller"] = miller
+    if st.get("mxu") == "auto":
+        st["mxu"] = mxu_won()
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Agendas — one list per measurement campaign
+# ---------------------------------------------------------------------------
+
+AGENDAS: dict[str, list[dict]] = {
+    # r5 provenance (TPU_SESSION_r05.jsonl): the four historical waves,
+    # flattened to what each actually ran.  Kept replayable — stages
+    # that branched on verdicts use "auto" (ledger-resolved).
+    "r5": [
+        {"kind": "bench", "batch": 512, "chains": False, "miller": False,
+         "abort_on_fail": True},
+        {"kind": "bench", "batch": 512, "chains": True, "miller": False,
+         "timeout": 5500},
+        {"kind": "bench", "batch": 512, "chains": "auto", "miller": True,
+         "timeout": 7000},
+        {"kind": "bench", "batch": 4096, "chains": "auto",
+         "miller": "auto", "timeout": 7000},
+        {"kind": "bench", "batch": 8192, "chains": "auto",
+         "miller": "auto", "timeout": 7000},
+        {"kind": "epoch"},
+        {"kind": "bench", "batch": 512, "chains": "auto",
+         "device_h2c": True, "timeout": 5500},
+    ],
+    "r5-wsm": [  # the session3 wave: fused-WSM A/B + windowed chains
+        {"kind": "bench", "batch": 512, "chains": "auto",
+         "miller": "auto", "wsm": True, "timeout": 6000,
+         "abort_on_fail": True},
+        {"kind": "bench", "batch": 512, "chains": True, "miller": True,
+         "timeout": 6000},
+        {"kind": "bench", "batch": 8192, "chains": "auto",
+         "miller": True, "timeout": 7000},
+        {"kind": "entry_warm"},
+    ],
+    "r5-megachain": [  # the session4 wave: consolidation + pipeline
+        {"kind": "dispatch_audit"},
+        {"kind": "bench", "batch": 512, "chains": True, "miller": True,
+         "timeout": 6000},
+        {"kind": "bench", "batch": 512, "chains": True, "miller": True,
+         "device_h2c": True, "timeout": 6000},
+        {"kind": "bench", "batch": 2048, "chains": "auto", "miller": True,
+         "pipeline": True, "timeout": 6000},
+        {"kind": "bench", "batch": 8192, "chains": "auto", "miller": True,
+         "timeout": 7000},
+        {"kind": "entry_warm"},
+    ],
+    # r6: the MXU-vs-VPU Montgomery core campaign (ROADMAP item 1).
+    # The whole on-chip A/B is ONE agenda entry: BENCH_MXU=1 makes the
+    # bench child run the mont_mul microbench plus the end-to-end
+    # verify sweep (BENCH_MXU_VERIFY_BATCHES default 512,4096,8192)
+    # with fp.set_mxu toggled across separate jit compiles, recording
+    # kind="mxu" BENCH_HISTORY rows.
+    "r6": [
+        {"kind": "dispatch_audit"},
+        {"kind": "bench", "batch": 512, "miller": True,
+         "abort_on_fail": True},          # baseline refresh, warm cache
+        {"kind": "bench", "batch": 512, "miller": True, "bench_mxu": True,
+         "timeout": 9000},                # the MXU A/B (micro + sweep)
+        {"kind": "bench", "batch": 8192, "miller": True, "mxu": "auto",
+         "timeout": 7000},                # headline in the winning arm
+        {"kind": "entry_warm"},
+    ],
+}
+
+_BENCH_KEYS = ("batch", "chains", "miller", "device_h2c", "wsm", "mxu",
+               "bench_mxu", "pipeline", "timeout")
+
+
+def run_stage(stage: dict) -> bool:
+    """Execute one resolved stage; returns success (bench kinds only —
+    audit/warm stages never gate the agenda)."""
+    st = _resolve(stage)
+    kind = st["kind"]
+    if kind == "bench":
+        kwargs = {k: st[k] for k in _BENCH_KEYS if k in st}
+        return ok(run_bench_child(**kwargs))
+    if kind == "epoch":
+        return run_epoch_bench() is not None
+    if kind == "dispatch_audit":
+        run_dispatch_audit()
+        return True
+    if kind == "entry_warm":
+        run_entry_warm()
+        return True
+    log({"stage": "unknown stage kind", "spec": st})
+    return False
+
+
+def run_agenda(name: str) -> int:
+    stages = AGENDAS[name]
+    log({"stage": f"session start (agenda {name})", "pid": os.getpid(),
+         "stages": len(stages)})
+    for i, stage in enumerate(stages):
+        good = run_stage(stage)
+        if not good and stage.get("abort_on_fail"):
+            log({"stage": "abort", "why": f"stage {i} ({stage['kind']}) "
+                 "failed; relay presumed dead"})
+            return 1
+    log({"stage": f"session done (agenda {name})"})
+    return 0
+
+
+def main(argv=None) -> int:
+    global _ROUND
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--agenda", default=None,
+                    help=f"one of: {', '.join(sorted(AGENDAS))}")
+    ap.add_argument("--list", action="store_true",
+                    help="print agendas and their stages, then exit")
+    args = ap.parse_args(argv)
+    if args.list or not args.agenda:
+        for name in sorted(AGENDAS):
+            print(f"{name}:")
+            for st in AGENDAS[name]:
+                print(f"  {json.dumps(st)}")
+        return 0
+    if args.agenda not in AGENDAS:
+        ap.error(f"unknown agenda {args.agenda!r} "
+                 f"(of: {', '.join(sorted(AGENDAS))})")
+    # r5* waves share the historical ledger; later rounds get their own
+    _ROUND = "r05" if args.agenda.startswith("r5") else args.agenda
+    return run_agenda(args.agenda)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
