@@ -73,6 +73,11 @@ class AuditConfig:
     faults_defs: str = "lighthouse_tpu/utils/faults.py"
     scenarios_defs: str = "lighthouse_tpu/scenario/spec.py"
     spans_defs: str = "lighthouse_tpu/obs/tracer.py"
+    # scenario-search mutation surface: the literal constants in
+    # search_defs must reference registered shapes/tracks/knobs
+    search_defs: str = "lighthouse_tpu/scenario/search.py"
+    traffic_defs: str = "lighthouse_tpu/scenario/traffic.py"
+    adversity_defs: str = "lighthouse_tpu/scenario/adversity.py"
     docs: tuple = ("README.md", "STATUS.md")
     hot_path: dict = field(
         default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
@@ -207,6 +212,12 @@ def load_config(path: str) -> AuditConfig:
         cfg.scenarios_defs = a["scenarios_defs"]
     if "spans_defs" in a:
         cfg.spans_defs = a["spans_defs"]
+    if "search_defs" in a:
+        cfg.search_defs = a["search_defs"]
+    if "traffic_defs" in a:
+        cfg.traffic_defs = a["traffic_defs"]
+    if "adversity_defs" in a:
+        cfg.adversity_defs = a["adversity_defs"]
     if "docs" in a:
         cfg.docs = tuple(a["docs"])
     if "site_scan_exclude" in a:
@@ -290,11 +301,23 @@ def run_audit(
                     rule="parse-error", path=rel, line=0, symbol=rel,
                     message="doc listed in audit config is unreadable",
                 ))
+        # the parse_scenario_arg round-trip only binds against the live
+        # registry (fixture corpora re-point scenarios_defs at fakes)
+        live_scenarios = (
+            cfg.scenarios_defs == AuditConfig.scenarios_defs
+        )
         violations.extend(registry_lint.run(
             files, docs, cfg.metrics_defs, cfg.faults_defs,
             cfg.site_scan_exclude,
             scenarios_defs_path=cfg.scenarios_defs,
             spans_defs_path=cfg.spans_defs,
+            scenario_arg_validator=(
+                registry_lint.default_scenario_arg_validator
+                if live_scenarios else None
+            ),
+            search_defs_path=cfg.search_defs,
+            traffic_defs_path=cfg.traffic_defs,
+            adversity_defs_path=cfg.adversity_defs,
         ))
         fam_t["registry"] = time.perf_counter() - t
 

@@ -508,9 +508,25 @@ def scenario_defs(src: str, path: str) -> dict[str, int]:
     return names
 
 
-def scenario_spec_violations(docs, known_names) -> list[Violation]:
+def default_scenario_arg_validator(raw: str):
+    """Validate a concrete doc example against the real
+    ``parse_scenario_arg`` grammar (name lookup + ``:key=val`` override
+    parsing).  Returns an error string or None."""
+    from lighthouse_tpu.scenario.spec import parse_scenario_arg
+
+    try:
+        parse_scenario_arg(raw)
+    except Exception as exc:
+        return str(exc)
+    return None
+
+
+def scenario_spec_violations(docs, known_names,
+                             arg_validator=None) -> list[Violation]:
     """Every concrete ``--scenario NAME[:key=val]`` doc example must name
-    a registered scenario (overrides are stripped before the check)."""
+    a registered scenario; with ``arg_validator`` (the live audit passes
+    :func:`default_scenario_arg_validator`) the full example must also
+    round-trip through the real ``parse_scenario_arg`` grammar."""
     out = []
     for display, text in docs:
         for lineno, line in enumerate(text.splitlines(), start=1):
@@ -527,6 +543,178 @@ def scenario_spec_violations(docs, known_names) -> list[Violation]:
                             f"scenario {name!r}"
                         ),
                     ))
+                    continue
+                if arg_validator is not None:
+                    err = arg_validator(raw)
+                    if err is not None:
+                        out.append(Violation(
+                            rule="scenario-spec", path=display, line=lineno,
+                            symbol=raw,
+                            message=(
+                                f"--scenario example does not parse under "
+                                f"parse_scenario_arg: {err}"
+                            ),
+                        ))
+    return out
+
+
+# -- scenario-search mutation surface ------------------------------------
+
+
+def search_surface_defs(src: str, path: str):
+    """AST-parse the literal mutation-surface constants from search.py:
+    ``MUTATION_SHAPES``/``MUTATION_TRACKS`` (tuples of str, with lines)
+    and ``KNOB_RANGES`` (track name -> [knob key, ...])."""
+    tree = ast.parse(src, filename=path)
+    shapes: dict[str, int] = {}
+    tracks: dict[str, int] = {}
+    knobs: dict[str, tuple[list[str], int]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        v = node.value
+        if ("MUTATION_SHAPES" in names or "MUTATION_TRACKS" in names) and \
+                isinstance(v, (ast.Tuple, ast.List)):
+            dst = shapes if "MUTATION_SHAPES" in names else tracks
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    dst[e.value] = e.lineno
+        elif "KNOB_RANGES" in names and isinstance(v, ast.Dict):
+            for k, val in zip(v.keys, v.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                keys = []
+                if isinstance(val, ast.Dict):
+                    keys = [kk.value for kk in val.keys
+                            if isinstance(kk, ast.Constant)
+                            and isinstance(kk.value, str)]
+                knobs[k.value] = (keys, k.lineno)
+    return shapes, tracks, knobs
+
+
+def registry_class_names(src: str, path: str, registry_var: str):
+    """Registered names from a ``REGISTRY = {cls.name: cls for cls in
+    (A, B, ...)}`` module: name literal -> __init__ kwarg names.  Pure
+    AST — maps the comprehension's class tuple through each class's
+    literal ``name`` attribute and ``__init__`` signature."""
+    tree = ast.parse(src, filename=path)
+    cls_name_attr: dict[str, str] = {}
+    cls_init_args: dict[str, list[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if (isinstance(stmt, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "name"
+                            for t in stmt.targets)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                cls_name_attr[node.name] = stmt.value.value
+            elif isinstance(stmt, ast.FunctionDef) and \
+                    stmt.name == "__init__":
+                cls_init_args[node.name] = [
+                    a.arg for a in stmt.args.args[1:]
+                ]
+    members: dict[str, list[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == registry_var
+                   for t in node.targets):
+            continue
+        v = node.value
+        if isinstance(v, ast.DictComp) and v.generators and isinstance(
+            v.generators[0].iter, (ast.Tuple, ast.List)
+        ):
+            for e in v.generators[0].iter.elts:
+                if isinstance(e, ast.Name) and e.id in cls_name_attr:
+                    members[cls_name_attr[e.id]] = cls_init_args.get(
+                        e.id, []
+                    )
+    return members
+
+
+def search_surface_violations(
+    files, search_defs_path, traffic_defs_path, adversity_defs_path
+) -> list[Violation]:
+    """Every mutation-surface name in search.py must reference a real
+    registered shape/track, and every KNOB_RANGES knob must be a real
+    ``__init__`` parameter of that track class — the same
+    literal-vs-registry cross-reference the chaos/scenario families
+    enforce, so search can never mutate toward a dimension the engine
+    would reject."""
+    files = dict(files)
+    out: list[Violation] = []
+    search_src = files.get(search_defs_path)
+    if search_src is None:
+        return out  # corpus without the search engine: skip the family
+    shapes, tracks, knobs = search_surface_defs(search_src,
+                                                search_defs_path)
+    if not (shapes and tracks and knobs):
+        return [Violation(
+            rule="search-surface", path=search_defs_path, line=0,
+            symbol="MUTATION_SHAPES",
+            message="mutation-surface constants missing or non-literal "
+                    "(MUTATION_SHAPES / MUTATION_TRACKS / KNOB_RANGES)",
+        )]
+    real_shapes = real_tracks = None
+    traffic_src = files.get(traffic_defs_path)
+    if traffic_src is not None:
+        real_shapes = registry_class_names(
+            traffic_src, traffic_defs_path, "SHAPES"
+        )
+    adversity_src = files.get(adversity_defs_path)
+    if adversity_src is not None:
+        real_tracks = registry_class_names(
+            adversity_src, adversity_defs_path, "TRACKS"
+        )
+    if real_shapes:
+        for name, line in sorted(shapes.items()):
+            if name not in real_shapes:
+                out.append(Violation(
+                    rule="search-surface", path=search_defs_path,
+                    line=line, symbol=name,
+                    message=(
+                        f"MUTATION_SHAPES entry {name!r} is not a "
+                        f"registered traffic shape"
+                    ),
+                ))
+    if real_tracks:
+        for name, line in sorted(tracks.items()):
+            if name not in real_tracks:
+                out.append(Violation(
+                    rule="search-surface", path=search_defs_path,
+                    line=line, symbol=name,
+                    message=(
+                        f"MUTATION_TRACKS entry {name!r} is not a "
+                        f"registered adversity track"
+                    ),
+                ))
+        for track, (keys, line) in sorted(knobs.items()):
+            if track not in tracks:
+                out.append(Violation(
+                    rule="search-surface", path=search_defs_path,
+                    line=line, symbol=track,
+                    message=(
+                        f"KNOB_RANGES track {track!r} is not in "
+                        f"MUTATION_TRACKS"
+                    ),
+                ))
+            params = real_tracks.get(track)
+            if params is None:
+                continue
+            for key in keys:
+                if key not in params:
+                    out.append(Violation(
+                        rule="search-surface", path=search_defs_path,
+                        line=line, symbol=f"{track}.{key}",
+                        message=(
+                            f"KNOB_RANGES knob {key!r} is not an "
+                            f"__init__ parameter of the {track!r} track"
+                        ),
+                    ))
     return out
 
 
@@ -534,6 +722,9 @@ def run(
     files, docs, metrics_defs_path, faults_defs_path,
     site_scan_exclude=("tests/",), spec_validator=None,
     scenarios_defs_path=None, spans_defs_path=None,
+    scenario_arg_validator=None,
+    search_defs_path=None, traffic_defs_path=None,
+    adversity_defs_path=None,
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
@@ -556,6 +747,13 @@ def run(
         # absent in fixture corpora: skip the family rather than flag it
         if scn_src is not None:
             out.extend(scenario_spec_violations(
-                docs, scenario_defs(scn_src, scenarios_defs_path)
+                docs, scenario_defs(scn_src, scenarios_defs_path),
+                arg_validator=scenario_arg_validator,
             ))
+    if search_defs_path is not None:
+        out.extend(search_surface_violations(
+            files, search_defs_path,
+            traffic_defs_path or "lighthouse_tpu/scenario/traffic.py",
+            adversity_defs_path or "lighthouse_tpu/scenario/adversity.py",
+        ))
     return out

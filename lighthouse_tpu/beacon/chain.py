@@ -95,31 +95,45 @@ class SignatureVerifiedBlock:
 class ValidatorPubkeyCache:
     """Index -> decompressed PublicKey (validator_pubkey_cache.rs:9-16).
     This is the marshaling table the device backend consumes; grows
-    monotonically with the registry."""
+    monotonically with the registry.
+
+    Decompression is lazy: ``update`` records the raw compressed bytes
+    (cheap), and the expensive BLS decompression happens on first ``get``
+    of each index.  A registry padded with inactive synthetic validators
+    (cheap-node scenarios) never pays for keys nobody looks up, and one
+    cache instance can safely be shared across every node of an in-process
+    simulation (the registry prefix is identical chain-wide)."""
 
     def __init__(self):
-        self._keys: list[bls.PublicKey | None] = []
+        self._raw: list[bytes] = []
+        self._keys: dict[int, bls.PublicKey | None] = {}
 
     def update(self, state) -> None:
-        for v in state.validators[len(self._keys) :]:
-            try:
-                self._keys.append(bls.PublicKey.from_bytes(bytes(v.pubkey)))
-            except Exception:
-                self._keys.append(None)
+        vs = state.validators
+        for i in range(len(self._raw), len(vs)):
+            self._raw.append(bytes(vs[i].pubkey))
 
     def get(self, index: int) -> bls.PublicKey | None:
-        if 0 <= index < len(self._keys):
+        if not 0 <= index < len(self._raw):
+            return None
+        if index in self._keys:
             return self._keys[index]
-        return None
+        try:
+            key = bls.PublicKey.from_bytes(self._raw[index])
+        except Exception:
+            key = None
+        self._keys[index] = key
+        return key
 
     def __len__(self):
-        return len(self._keys)
+        return len(self._raw)
 
 
 class BeaconChain:
     def __init__(self, spec: S.ChainSpec, genesis_state, store: HotColdDB | None,
                  slot_clock=None, fork: str = "base", execution=None,
-                 committee_caches: dict | None = None):
+                 committee_caches: dict | None = None,
+                 pubkey_cache: ValidatorPubkeyCache | None = None):
         self.spec = spec
         self.preset = spec.preset
         self.types = types_for(spec.preset)
@@ -173,23 +187,24 @@ class BeaconChain:
         self.op_pool = OperationPool()
 
         genesis_state = genesis_state.copy()
+        genesis_state_root = genesis_state.root()
         # Anchor root: the latest header with its state_root filled — the
         # same value per-slot processing will fill in, and the canonical
         # "genesis block root" identity (header.root == block.root once
         # state_root is set).
         anchor_header = genesis_state.latest_block_header.copy()
         if bytes(anchor_header.state_root) == bytes(32):
-            anchor_header.state_root = genesis_state.root()
+            anchor_header.state_root = genesis_state_root
         genesis_root = anchor_header.root()
         self.genesis_block_root = genesis_root
-        self.store.put_state(genesis_state.root(), genesis_state)
+        self.store.put_state(genesis_state_root, genesis_state)
         self.fork_choice = ForkChoice(
             spec,
             FcBlock(
                 slot=int(genesis_state.slot),
                 root=genesis_root,
                 parent_root=None,
-                state_root=genesis_state.root(),
+                state_root=genesis_state_root,
                 justified_epoch=0,
                 finalized_epoch=0,
             ),
@@ -203,7 +218,9 @@ class BeaconChain:
         self._committee_caches: dict[tuple[bytes, int], cm.CommitteeCache] = (
             committee_caches if committee_caches is not None else {}
         )
-        self.pubkey_cache = ValidatorPubkeyCache()
+        self.pubkey_cache = (
+            pubkey_cache if pubkey_cache is not None else ValidatorPubkeyCache()
+        )
         self.pubkey_cache.update(genesis_state)
         # observed-gossip dedup (observed_attesters / observed_block_producers)
         self._observed_blocks: set[bytes] = set()
@@ -789,7 +806,9 @@ class BeaconChain:
         for agg in self.naive_pool.get_aggregates():
             self.op_pool.insert_attestation(agg)
         atts = self.op_pool.get_attestations_for_block(state, self.preset)
-        ps, asl, exits = self.op_pool.get_slashings_and_exits(state, self.preset)
+        ps, asl, exits = self.op_pool.get_slashings_and_exits(
+            state, self.preset, spec=self.spec
+        )
         body_cls = self.types.BeaconBlockBody_BY_FORK[fork_now]
         body_kwargs = dict(
             randao_reveal=randao_reveal,

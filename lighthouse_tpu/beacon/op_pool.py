@@ -82,7 +82,7 @@ class OperationPool:
             candidates.remove(best)
         return packed
 
-    def get_slashings_and_exits(self, state, preset):
+    def get_slashings_and_exits(self, state, preset, spec=None):
         """Bounded op lists for a block, validity-filtered against the
         packing ``state`` (op_pool/src/lib.rs get_slashings: an op that
         would fail the transition — e.g. a proposer already slashed by an
@@ -113,11 +113,25 @@ class OperationPool:
                 & set(s.attestation_2.attesting_indices)
             )
         ][: preset.max_attester_slashings]
+        def _exitable(e) -> bool:
+            # mirror process_voluntary_exit's full validity ladder — a
+            # packed exit that is too young (shard_committee_period), not
+            # yet due (exit.epoch in the future), inactive, or already
+            # exiting would invalidate the whole proposal
+            idx = int(e.message.validator_index)
+            if idx >= len(state.validators):
+                return False
+            v = state.validators[idx]
+            period = spec.shard_committee_period if spec is not None else 256
+            return (
+                v.exit_epoch == FAR_FUTURE_EPOCH
+                and v.activation_epoch <= current
+                and current >= int(e.message.epoch)
+                and current >= v.activation_epoch + period
+            )
+
         exits = [
-            e for e in self.voluntary_exits.values()
-            if int(e.message.validator_index) < len(state.validators)
-            and state.validators[int(e.message.validator_index)].exit_epoch
-            == FAR_FUTURE_EPOCH
+            e for e in self.voluntary_exits.values() if _exitable(e)
         ][: preset.max_voluntary_exits]
         return ps, asl, exits
 

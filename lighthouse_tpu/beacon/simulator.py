@@ -28,7 +28,8 @@ class SimNode:
     def __init__(self, node_id: str, spec: S.ChainSpec, genesis_state,
                  router: gossip.GossipRouter, fork: str = "altair",
                  committee_caches: dict | None = None,
-                 slasher: bool = False):
+                 slasher: bool = False,
+                 pubkey_cache=None):
         self.node_id = node_id
         self.spec = spec
         self.clock = ManualSlotClock(
@@ -37,18 +38,17 @@ class SimNode:
         )
         self.chain = BeaconChain(
             spec, genesis_state, store=None, slot_clock=self.clock, fork=fork,
-            committee_caches=committee_caches,
+            committee_caches=committee_caches, pubkey_cache=pubkey_cache,
         )
         self.gossip = gossip.GossipNode(node_id, router)
         self.fork = fork
         # optional in-node slasher (service.rs analog): every gossiped
         # block's header is fed BEFORE import so equivocations are seen
-        # even when fork choice never adopts the second block
-        self.slasher = None
-        if slasher:
-            from ..slasher import Slasher
-
-            self.slasher = Slasher()
+        # even when fork choice never adopts the second block.  Constructed
+        # lazily on first access (cheap-node path: dozens of nodes, most of
+        # which never see a slashable offence, skip the surface setup).
+        self._slasher_enabled = slasher
+        self._slasher = None
         gvr = bytes(genesis_state.genesis_validators_root)
         digest = topics.fork_digest(spec, 0, gvr)
         self.block_topic = topics.topic("beacon_block", digest)
@@ -59,6 +59,14 @@ class SimNode:
         self.gossip.subscribe(self.block_topic, self._on_block)
         for t in self.att_topics:
             self.gossip.subscribe(t, self._on_attestation)
+
+    @property
+    def slasher(self):
+        if self._slasher is None and self._slasher_enabled:
+            from ..slasher import Slasher
+
+            self._slasher = Slasher()
+        return self._slasher
 
     # ------------------------------------------------------- gossip handlers
 
@@ -163,16 +171,31 @@ class Simulator:
     """
 
     def __init__(self, n_nodes: int = 3, n_validators: int = 32,
-                 fork: str = "altair", injector=None, slasher: bool = False):
-        self.spec = phase0_spec(S.MINIMAL)
+                 fork: str = "altair", injector=None, slasher: bool = False,
+                 registry_padding: int = 0,
+                 spec_overrides: tuple = ()):
+        import dataclasses
+
+        from .chain import ValidatorPubkeyCache
+
+        spec = phase0_spec(S.MINIMAL)
+        if spec_overrides:
+            spec = dataclasses.replace(spec, **dict(spec_overrides))
+        self.spec = spec
         genesis, self.keypairs = interop_state(
-            n_validators, self.spec, fork=fork
+            n_validators, self.spec, fork=fork,
+            registry_padding=registry_padding,
         )
         self.router = gossip.GossipRouter(injector=injector)
         shared_caches: dict = {}
+        # one lazy pubkey cache for the whole mesh: the registry prefix is
+        # identical chain-wide, so decompressing a pubkey once serves all
+        # nodes (cheap-node path)
+        shared_pubkeys = ValidatorPubkeyCache()
         self.nodes = [
             SimNode(f"node{i}", self.spec, genesis, self.router, fork,
-                    committee_caches=shared_caches, slasher=slasher)
+                    committee_caches=shared_caches, slasher=slasher,
+                    pubkey_cache=shared_pubkeys)
             for i in range(n_nodes)
         ]
         # a driver harness view for producing blocks/attestations with keys
@@ -201,14 +224,19 @@ class Simulator:
         for node in self.nodes:
             node.clock.set_slot(slot)
 
-    def attest(self, slot: int, view_node: SimNode | None = None) -> list:
+    def attest(self, slot: int, view_node: SimNode | None = None,
+               keep=None) -> list:
         """Sign + gossip every committee attestation scheduled at ``slot``
         from ``view_node``'s head view (committees are identical across
-        honest nodes).  Returns the attestations for traffic shapes that
-        re-publish or flood them."""
+        honest nodes).  ``keep`` (att -> bool) suppresses publication of
+        filtered-out attestations — the finality-stall lever.  Returns the
+        published attestations for traffic shapes that re-publish or flood
+        them."""
         view_node = view_node or self.proposer_node(slot)
         self._producer.chain = view_node.chain
         atts = BeaconChainHarness.make_attestations(self._producer, slot)
+        if keep is not None:
+            atts = [att for att in atts if keep(att)]
         for att in atts:
             attester_node = self.nodes[int(att.data.index) % len(self.nodes)]
             attester_node.publish_attestation(att)

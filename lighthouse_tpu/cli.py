@@ -64,8 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run a named adversarial scenario (SLO-gated, "
                          "seed-deterministic; see scenario/spec.py and "
                          "tools/scenario_run.py --list) instead of "
-                         "serving, e.g. --scenario smoke or "
-                         "--scenario mainnet-shape:seed=99; exits 0/1 "
+                         "serving, e.g. --scenario smoke, "
+                         "--scenario mainnet-shape:seed=99, or the "
+                         "hostile regimes --scenario long-non-finality, "
+                         "--scenario slashing-flood, "
+                         "--scenario hostile-checkpoint-sync:epochs=4, "
+                         "--scenario registry-pressure; exits 0/1 "
                          "on SLO pass/fail")
     bn.add_argument("--upnp", action="store_true",
                     help="attempt UPnP port mapping for p2p/discovery "

@@ -25,11 +25,50 @@ def is_active_validator(v, epoch: int) -> bool:
     return v.activation_epoch <= epoch < v.exit_epoch
 
 
+# Active-set scans over frozen registries (the cheap-node path, where every
+# mutation rebinds the list so identity implies content).  Level 1 is hit
+# by repeated scans of one state; level 2 keys on the shared element
+# identities, so every node in a mesh reuses one scan of the same content.
+# Callers treat the returned array as read-only (shuffles gather-copy).
+_ACTIVE_BY_ID: dict = {}
+_ACTIVE_BY_ELEMS: dict = {}
+
+
 def get_active_validator_indices(state, epoch: int) -> np.ndarray:
-    return np.array(
-        [i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)],
-        dtype=np.int64,
+    vs = state.validators
+    cacheable = (
+        len(vs) >= 4096 and vs and vs[0].__dict__.get("_frozen", False)
     )
+    if not cacheable:
+        return np.array(
+            [i for i, v in enumerate(vs) if is_active_validator(v, epoch)],
+            dtype=np.int64,
+        )
+    key = (id(vs), epoch)
+    hit = _ACTIVE_BY_ID.get(key)
+    if hit is not None and hit[1] is vs:
+        return hit[0]
+    ekey = (epoch, tuple(map(id, vs)))
+    hit2 = _ACTIVE_BY_ELEMS.get(ekey)
+    if hit2 is not None:
+        arr = hit2[0]
+    else:
+        arr = np.array(
+            [i for i, v in enumerate(vs) if is_active_validator(v, epoch)],
+            dtype=np.int64,
+        )
+        # identity-keyed sharing is only sound if every element is frozen
+        # (an unfrozen element could mutate under the same id)
+        if all(v.__dict__.get("_frozen") for v in vs):
+            if len(_ACTIVE_BY_ELEMS) >= 4:
+                _ACTIVE_BY_ELEMS.pop(next(iter(_ACTIVE_BY_ELEMS)))
+            _ACTIVE_BY_ELEMS[ekey] = (arr, list(vs))
+        else:
+            return arr
+    if len(_ACTIVE_BY_ID) >= 8:
+        _ACTIVE_BY_ID.pop(next(iter(_ACTIVE_BY_ID)))
+    _ACTIVE_BY_ID[key] = (arr, vs)
+    return arr
 
 
 def get_seed(state, epoch: int, domain_type: bytes, preset: Preset) -> bytes:

@@ -85,6 +85,13 @@ class Checkpoint(Container):
 
 
 class Validator(Container):
+    """Registry entry.  Supports an opt-in freeze/copy-on-write protocol for
+    registry-scale scenarios: a frozen validator is immutable (``__setattr__``
+    raises; mutators must go through :meth:`thawed`), shares itself across
+    state copies (``__deepcopy__``/``copy_value`` return ``self``), and memoizes
+    its hash tree root — so a 100k-entry registry of mostly-inert validators
+    costs O(active) per state copy/root instead of O(registry)."""
+
     fields = {
         "pubkey": BLSPubkey,
         "withdrawal_credentials": Bytes32,
@@ -95,6 +102,105 @@ class Validator(Container):
         "exit_epoch": U64,
         "withdrawable_epoch": U64,
     }
+
+    _freezable = True
+
+    def freeze(self) -> "Validator":
+        """Mark immutable (idempotent).  Returns self for chaining."""
+        self.__dict__["_frozen"] = True
+        return self
+
+    @property
+    def frozen(self) -> bool:
+        return self.__dict__.get("_frozen", False)
+
+    def thawed(self, **changes) -> "Validator":
+        """Replace-on-write: a fresh *mutable* validator with ``changes``
+        applied.  The canonical mutation path for frozen registries — callers
+        rebind the registry slot to the thawed copy."""
+        new = type(self).__new__(type(self))
+        d = new.__dict__
+        src = self.__dict__
+        for fname in self._fields:
+            d[fname] = src[fname]
+        for fname, v in changes.items():
+            if fname not in self._fields:
+                raise TypeError(f"unknown field: {fname}")
+            d[fname] = v
+        return new
+
+    def __setattr__(self, name, value):
+        if self.__dict__.get("_frozen"):
+            raise AttributeError(
+                f"frozen Validator is immutable; use thawed({name}=...) and "
+                "rebind the registry slot"
+            )
+        if name in self._fields:
+            self.__dict__.pop("_root_memo", None)
+            self.__dict__.pop("_ser_memo", None)
+        object.__setattr__(self, name, value)
+
+    def __deepcopy__(self, memo):
+        if self.__dict__.get("_frozen"):
+            return self
+        new = self.thawed()
+        memo[id(self)] = new
+        return new
+
+    def root(self) -> bytes:
+        memo = self.__dict__.get("_root_memo")
+        if memo is None:
+            memo = type(self).hash_tree_root_value(self)
+            self.__dict__["_root_memo"] = memo
+        return memo
+
+    @classmethod
+    def bulk_roots(cls, validators) -> None:
+        """Prefill ``_root_memo`` for many validators in wide numpy-batched
+        SHA-256 passes (one tree level per pass across ALL validators),
+        instead of one per-validator Merkleization each.  Registry-scale
+        genesis builds go from seconds to tens of milliseconds; ``root()``
+        and the SSZ sequence-root path consume the memos transparently."""
+        import numpy as np
+
+        from ..ops import sha256_many
+
+        todo = [v for v in validators if "_root_memo" not in v.__dict__]
+        if not todo:
+            return
+        n = len(todo)
+        # chunk 0: pubkey root = sha256(48 bytes || 16 zero bytes)
+        pk = np.zeros((n, 64), dtype=np.uint8)
+        pk[:, :48] = np.frombuffer(
+            b"".join(bytes(v.pubkey) for v in todo), dtype=np.uint8
+        ).reshape(n, 48)
+        chunks = np.zeros((n, 8, 32), dtype=np.uint8)
+        chunks[:, 0] = sha256_many(pk)
+        chunks[:, 1] = np.frombuffer(
+            b"".join(bytes(v.withdrawal_credentials) for v in todo),
+            dtype=np.uint8,
+        ).reshape(n, 32)
+        u64_fields = (
+            (2, "effective_balance"),
+            (4, "activation_eligibility_epoch"),
+            (5, "activation_epoch"),
+            (6, "exit_epoch"),
+            (7, "withdrawable_epoch"),
+        )
+        for ci, fname in u64_fields:
+            col = np.fromiter(
+                (getattr(v, fname) for v in todo), dtype="<u8", count=n
+            )
+            chunks[:, ci, :8] = col.view(np.uint8).reshape(n, 8)
+        chunks[:, 3, 0] = np.fromiter(
+            (1 if v.slashed else 0 for v in todo), dtype=np.uint8, count=n
+        )
+        lvl = chunks.reshape(n * 4, 64)
+        lvl = sha256_many(lvl).reshape(n * 2, 64)
+        lvl = sha256_many(lvl).reshape(n, 64)
+        roots = sha256_many(lvl)
+        for v, r in zip(todo, roots):
+            v.__dict__["_root_memo"] = r.tobytes()
 
 
 class AttestationData(Container):
@@ -525,7 +631,16 @@ class TypesFamily:
             "finalized_checkpoint": F(Checkpoint),
         }
 
-        class BeaconState(Container):
+        class _FastCopyState(Container):
+            """States are copied on every import/proposal path; the
+            type-driven field-wise copy replaces deepcopy's memo walk and
+            lets frozen registry validators be shared instead of cloned —
+            the difference between O(registry) and O(active) per copy."""
+
+            def copy(self):
+                return type(self).copy_value_of(self)
+
+        class BeaconState(_FastCopyState):
             fields = {
                 **_state_base_fields,
                 "previous_epoch_attestations": SSZList(
@@ -549,7 +664,7 @@ class TypesFamily:
             "next_sync_committee": F(SyncCommittee),
         }
 
-        class BeaconStateAltair(Container):
+        class BeaconStateAltair(_FastCopyState):
             fields = {
                 **_state_base_fields,
                 **_altair_participation,
@@ -557,13 +672,13 @@ class TypesFamily:
                 **_altair_tail,
             }
 
-        class BeaconStateBellatrix(Container):
+        class BeaconStateBellatrix(_FastCopyState):
             fields = {
                 **BeaconStateAltair.fields,
                 "latest_execution_payload_header": F(ExecutionPayloadHeader),
             }
 
-        class BeaconStateCapella(Container):
+        class BeaconStateCapella(_FastCopyState):
             fields = {
                 **BeaconStateAltair.fields,
                 "latest_execution_payload_header": F(ExecutionPayloadHeaderCapella),
@@ -574,7 +689,7 @@ class TypesFamily:
                 ),
             }
 
-        class BeaconStateDeneb(Container):
+        class BeaconStateDeneb(_FastCopyState):
             fields = {
                 **BeaconStateAltair.fields,
                 "latest_execution_payload_header": F(ExecutionPayloadHeaderDeneb),

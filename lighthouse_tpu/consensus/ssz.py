@@ -110,6 +110,15 @@ class SSZType:
     def default(self):
         raise NotImplementedError
 
+    def copy_value(self, value):
+        """Independent copy of ``value`` with deepcopy semantics.  Basic
+        types return the (immutable) value itself; collections rebuild the
+        outer list; containers recurse field-wise.  The fallback is a true
+        deepcopy so exotic value shapes stay correct."""
+        import copy as _copy
+
+        return _copy.deepcopy(value)
+
 
 class UintN(SSZType):
     def __init__(self, bits: int):
@@ -140,6 +149,9 @@ class UintN(SSZType):
     def default(self) -> int:
         return 0
 
+    def copy_value(self, value):
+        return value
+
 
 class Boolean(SSZType):
     def __repr__(self):
@@ -166,6 +178,9 @@ class Boolean(SSZType):
 
     def default(self) -> bool:
         return False
+
+    def copy_value(self, value):
+        return value
 
 
 U8, U16, U32, U64, U128, U256 = (UintN(b) for b in (8, 16, 32, 64, 128, 256))
@@ -204,6 +219,9 @@ class ByteVector(SSZType):
     def default(self) -> bytes:
         return b"\x00" * self.length
 
+    def copy_value(self, value):
+        return value
+
 
 class ByteList(SSZType):
     """Variable bytes with a max length (e.g. transactions, extra_data)."""
@@ -240,6 +258,9 @@ class ByteList(SSZType):
     def default(self) -> bytes:
         return b""
 
+    def copy_value(self, value):
+        return value
+
 
 class Vector(SSZType):
     def __init__(self, elem: SSZType, length: int):
@@ -275,6 +296,9 @@ class Vector(SSZType):
     def default(self):
         return [self.elem.default() for _ in range(self.length)]
 
+    def copy_value(self, value):
+        return _copy_sequence(self.elem, value)
+
 
 class SSZList(SSZType):
     def __init__(self, elem: SSZType, limit: int):
@@ -302,11 +326,68 @@ class SSZList(SSZType):
         return out
 
     def hash_tree_root(self, value) -> bytes:
-        root = _sequence_root(self.elem, value, self.limit)
+        root = self._registry_root(value)
+        if root is None:
+            root = _sequence_root(self.elem, value, self.limit)
         return _mix_in_length(root, len(value))
+
+    def _registry_root(self, values) -> bytes | None:
+        """Registry-scale root cache (cheap-node path).
+
+        Sound because every state-list mutation in this package is
+        replace-style — a NEW list object is bound to the field; elements
+        are never assigned in place (and frozen validators enforce their
+        own immutability).  Two levels:
+
+        * by outer-list identity — O(1) repeat roots of the same state.
+          The cache pins the list (strong ref) and re-checks ``is`` + len,
+          so a recycled id or an in-place append can never serve stale.
+        * for freezable-container elements, by element-identity tuple —
+          shared across state *copies*, which rebuild the outer list but
+          share the frozen elements.  Engaged only when every element is
+          frozen; the snapshot pins the elements.
+
+        Only engages at registry scale (len >= 4096) where re-Merkleizing
+        dominates; small lists take the plain path untouched.
+        """
+        n = len(values)
+        if n < 4096:
+            return None
+        cls = getattr(self.elem, "cls", None)
+        if cls is not None:
+            if not getattr(cls, "_freezable", False):
+                return None
+        elif not isinstance(self.elem, UintN):
+            return None
+        by_id = self.__dict__.setdefault("_root_by_id", {})
+        hit = by_id.get(id(values))
+        if hit is not None and hit[1] is values and len(hit[1]) == n:
+            return hit[0]
+        if cls is not None:
+            by_elems = self.__dict__.setdefault("_root_by_elems", {})
+            key = tuple(map(id, values))
+            hit2 = by_elems.get(key)
+            if hit2 is not None:
+                root = hit2[0]
+            elif all(v.__dict__.get("_frozen") for v in values):
+                root = _sequence_root(self.elem, values, self.limit)
+                if len(by_elems) >= 4:
+                    by_elems.pop(next(iter(by_elems)))
+                by_elems[key] = (root, list(values))
+            else:
+                return None
+        else:
+            root = _sequence_root(self.elem, values, self.limit)
+        if len(by_id) >= 8:
+            by_id.pop(next(iter(by_id)))
+        by_id[id(values)] = (root, values)
+        return root
 
     def default(self):
         return []
+
+    def copy_value(self, value):
+        return _copy_sequence(self.elem, value)
 
 
 class Bitvector(SSZType):
@@ -342,6 +423,9 @@ class Bitvector(SSZType):
 
     def default(self):
         return [False] * self.length
+
+    def copy_value(self, value):
+        return list(value)
 
 
 class Bitlist(SSZType):
@@ -389,6 +473,9 @@ class Bitlist(SSZType):
     def default(self):
         return []
 
+    def copy_value(self, value):
+        return list(value)
+
 
 def _bits_to_bytes(bits: Sequence[bool]) -> bytes:
     out = bytearray((len(bits) + 7) // 8)
@@ -402,8 +489,16 @@ def _bytes_to_bits(data: bytes) -> list[bool]:
     return [bool((byte >> i) & 1) for byte in data for i in range(8)]
 
 
+def _copy_sequence(elem: SSZType, values: Sequence) -> list:
+    if isinstance(elem, (UintN, Boolean, ByteVector, ByteList)):
+        return list(values)  # immutable elements: fresh outer list only
+    return [elem.copy_value(v) for v in values]
+
+
 def _serialize_sequence(elem: SSZType, values: Sequence) -> bytes:
     if elem.is_fixed_size():
+        if isinstance(elem, UintN) and len(values) > 256:
+            return _pack_uints(elem, values)
         return b"".join(elem.serialize(v) for v in values)
     parts = [elem.serialize(v) for v in values]
     offset = OFFSET_BYTES * len(parts)
@@ -439,16 +534,53 @@ def _deserialize_sequence(elem: SSZType, data: bytes) -> list:
     return out
 
 
+def _pack_uints(elem: "UintN", values: Sequence) -> bytes:
+    """Serialize a uint sequence in one numpy pass (the balances /
+    participation / inactivity lists are 100k+ entries at registry scale;
+    a per-element ``int.to_bytes`` loop dominates the state root there)."""
+    dtype = f"<u{elem.nbytes}"
+    try:
+        return np.asarray(values, dtype=dtype).tobytes()
+    except (OverflowError, TypeError, ValueError):
+        # odd value types (or out-of-range ints caught late): exact path
+        return b"".join(elem.serialize(v) for v in values)
+
+
 def _sequence_root(elem: SSZType, values: Sequence, limit: int | None) -> bytes:
     if isinstance(elem, UintN) or isinstance(elem, Boolean):
-        data = _pack_bytes(b"".join(elem.serialize(v) for v in values))
-        per_chunk = BYTES_PER_CHUNK // elem.fixed_size()
-        limit_chunks = (
-            None if limit is None else (limit + per_chunk - 1) // per_chunk
-        )
-        return _merkleize_chunks(data, limit_chunks)
+        if isinstance(elem, UintN) and len(values) > 256:
+            raw = _pack_uints(elem, values)
+            if len(values) >= 4096:
+                # registry-scale uint lists (balances, participation,
+                # inactivity): every node in a multi-node scenario imports
+                # the same block and re-roots identical content — key the
+                # Merkle pass by the packed bytes so one compute serves
+                # the whole mesh.  Keyed on (limit, content); elem is the
+                # shared UintN singleton, so the cache spans fields.
+                cache = elem.__dict__.setdefault("_big_root_cache", {})
+                key = (limit, raw)
+                hit = cache.get(key)
+                if hit is not None:
+                    return hit
+                root = _uint_sequence_root(elem, raw, limit)
+                if len(cache) >= 8:
+                    cache.pop(next(iter(cache)))
+                cache[key] = root
+                return root
+        else:
+            raw = b"".join(elem.serialize(v) for v in values)
+        return _uint_sequence_root(elem, raw, limit)
     chunks = b"".join(elem.hash_tree_root(v) for v in values)
     return _merkleize_chunks(chunks, limit if limit is not None else None)
+
+
+def _uint_sequence_root(elem: SSZType, raw: bytes, limit: int | None) -> bytes:
+    data = _pack_bytes(raw)
+    per_chunk = BYTES_PER_CHUNK // elem.fixed_size()
+    limit_chunks = (
+        None if limit is None else (limit + per_chunk - 1) // per_chunk
+    )
+    return _merkleize_chunks(data, limit_chunks)
 
 
 class _ContainerMeta(type):
@@ -473,6 +605,11 @@ class Container(SSZType, metaclass=_ContainerMeta):
     """
 
     fields: dict[str, SSZType] = {}
+
+    # Classes that opt into the freeze/copy-on-write protocol (instances
+    # carry a ``_frozen`` marker in __dict__) set this True; the registry
+    # root cache and fast copy path key off it.
+    _freezable = False
 
     def __init__(self, **kwargs):
         for fname, ftype in self._fields.items():
@@ -565,6 +702,20 @@ class Container(SSZType, metaclass=_ContainerMeta):
         )
         return _merkleize_chunks(chunks)
 
+    @classmethod
+    def copy_value_of(cls, value):
+        """Type-driven structural copy: fresh instance, each field copied per
+        its SSZ type.  Equivalent to deepcopy for SSZ-shaped data (all state
+        mutation in this package is attribute/replace-style), but skips the
+        deepcopy memo walk — the difference between seconds and milliseconds
+        on registry-scale states."""
+        new = cls.__new__(cls)
+        d = new.__dict__
+        src = value.__dict__
+        for fname, ftype in cls._fields.items():
+            d[fname] = ftype.copy_value(src[fname])
+        return new
+
     # --- SSZType interface (container used as a field type) ---------------
     def is_fixed_size(self):  # pragma: no cover - shadowed by classmethods
         raise TypeError("use the class, not an instance, as a field type")
@@ -586,16 +737,35 @@ class _ContainerField(SSZType):
         return self.cls.fixed_size_cls()
 
     def serialize(self, value):
-        return self.cls.serialize_value(value)
+        d = value.__dict__
+        memo = d.get("_ser_memo")
+        if memo is not None:
+            return memo
+        out = self.cls.serialize_value(value)
+        if d.get("_frozen"):
+            d["_ser_memo"] = out  # frozen => immutable => bytes never stale
+        return out
 
     def deserialize(self, data):
         return self.cls.deserialize_value(data)
 
     def hash_tree_root(self, value):
-        return self.cls.hash_tree_root_value(value)
+        d = value.__dict__
+        memo = d.get("_root_memo")
+        if memo is not None:
+            return memo
+        root = self.cls.hash_tree_root_value(value)
+        if d.get("_frozen"):
+            d["_root_memo"] = root  # frozen => immutable => memo never stale
+        return root
 
     def default(self):
         return self.cls()
+
+    def copy_value(self, value):
+        if value.__dict__.get("_frozen"):
+            return value  # frozen containers are immutable: share, don't copy
+        return type(value).copy_value_of(value)
 
 
 def F(container_cls) -> _ContainerField:

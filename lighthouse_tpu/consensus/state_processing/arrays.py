@@ -61,15 +61,49 @@ class ValidatorArrays:
         def back(x: np.int64) -> int:
             return FAR_FUTURE_EPOCH if x == FAR else int(x)
 
-        for i, v in enumerate(state.validators):
-            v.effective_balance = int(self.effective_balance[i])
-            v.slashed = bool(self.slashed[i])
-            v.activation_eligibility_epoch = back(
-                self.activation_eligibility_epoch[i]
+        # Compare-and-replace: re-extract the current columns (vectorized)
+        # and touch only validators whose values actually changed.  Frozen
+        # registry entries (cheap-node copy-on-write) are replaced via
+        # thawed()+freeze() so the registry stays all-frozen and shared
+        # roots/copies stay valid; mutable entries are updated in place as
+        # before.
+        cur = ValidatorArrays.extract(state)
+        changed = (
+            (cur.effective_balance != self.effective_balance)
+            | (cur.slashed != self.slashed)
+            | (
+                cur.activation_eligibility_epoch
+                != self.activation_eligibility_epoch
             )
-            v.activation_epoch = back(self.activation_epoch[i])
-            v.exit_epoch = back(self.exit_epoch[i])
-            v.withdrawable_epoch = back(self.withdrawable_epoch[i])
+            | (cur.activation_epoch != self.activation_epoch)
+            | (cur.exit_epoch != self.exit_epoch)
+            | (cur.withdrawable_epoch != self.withdrawable_epoch)
+        )
+        idxs = np.nonzero(changed)[0]
+        if len(idxs):
+            vs = list(state.validators)
+            replaced = False
+            for i in idxs:
+                i = int(i)
+                changes = {
+                    "effective_balance": int(self.effective_balance[i]),
+                    "slashed": bool(self.slashed[i]),
+                    "activation_eligibility_epoch": back(
+                        self.activation_eligibility_epoch[i]
+                    ),
+                    "activation_epoch": back(self.activation_epoch[i]),
+                    "exit_epoch": back(self.exit_epoch[i]),
+                    "withdrawable_epoch": back(self.withdrawable_epoch[i]),
+                }
+                v = vs[i]
+                if v.__dict__.get("_frozen"):
+                    vs[i] = v.thawed(**changes).freeze()
+                    replaced = True
+                else:
+                    for k, val in changes.items():
+                        setattr(v, k, val)
+            if replaced:
+                state.validators = vs
         state.balances = [int(b) for b in self.balances]
 
     # ----------------------------------------------------------------- views

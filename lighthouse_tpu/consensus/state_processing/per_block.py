@@ -176,6 +176,21 @@ def _current_epoch(state, preset) -> int:
     return state.slot // preset.slots_per_epoch
 
 
+def _update_validator(state, index: int, **changes) -> None:
+    """Apply field changes to a registry entry.  Frozen entries (cheap-node
+    copy-on-write registries) are replaced via thawed()+freeze() with the
+    list rebound, so shared frozen registries never mutate in place; mutable
+    entries are updated directly."""
+    v = state.validators[index]
+    if v.__dict__.get("_frozen"):
+        vs = list(state.validators)
+        vs[index] = v.thawed(**changes).freeze()
+        state.validators = vs
+    else:
+        for k, val in changes.items():
+            setattr(v, k, val)
+
+
 def slash_validator(
     state, slashed_index: int, spec: ChainSpec, whistleblower: int | None = None
 ) -> None:
@@ -184,10 +199,15 @@ def slash_validator(
     epoch = _current_epoch(state, preset)
     _initiate_validator_exit(state, slashed_index, spec)
     v = state.validators[slashed_index]
-    v.slashed = True
-    v.withdrawable_epoch = max(
-        v.withdrawable_epoch, epoch + preset.epochs_per_slashings_vector
+    _update_validator(
+        state,
+        slashed_index,
+        slashed=True,
+        withdrawable_epoch=max(
+            v.withdrawable_epoch, epoch + preset.epochs_per_slashings_vector
+        ),
     )
+    v = state.validators[slashed_index]
     s = list(state.slashings)
     s[epoch % preset.epochs_per_slashings_vector] += v.effective_balance
     state.slashings = s
@@ -235,8 +255,12 @@ def _initiate_validator_exit(state, index: int, spec: ChainSpec) -> None:
     churn = max(spec.min_per_epoch_churn_limit, active // spec.churn_limit_quotient)
     while sum(1 for e in exit_epochs if e == exit_epoch) >= churn:
         exit_epoch += 1
-    v.exit_epoch = exit_epoch
-    v.withdrawable_epoch = exit_epoch + spec.min_validator_withdrawability_delay
+    _update_validator(
+        state,
+        index,
+        exit_epoch=exit_epoch,
+        withdrawable_epoch=exit_epoch + spec.min_validator_withdrawability_delay,
+    )
 
 
 def process_proposer_slashing(state, ps, spec, verify_signatures, get_pubkey):
@@ -478,18 +502,20 @@ def apply_deposit(state, data, spec: ChainSpec) -> None:
         data.amount - data.amount % spec.effective_balance_increment,
         spec.max_effective_balance,
     )
-    state.validators = list(state.validators) + [
-        Validator(
-            pubkey=pk,
-            withdrawal_credentials=bytes(data.withdrawal_credentials),
-            effective_balance=eb,
-            slashed=False,
-            activation_eligibility_epoch=FAR_FUTURE_EPOCH,
-            activation_epoch=FAR_FUTURE_EPOCH,
-            exit_epoch=FAR_FUTURE_EPOCH,
-            withdrawable_epoch=FAR_FUTURE_EPOCH,
-        )
-    ]
+    new_v = Validator(
+        pubkey=pk,
+        withdrawal_credentials=bytes(data.withdrawal_credentials),
+        effective_balance=eb,
+        slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+    vs = list(state.validators)
+    if vs and vs[0].__dict__.get("_frozen"):
+        new_v.freeze()  # keep a frozen registry uniformly frozen
+    state.validators = vs + [new_v]
     state.balances = list(state.balances) + [data.amount]
     if hasattr(state, "previous_epoch_participation"):
         state.previous_epoch_participation = list(
@@ -715,8 +741,12 @@ def process_bls_to_execution_change(
     if verify_signatures:
         s = sets.bls_execution_change_signature_set(state, signed_change, spec)
         _err(s.verify(), "bls-to-execution-change signature invalid")
-    v.withdrawal_credentials = (
-        b"\x01" + bytes(11) + bytes(change.to_execution_address)
+    _update_validator(
+        state,
+        change.validator_index,
+        withdrawal_credentials=(
+            b"\x01" + bytes(11) + bytes(change.to_execution_address)
+        ),
     )
 
 

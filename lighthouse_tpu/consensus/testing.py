@@ -68,10 +68,17 @@ def interop_state(
     spec: ChainSpec,
     balance: int | None = None,
     fork: str = "base",
+    registry_padding: int = 0,
 ):
     """Genesis-like BeaconState (chosen fork variant) with n interop
     validators, plus the keypairs.  genesis_validators_root is computed per
-    spec (the root of the validator registry)."""
+    spec (the root of the validator registry).
+
+    ``registry_padding`` appends that many *inactive* synthetic validators
+    (never-activated, zero balance) after the interop set, and freezes the
+    whole registry for copy-on-write sharing — the cheap-node path that lets
+    scenarios run registry-scale states across dozens of in-process nodes.
+    """
     key = (
         n_validators, balance, fork, spec.preset, spec.config_name,
         spec.max_effective_balance, spec.min_genesis_time,
@@ -80,15 +87,38 @@ def interop_state(
         if fork != "base"
         and getattr(spec, f"{fork}_fork_epoch", None) is not None
         else None,
+        registry_padding,
     )
     cached = _INTEROP_STATE_CACHE.get(key)
     if cached is not None:
         return cached.copy(), interop_keypairs(n_validators)
-    state, keypairs = _build_interop_state(n_validators, spec, balance, fork)
+    state, keypairs = _build_interop_state(
+        n_validators, spec, balance, fork, registry_padding
+    )
     if len(_INTEROP_STATE_CACHE) >= _INTEROP_STATE_CACHE_MAX:
         _INTEROP_STATE_CACHE.pop(next(iter(_INTEROP_STATE_CACHE)))
     _INTEROP_STATE_CACHE[key] = state.copy()
     return state, keypairs
+
+
+def _padding_validators(count: int, offset: int) -> list:
+    """Inactive registry filler: unique synthetic pubkeys (no BLS key behind
+    them — they never sign), FAR epochs everywhere, zero effective balance.
+    Kept frozen so copies/roots share them."""
+    out = []
+    for i in range(count):
+        v = Validator(
+            pubkey=b"\xfa" + (offset + i).to_bytes(8, "little") + b"\x00" * 39,
+            withdrawal_credentials=b"\x00" * 32,
+            effective_balance=0,
+            slashed=False,
+            activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+            activation_epoch=FAR_FUTURE_EPOCH,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        out.append(v.freeze())
+    return out
 
 
 def _build_interop_state(
@@ -96,6 +126,7 @@ def _build_interop_state(
     spec: ChainSpec,
     balance: int | None = None,
     fork: str = "base",
+    registry_padding: int = 0,
 ):
     preset = spec.preset
     T = types_for(preset)
@@ -114,6 +145,11 @@ def _build_interop_state(
         )
         for _, pk in keypairs
     ]
+    if registry_padding:
+        for v in validators:
+            v.freeze()
+        validators += _padding_validators(registry_padding, n_validators)
+        Validator.bulk_roots(validators)
     state_cls = T.BeaconState_BY_FORK[fork]
     # A genesis state at a scheduled fork carries that fork's version (the
     # reference harness does the same when spawning e.g. a bellatrix-genesis
@@ -131,16 +167,17 @@ def _build_interop_state(
         ),
         latest_block_header=BeaconBlockHeader(),
         validators=validators,
-        balances=[balance] * n_validators,
+        balances=[balance] * n_validators + [0] * registry_padding,
         randao_mixes=[bytes(32)] * preset.epochs_per_historical_vector,
         finalized_checkpoint=Checkpoint(),
     )
     gvr = state_cls._fields["validators"].hash_tree_root(validators)
     state.genesis_validators_root = gvr
+    n_total = len(validators)
     if fork != "base":
-        state.previous_epoch_participation = [0] * n_validators
-        state.current_epoch_participation = [0] * n_validators
-        state.inactivity_scores = [0] * n_validators
+        state.previous_epoch_participation = [0] * n_total
+        state.current_epoch_participation = [0] * n_total
+        state.inactivity_scores = [0] * n_total
         from .state_processing.per_epoch import compute_sync_committee
 
         state.current_sync_committee = compute_sync_committee(state, 0, spec)

@@ -232,10 +232,182 @@ class PodDeviceDropTrack(Track):
         )
 
 
+class FinalityStallTrack(Track):
+    """A multi-epoch finality stall: over the slot window each committee
+    aggregate is suppressed before publication with probability ``p``
+    (drawn from the engine's seeded rng, so the stall is deterministic).
+    With p above ~1/3 the surviving participation can't justify, so
+    finality pins at its pre-window value — the regime the pool-growth
+    and shuffling-cache SLOs are judged under."""
+
+    name = "finality-stall"
+
+    def __init__(self, p="0.6", start="2", end="999"):
+        self.p = float(p)
+        self.start = int(start)
+        self.end = int(end)
+        self.suppressed = 0
+
+    def on_slot(self, engine, slot: int) -> None:
+        if slot == self.start:
+            rng, p = engine.rng, self.p
+
+            def keep(att) -> bool:
+                if rng.random() < p:
+                    self.suppressed += 1
+                    return False
+                return True
+
+            engine.att_filter = keep
+            engine.note("finality-stall", slot=slot, armed=True, p=p)
+        elif slot == self.end + 1:
+            engine.att_filter = None
+            engine.note("finality-stall", slot=slot, disarmed=True)
+
+    def finalize(self, engine) -> None:
+        engine.att_filter = None
+        engine.run_facts["attestations_suppressed"] = self.suppressed
+
+
+class HostileCheckpointTrack(Track):
+    """Checkpoint sync through a byzantine peer majority.
+
+    At slot ``at`` the best node's head (block + post-state) is captured
+    as a checkpoint anchor.  At run end a fresh node is built from that
+    anchor (``chain_from_anchor``) and forward-syncs over the real
+    SyncManager with an initial peer set that is ENTIRELY hostile:
+    ``hostile`` peers serving a structurally-valid byzantine fork (same
+    genesis, different graffiti ancestry — batches fail import with
+    unknown parents).  Scoring must grind them down (strike 1 greylists,
+    the last-resort re-pick bans) until the sync stalls; then discovery
+    lands ONE honest peer, the sync re-arms, and the node must reach the
+    honest head — the ``checkpoint_convergence`` /
+    ``hostile_peers_banned`` SLOs."""
+
+    name = "hostile-checkpoint"
+
+    def __init__(self, at="12", hostile="3"):
+        self.at = int(at)
+        self.hostile = int(hostile)
+        self._anchor = None
+
+    def on_slot(self, engine, slot: int) -> None:
+        if slot != self.at:
+            return
+        sim = engine.sim
+        for n in sim.nodes:
+            n.chain.recompute_head()
+        best = max(
+            sim.nodes,
+            key=lambda n: (int(n.chain.head_state().slot), n.chain.head_root),
+        )
+        cls = best.chain.types.SignedBeaconBlock_BY_FORK[engine.spec.fork]
+        blk = best.chain.store.get_block(best.chain.head_root, cls)
+        self._anchor = (best, best.chain.head_state().copy(), blk)
+        engine.note("hostile-checkpoint", slot=slot,
+                    anchor_slot=int(blk.message.slot))
+
+    def _build_fork(self, engine, head_slot: int):
+        """A full byzantine fork off the shared genesis: every block
+        carries fork graffiti, so roots diverge from slot 1 while every
+        block remains structurally valid."""
+        from ..beacon.chain import BeaconChain
+        from ..consensus.testing import interop_state
+        from ..utils import ManualSlotClock
+
+        spec = engine.sim.spec
+        genesis, keypairs = interop_state(
+            engine.spec.n_validators, spec, fork=engine.spec.fork,
+            registry_padding=engine.spec.registry_padding,
+        )
+        clock = ManualSlotClock(
+            genesis_time=float(genesis.genesis_time),
+            seconds_per_slot=spec.seconds_per_slot,
+        )
+        chain = BeaconChain(spec, genesis, store=None, slot_clock=clock,
+                            fork=engine.spec.fork)
+        for slot in range(1, head_slot + 1):
+            clock.set_slot(slot)
+            signed = chain.produce_block(slot, keypairs,
+                                         graffiti=b"byzantine-fork")
+            chain.process_block(signed, verify_signatures=False)
+        return chain
+
+    def finalize(self, engine) -> None:
+        if self._anchor is None:
+            return  # run shorter than `at`: nothing to sync
+        from ..beacon.checkpoint_sync import chain_from_anchor
+        from ..beacon.sync import (
+            SyncManager,
+            SyncPeer,
+            SyncState,
+            serve_blocks_by_range,
+        )
+        from ..network import rpc
+        from ..network.peer_manager import PeerManager
+
+        best, anchor_state, anchor_block = self._anchor
+        best.chain.recompute_head()
+        head_slot = int(best.chain.head_state().slot)
+        fork_chain = self._build_fork(engine, head_slot)
+        chain, _backfill = chain_from_anchor(
+            engine.sim.spec, anchor_state, anchor_block,
+            fork=engine.spec.fork,
+        )
+        honest_serve = serve_blocks_by_range(best.chain, engine.spec.fork)
+        byz_serve = serve_blocks_by_range(fork_chain, engine.spec.fork)
+
+        def honest(start_slot, count):
+            return [rpc.decode_response_chunk(c)
+                    for c in honest_serve(start_slot, count)]
+
+        def hostile(start_slot, count):
+            return [rpc.decode_response_chunk(c)
+                    for c in byz_serve(start_slot, count)]
+
+        pm = PeerManager()
+        mgr = SyncManager(chain, fork=engine.spec.fork, peer_manager=pm,
+                          batch_slots=engine.slots_per_epoch,
+                          request_timeout=0.5)
+        hostile_ids = [f"byz-fork-{i}" for i in range(self.hostile)]
+        for pid in hostile_ids:
+            mgr.add_peer(SyncPeer(peer_id=pid, head_slot=head_slot,
+                                  request_blocks=hostile))
+
+        def ticks(bound: int) -> None:
+            for _ in range(bound):
+                try:
+                    state = mgr.tick()
+                except Exception as exc:  # noqa: BLE001 — promises not to
+                    engine.run_facts["never_raise_violations"] += 1
+                    engine.note("never-raise-violation",
+                                where="hostile-checkpoint.tick",
+                                error=f"{type(exc).__name__}: {exc}")
+                    return
+                if state in (SyncState.SYNCED, SyncState.STALLED,
+                             SyncState.IDLE):
+                    return
+
+        # phase 1: only liars to sync from — scoring must stall this out
+        ticks(16)
+        # phase 2: discovery finds one honest peer; sync re-arms off it
+        mgr.add_peer(SyncPeer(peer_id="honest", head_slot=head_slot,
+                              request_blocks=honest))
+        ticks(16)
+        chain.recompute_head()
+        converged = chain.head_root == best.chain.head_root
+        banned = sum(1 for pid in hostile_ids if pm.is_banned(pid))
+        engine.run_facts["checkpoint_converged"] = converged
+        engine.run_facts["hostile_peers_banned"] = banned
+        engine.note("hostile-checkpoint-result", converged=converged,
+                    banned=banned, head_slot=head_slot)
+
+
 TRACKS = {
     cls.name: cls
     for cls in (GossipFaultTrack, DeviceFaultTrack, ByzantineSyncTrack,
-                KillRecoveryTrack, PodDeviceDropTrack)
+                KillRecoveryTrack, PodDeviceDropTrack, FinalityStallTrack,
+                HostileCheckpointTrack)
 }
 
 

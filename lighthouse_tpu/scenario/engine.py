@@ -72,6 +72,8 @@ class ScenarioEngine:
         self.sim = Simulator(
             n_nodes=spec.n_nodes, n_validators=spec.n_validators,
             fork=spec.fork, injector=self.injector, slasher=spec.slasher,
+            registry_padding=spec.registry_padding,
+            spec_overrides=spec.spec_overrides,
         )
         self.slots_per_epoch = self.sim.spec.preset.slots_per_epoch
         self.clock = ScenarioClock()
@@ -98,6 +100,7 @@ class ScenarioEngine:
         self.shapes = build_shapes(spec.traffic)
         self.tracks = build_tracks(spec.adversity)
         self.byzantine_sync = False  # ByzantineSyncTrack flips this
+        self.att_filter = None  # FinalityStallTrack sets (att -> bool)
         self.events: list[dict] = []
         self.run_facts: dict = {
             "processor_enqueues": 0,
@@ -214,7 +217,7 @@ class ScenarioEngine:
             self.note("proposal-failed", slot=slot,
                       error=f"{type(exc).__name__}: {exc}")
         try:
-            atts = sim.attest(slot)
+            atts = sim.attest(slot, keep=self.att_filter)
         except Exception as exc:
             atts = []
             self.note("attest-failed", slot=slot,
@@ -347,6 +350,19 @@ class ScenarioEngine:
         self.run_facts["heads"] = heads
         self.run_facts["finalized_epochs"] = fins
         self.run_facts.setdefault("breaker_closed", self.breaker.is_closed)
+        # pool/cache pressure facts for the hostile-regime gates: worst
+        # per-node pool sizes at run end, and the shared shuffling-cache
+        # population (one dict across all SimNodes)
+        nodes = self.sim.nodes
+        self.run_facts["op_pool_attestations"] = max(
+            n.chain.op_pool.num_attestations() for n in nodes
+        )
+        self.run_facts["naive_pool_groups"] = max(
+            len(n.chain.naive_pool._groups) for n in nodes
+        )
+        self.run_facts["committee_cache_entries"] = len(
+            nodes[0].chain._committee_caches
+        )
         trace_mark = getattr(self, "_trace_mark", 0)
         run_events = TRACER.chrome_trace(trace_mark)["traceEvents"]
         self.run_facts["overlap_efficiency"] = trace_report.overlap_efficiency(
@@ -392,6 +408,11 @@ class ScenarioEngine:
             "nodes": self.spec.n_nodes,
             "trace_dump": trace_dump,
             "slo": [r.to_dict() for r in results],
+            # advisory-gate summary: warn-level failures never flip the
+            # verdict, so surface them explicitly for report consumers
+            "slo_warnings": [
+                r.name for r in results if not r.ok and r.level == "warn"
+            ],
             "metrics": deltas,
             "facts": dict(self.run_facts),
             "fired_faults": fired,

@@ -1,0 +1,161 @@
+"""Delta-debug a violating ScenarioSpec down to a minimal reproducer.
+
+Given a spec whose run violates at least one fail-level SLO gate and a
+``reproduces`` oracle (does this candidate still violate the same way?),
+:func:`minimize` greedily strips the spec toward defaults: dropping
+traffic shapes, adversity tracks and their ``k=v`` knobs, shrinking
+epochs/nodes/validators, and clearing incidental toggles — re-running
+the oracle after every candidate and keeping only reductions that still
+reproduce.  The result is the smallest spec (under this reduction
+lattice) that still fails, plus the oracle-call count; ``render_spec``
+turns it into a ready-to-paste ``SCENARIOS`` registry entry.
+
+The loop is the classic ddmin shape specialised to the scenario
+dimensions: one-at-a-time removals with a restart whenever anything
+sticks (a removal can unlock another), bounded by ``max_steps`` oracle
+calls so a flaky oracle can't spin forever.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields, replace
+
+from .spec import ScenarioSpec
+
+
+@dataclass
+class MinimizeResult:
+    spec: ScenarioSpec        # the minimal reproducing spec
+    steps: int                # oracle invocations spent
+    removed: list             # human-readable reduction log
+
+
+def _strip_track_knob(track_spec: str, key: str) -> str:
+    """Drop one ``k=v`` knob from a ``"name:k=v,..."`` track spec."""
+    name, _, rest = track_spec.partition(":")
+    kvs = [kv for kv in rest.split(",") if kv
+           and kv.partition("=")[0].strip() != key]
+    return name if not kvs else f"{name}:{','.join(kvs)}"
+
+
+def _track_knobs(track_spec: str) -> list[str]:
+    _, _, rest = track_spec.partition(":")
+    return [kv.partition("=")[0].strip()
+            for kv in rest.split(",") if kv]
+
+
+def minimize(spec: ScenarioSpec, reproduces, max_steps: int = 64
+             ) -> MinimizeResult:
+    """Shrink ``spec`` while ``reproduces(candidate)`` stays true.
+
+    ``reproduces`` runs the candidate and answers whether the original
+    violation is still present (see ``search.violation_oracle``).  The
+    INITIAL spec is assumed to reproduce; it is never re-run.
+    """
+    steps = 0
+    removed: list[str] = []
+
+    def attempt(candidate: ScenarioSpec, what: str) -> bool:
+        nonlocal steps, spec
+        if steps >= max_steps:
+            return False
+        steps += 1
+        if reproduces(candidate):
+            spec = candidate
+            removed.append(what)
+            return True
+        return False
+
+    progress = True
+    while progress and steps < max_steps:
+        progress = False
+
+        for shape in list(spec.traffic):
+            cand = replace(spec, traffic=tuple(
+                s for s in spec.traffic if s != shape
+            ))
+            if attempt(cand, f"traffic -{shape}"):
+                progress = True
+
+        for track in list(spec.adversity):
+            cand = replace(spec, adversity=tuple(
+                t for t in spec.adversity if t != track
+            ))
+            if attempt(cand, f"adversity -{track.partition(':')[0]}"):
+                progress = True
+
+        # knob stripping: a knob whose removal (class default) still
+        # reproduces is noise in the regression scenario
+        for track in list(spec.adversity):
+            for key in _track_knobs(track):
+                slim = _strip_track_knob(track, key)
+                cand = replace(spec, adversity=tuple(
+                    slim if t == track else t for t in spec.adversity
+                ))
+                if attempt(cand, f"knob -{track.partition(':')[0]}.{key}"):
+                    progress = True
+                    break  # `track` string changed; restart its knobs
+
+        for epochs in sorted({1, spec.epochs // 2}):
+            if 0 < epochs < spec.epochs:
+                if attempt(replace(spec, epochs=epochs),
+                           f"epochs {epochs}"):
+                    progress = True
+                    break
+
+        for n in sorted({1, 2, spec.n_nodes // 2}):
+            if 0 < n < spec.n_nodes:
+                if attempt(replace(spec, n_nodes=n), f"n_nodes {n}"):
+                    progress = True
+                    break
+
+        # validator floor is 8: one minimal-preset committee's worth —
+        # below that the engine can't schedule a meaningful epoch
+        for n in sorted({8, spec.n_validators // 2}):
+            if 8 <= n < spec.n_validators:
+                if attempt(replace(spec, n_validators=n),
+                           f"n_validators {n}"):
+                    progress = True
+                    break
+
+        # incidental toggles back to their defaults
+        defaults = {f.name: f.default for f in fields(ScenarioSpec)
+                    if f.name in ("breaker_enabled", "slasher",
+                                  "registry_padding", "spec_overrides")}
+        for fname, dflt in defaults.items():
+            if getattr(spec, fname) != dflt:
+                if attempt(replace(spec, **{fname: dflt}),
+                           f"{fname} -> default"):
+                    progress = True
+
+        # per-key SLO overrides that aren't load-bearing
+        for key in list(spec.slo):
+            slim = {k: v for k, v in spec.slo.items() if k != key}
+            if attempt(replace(spec, slo=slim), f"slo -{key}"):
+                progress = True
+
+    return MinimizeResult(spec=spec, steps=steps, removed=removed)
+
+
+def render_spec(spec: ScenarioSpec, name: str | None = None) -> str:
+    """A ready-to-register ``SCENARIOS`` entry for a minimized spec —
+    literal constructor source (the registry lint AST-parses the dict, so
+    the emitted entry lints like any hand-written one).  Only fields that
+    differ from the dataclass defaults are rendered."""
+    name = name or spec.name
+    lines = [f'    "{name}": ScenarioSpec(', f'        name="{name}",',
+             f"        seed={spec.seed},"]
+    for f in fields(ScenarioSpec):
+        if f.name in ("name", "seed", "slo"):
+            continue  # always rendered / handled below
+        value = getattr(spec, f.name)
+        if value == f.default:
+            continue
+        lines.append(f"        {f.name}={value!r},")
+    if spec.slo:
+        lines.append("        slo={")
+        for k, v in spec.slo.items():
+            lines.append(f'            "{k}": {v!r},')
+        lines.append("        },")
+    lines.append("    ),")
+    return "\n".join(lines)

@@ -1,0 +1,342 @@
+"""Coverage-guided hostile-regime scenario search.
+
+A small mutation-based fuzzer over :class:`ScenarioSpec` space: start
+from registered corpus scenarios, mutate one dimension at a time (seed,
+traffic shapes, adversity tracks and their ``k=v`` knobs, node/validator
+counts, epochs, the breaker toggle), run each candidate through the
+deterministic :class:`ScenarioEngine`, and use the run's sha256
+fingerprint as the novelty/coverage signal — a candidate whose
+fingerprint was never seen exercised a genuinely new fault interleaving
+and earns a corpus slot.  SLO *proximity* (worst observed/threshold
+ratio across the numeric fail-level gates) is the fitness that biases
+parent selection toward near-violating regions.
+
+Any candidate that violates a fail-level SLO is handed to
+:mod:`minimize`, which delta-debugs it to a minimal reproducing spec and
+renders a ready-to-register ``SCENARIOS`` entry — the search output IS a
+regression scenario, not just a crash log.
+
+Everything is deterministic under ``SearchConfig.seed``: one
+``random.Random`` drives every mutation choice, candidate seeds are
+drawn from it, and each engine run is deterministic by the scenario
+contract — so a search that found a violation replays bit-identically.
+
+The ``MUTATION_SHAPES`` / ``MUTATION_TRACKS`` / ``KNOB_RANGES``
+constants below are the search's mutation surface; the registry lint
+cross-checks every name against the real ``SHAPES``/``TRACKS``
+registries (keep them literal — AST-parsed, never imported).
+``hostile-checkpoint`` is deliberately NOT in the mutation surface: its
+finalize builds a full byzantine fork chain, too heavy for budgeted
+search (run it via its registered scenario instead).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field, replace
+
+from .minimize import MinimizeResult, minimize, render_spec
+from .spec import SCENARIOS, ScenarioSpec
+
+# ---------------------------------------------------------------------------
+# The mutation surface.  Keep these literal: analysis/registry_lint.py
+# AST-parses them and cross-checks every name/knob against the live
+# traffic.SHAPES / adversity.TRACKS registries.
+# ---------------------------------------------------------------------------
+
+MUTATION_SHAPES = (
+    "attestation-flood",
+    "deposit-queue",
+    "proposer-reorg",
+    "equivocation",
+    "equivocation-storm",
+    "exit-flood",
+)
+
+MUTATION_TRACKS = (
+    "gossip-faults",
+    "device-faults",
+    "byzantine-sync",
+    "kill-recovery",
+    "pod-device-drop",
+    "finality-stall",
+)
+
+# knob -> (lo, hi) ranges drawn uniformly (ints when both ends are ints)
+KNOB_RANGES = {
+    "gossip-faults": {"p": (0.05, 0.45), "start": (2, 10), "end": (8, 28)},
+    "device-faults": {"delay": (0.0, 0.03), "start": (4, 14), "end": (8, 22)},
+    "kill-recovery": {"at": (8, 28)},
+    "pod-device-drop": {"p": (0.3, 0.9), "shards": (2, 6),
+                        "start": (4, 12), "end": (8, 18)},
+    "finality-stall": {"p": (0.35, 0.8), "start": (2, 8), "end": (16, 64)},
+}
+
+# hard caps so mutation can't wander into hour-long candidates
+MAX_NODES = 5
+MAX_VALIDATORS = 48
+MAX_EPOCHS = 4
+
+
+@dataclass
+class SearchConfig:
+    seed: int = 0
+    budget: int = 32                 # candidate engine runs
+    corpus: tuple = ("smoke",)       # starting scenario names (SCENARIOS)
+    minimize_steps: int = 24         # oracle budget per violation (0 = off)
+    corpus_cap: int = 12             # live corpus bound
+    # mutation-surface narrowing (None = the full module constants);
+    # lets a budgeted CI search focus on one fault family
+    shapes: tuple | None = None
+    tracks: tuple | None = None
+
+
+@dataclass
+class Violation:
+    spec: ScenarioSpec
+    failed: tuple                    # failing fail-level gate names
+    fingerprint: str
+    minimized: MinimizeResult | None = None
+    rendered: str = ""               # ready-to-register registry entry
+
+
+@dataclass
+class SearchResult:
+    candidates_run: int = 0
+    violations: list = field(default_factory=list)
+    novel_fingerprints: int = 0
+    minimization_steps: int = 0
+    corpus_names: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "candidates_run": self.candidates_run,
+            "violations_found": len(self.violations),
+            "novel_fingerprints": self.novel_fingerprints,
+            "minimization_steps": self.minimization_steps,
+            "violations": [
+                {
+                    "name": v.spec.name,
+                    "failed": list(v.failed),
+                    "fingerprint": v.fingerprint,
+                    "minimized_steps": (
+                        v.minimized.steps if v.minimized else 0
+                    ),
+                    "removed": (
+                        v.minimized.removed if v.minimized else []
+                    ),
+                    "rendered": v.rendered,
+                }
+                for v in self.violations
+            ],
+        }
+
+
+def failing_gates(report: dict) -> tuple:
+    """Names of the fail-level gates a report violates (warns excluded)."""
+    return tuple(
+        s["name"] for s in report.get("slo", ())
+        if not s["ok"] and s.get("level") != "warn"
+    )
+
+
+def slo_proximity(report: dict) -> float:
+    """Worst observed/threshold pressure across numeric fail-level gates
+    (1.0 = at the limit).  Drives parent selection toward near-violating
+    corpus entries."""
+    worst = 0.0
+    for s in report.get("slo", ()):
+        if s.get("level") == "warn":
+            continue
+        obs, thr = s.get("observed"), s.get("threshold")
+        if isinstance(obs, (int, float)) and isinstance(thr, (int, float)) \
+                and thr > 0:
+            worst = max(worst, float(obs) / float(thr))
+    return worst
+
+
+def default_runner(spec: ScenarioSpec) -> dict:
+    """Run one candidate through the real engine (no report/history I/O)."""
+    from .engine import ScenarioEngine
+
+    return ScenarioEngine(spec).run()
+
+
+def violation_oracle(runner, gates: tuple):
+    """The reproduces-callback minimize() consumes: a candidate
+    reproduces iff its run still fails at least one of the ORIGINAL
+    violation's gates (a different failure is a different bug — don't
+    let the minimizer drift onto it)."""
+    gate_set = set(gates)
+
+    def reproduces(spec: ScenarioSpec) -> bool:
+        report = runner(spec)
+        return bool(gate_set & set(failing_gates(report)))
+
+    return reproduces
+
+
+class ScenarioSearch:
+    """One budgeted search session.  ``runner`` is injectable for tests
+    (spec -> report dict); everything else is pure spec surgery."""
+
+    def __init__(self, config: SearchConfig, runner=None,
+                 scenarios: dict | None = None, log=None):
+        self.config = config
+        self.rng = random.Random(config.seed)
+        self.runner = runner or default_runner
+        self.log = log or (lambda msg: None)
+        self._shapes = (config.shapes if config.shapes is not None
+                        else MUTATION_SHAPES)
+        self._tracks = (config.tracks if config.tracks is not None
+                        else MUTATION_TRACKS)
+        registry = scenarios if scenarios is not None else SCENARIOS
+        self.corpus: list[ScenarioSpec] = []
+        for name in config.corpus:
+            if name not in registry:
+                raise ValueError(
+                    f"unknown corpus scenario {name!r}; "
+                    f"have {sorted(registry)}"
+                )
+            self.corpus.append(registry[name])
+        self._fitness: dict[str, float] = {}   # spec.name -> proximity
+        self.seen: set[str] = set()            # fingerprints covered
+        self.result = SearchResult()
+
+    # ------------------------------------------------------------ mutation
+
+    def _mutate_knob(self, track_spec: str) -> str:
+        name, _, rest = track_spec.partition(":")
+        ranges = KNOB_RANGES.get(name)
+        if not ranges:
+            return track_spec
+        kwargs = {}
+        if rest:
+            for kv in rest.split(","):
+                k, _, v = kv.partition("=")
+                kwargs[k.strip()] = v.strip()
+        key = self.rng.choice(sorted(ranges))
+        lo, hi = ranges[key]
+        if isinstance(lo, int) and isinstance(hi, int):
+            kwargs[key] = str(self.rng.randint(lo, hi))
+        else:
+            kwargs[key] = f"{self.rng.uniform(lo, hi):.3f}"
+        rendered = ",".join(f"{k}={v}" for k, v in kwargs.items())
+        return f"{name}:{rendered}"
+
+    def mutate(self, parent: ScenarioSpec, index: int) -> ScenarioSpec:
+        """One mutated child: a fresh seed plus ONE structural mutation
+        (single-dimension steps keep the minimizer's job small)."""
+        spec = replace(parent, seed=self.rng.randrange(1, 2 ** 20),
+                       name=f"{parent.name.partition('~')[0]}~m{index}")
+        # adversity exploration is double-weighted: the hostile regimes
+        # we hunt live in track space far more often than in scale space
+        op = self.rng.choice((
+            "reseed", "add_shape", "drop_shape",
+            "add_track", "add_track", "drop_track",
+            "mutate_knob", "mutate_knob",
+            "scale_nodes", "scale_validators",
+            "scale_epochs", "toggle_breaker",
+        ))
+        if op == "add_shape":
+            missing = [s for s in self._shapes if s not in spec.traffic]
+            if missing:
+                shape = self.rng.choice(missing)
+                spec = replace(spec, traffic=spec.traffic + (shape,))
+                if shape == "exit-flood":
+                    # exits need exit-eligible validators inside the run
+                    spec = replace(spec, spec_overrides=(
+                        ("shard_committee_period", 0),
+                    ))
+        elif op == "drop_shape" and spec.traffic:
+            victim = self.rng.choice(sorted(spec.traffic))
+            spec = replace(spec, traffic=tuple(
+                s for s in spec.traffic if s != victim
+            ))
+        elif op == "add_track":
+            have = {t.partition(":")[0] for t in spec.adversity}
+            missing = [t for t in self._tracks if t not in have]
+            if missing:
+                track = self.rng.choice(missing)
+                spec = replace(spec, adversity=spec.adversity + (
+                    self._mutate_knob(track),
+                ))
+        elif op == "drop_track" and spec.adversity:
+            victim = self.rng.choice(sorted(spec.adversity))
+            spec = replace(spec, adversity=tuple(
+                t for t in spec.adversity if t != victim
+            ))
+        elif op == "mutate_knob" and spec.adversity:
+            victim = self.rng.choice(sorted(spec.adversity))
+            spec = replace(spec, adversity=tuple(
+                self._mutate_knob(t) if t == victim else t
+                for t in spec.adversity
+            ))
+        elif op == "scale_nodes":
+            spec = replace(spec, n_nodes=min(
+                MAX_NODES, max(2, spec.n_nodes + self.rng.choice((-1, 1)))
+            ))
+        elif op == "scale_validators":
+            spec = replace(spec, n_validators=min(
+                MAX_VALIDATORS,
+                max(8, spec.n_validators + self.rng.choice((-8, 8))),
+            ))
+        elif op == "scale_epochs":
+            spec = replace(spec, epochs=min(
+                MAX_EPOCHS, max(1, spec.epochs + self.rng.choice((-1, 1)))
+            ))
+        elif op == "toggle_breaker":
+            spec = replace(spec, breaker_enabled=not spec.breaker_enabled)
+        return spec
+
+    # ---------------------------------------------------------- the loop
+
+    def _pick_parent(self) -> ScenarioSpec:
+        """Fitness-weighted pick: corpus entries closer to an SLO limit
+        breed more often (weight 1 + proximity)."""
+        weights = [1.0 + self._fitness.get(s.name, 0.0) for s in self.corpus]
+        return self.rng.choices(self.corpus, weights=weights, k=1)[0]
+
+    def run(self) -> SearchResult:
+        res = self.result
+        while res.candidates_run < self.config.budget:
+            parent = self._pick_parent()
+            cand = self.mutate(parent, res.candidates_run)
+            report = self.runner(cand)
+            res.candidates_run += 1
+            fp = report.get("fingerprint", "")
+            novel = fp not in self.seen
+            if novel:
+                self.seen.add(fp)
+                res.novel_fingerprints += 1
+            failed = failing_gates(report)
+            if failed:
+                self.log(f"violation after {res.candidates_run} candidates:"
+                         f" {cand.name} fails {list(failed)}")
+                self._handle_violation(cand, failed, fp)
+                continue  # violating specs don't join the corpus
+            if novel and len(self.corpus) < self.config.corpus_cap:
+                self.corpus.append(cand)
+            self._fitness[cand.name] = slo_proximity(report)
+        res.corpus_names = [s.name for s in self.corpus]
+        return res
+
+    def _handle_violation(self, spec: ScenarioSpec, failed: tuple,
+                          fp: str) -> None:
+        known = {v.failed for v in self.result.violations}
+        violation = Violation(spec=spec, failed=failed, fingerprint=fp)
+        if failed not in known and self.config.minimize_steps > 0:
+            oracle = violation_oracle(self.runner, failed)
+            violation.minimized = minimize(
+                spec, oracle, max_steps=self.config.minimize_steps
+            )
+            self.result.minimization_steps += violation.minimized.steps
+            minimal = violation.minimized.spec
+            reg_name = f"regress-{'-'.join(failed)}-{minimal.seed}"
+            violation.rendered = render_spec(minimal, name=reg_name)
+        self.result.violations.append(violation)
+
+
+def run_search(config: SearchConfig, runner=None, log=None) -> SearchResult:
+    """One budgeted search session (the tools/scenario_search.py core)."""
+    return ScenarioSearch(config, runner=runner, log=log).run()

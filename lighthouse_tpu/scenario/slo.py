@@ -181,4 +181,53 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
              t["min_slashings_detected"],
              "the equivocation shape must be caught by the slashers")
 
+    # ---- hostile-regime gates ------------------------------------------
+
+    if t.get("max_op_pool_attestations") is not None:
+        v = run.get("op_pool_attestations", 0)
+        gate("op_pool_growth", v <= t["max_op_pool_attestations"], int(v),
+             t["max_op_pool_attestations"],
+             "largest per-node op-pool attestation count at run end — "
+             "pruning must bound growth under non-finality")
+
+    if t.get("max_naive_pool_groups") is not None:
+        v = run.get("naive_pool_groups", 0)
+        gate("naive_pool_growth", v <= t["max_naive_pool_groups"], int(v),
+             t["max_naive_pool_groups"],
+             "largest per-node naive-aggregation group count at run end")
+
+    if t.get("max_committee_caches") is not None:
+        v = run.get("committee_cache_entries", 0)
+        gate("shuffling_cache_pressure", v <= t["max_committee_caches"],
+             int(v), t["max_committee_caches"],
+             "shared shuffling-cache entries — the bounded cache must "
+             "hold its budget across epochs of non-finality")
+
+    if t.get("max_finalized_advance") is not None:
+        fins = run.get("finalized_epochs", [0])
+        best = max(fins) if fins else 0
+        gate("finality_stalled", best <= t["max_finalized_advance"], best,
+             t["max_finalized_advance"],
+             "the stall track must actually prevent finality "
+             f"(per-node finalized epochs {fins})")
+
+    if t.get("min_exits_processed") is not None:
+        v = run.get("exits_processed", 0)
+        gate("exits_processed", v >= t["min_exits_processed"], int(v),
+             t["min_exits_processed"],
+             "the exit-flood must drain through op-pool packing and the "
+             "voluntary-exit transition")
+
+    if t.get("require_checkpoint_convergence"):
+        converged = run.get("checkpoint_converged", False)
+        gate("checkpoint_convergence", converged, converged, True,
+             "the checkpoint-synced node must reach the honest head "
+             "despite a hostile peer majority")
+
+    if t.get("min_hostile_peers_banned") is not None:
+        v = run.get("hostile_peers_banned", 0)
+        gate("hostile_peers_banned", v >= t["min_hostile_peers_banned"],
+             int(v), t["min_hostile_peers_banned"],
+             "peer scoring must ban byzantine checkpoint servers")
+
     return out

@@ -49,6 +49,16 @@ DEFAULT_SLO: dict = {
     # trace-derived overlap efficiency (warn-level; see slo.evaluate and
     # obs/report.py — wall / max(stage busy), 1.0 = perfect overlap)
     "max_overlap_wall_ratio": None,
+    # hostile-regime gates (None = not asserted) — pool growth and
+    # shuffling-cache pressure under non-finality, exit-flood drainage,
+    # and checkpoint-sync convergence through byzantine serving peers
+    "max_op_pool_attestations": None,   # largest per-node op-pool att count
+    "max_naive_pool_groups": None,      # largest per-node naive-pool groups
+    "max_committee_caches": None,       # shared shuffling-cache entries
+    "max_finalized_advance": None,      # finality must NOT advance past this
+    "min_exits_processed": None,        # exit-flood must drain on-chain
+    "require_checkpoint_convergence": False,  # ckpt-synced node reaches head
+    "min_hostile_peers_banned": None,   # scoring must ban byzantine servers
 }
 
 
@@ -65,6 +75,11 @@ class ScenarioSpec:
     traffic: tuple = ()    # shape names from traffic.SHAPES
     adversity: tuple = ()  # track specs "name[:k=v,...]" (adversity.TRACKS)
     slo: dict = field(default_factory=dict)  # overrides over DEFAULT_SLO
+    # cheap-node knobs: pad the registry with inactive synthetic validators
+    # (copy-on-write shared across nodes) and override ChainSpec fields
+    # (dataclasses.replace pairs, e.g. (("shard_committee_period", 0),))
+    registry_padding: int = 0
+    spec_overrides: tuple = ()
 
     def slo_thresholds(self) -> dict:
         merged = dict(DEFAULT_SLO)
@@ -183,17 +198,105 @@ SCENARIOS: dict[str, ScenarioSpec] = {
             "require_breaker_recovered": False,
         },
     ),
+    # Multi-epoch finality stall: the finality-stall track suppresses
+    # ~60% of committee aggregates (deterministically, off the engine
+    # rng) so justification never reaches 2/3, while the attestation
+    # flood keeps pool pressure on.  The SLOs assert the stall is REAL
+    # (finality pinned at genesis) and that pool pruning + the bounded
+    # shuffling cache hold their budgets across epochs of non-finality.
+    "long-non-finality": ScenarioSpec(
+        name="long-non-finality",
+        seed=29,
+        n_nodes=3,
+        n_validators=16,
+        epochs=4,
+        traffic=("attestation-flood",),
+        adversity=("finality-stall:p=0.6,start=2,end=999",),
+        slo={
+            "max_finalized_advance": 0,
+            "max_op_pool_attestations": 96,
+            "max_naive_pool_groups": 96,
+            "max_committee_caches": 16,
+            "require_crash_recovery": False,
+        },
+    ),
+    # Mass slashable misbehaviour + exit traffic through the real
+    # machinery: four proposers double-propose (equivocation storm) and a
+    # quarter of the registry floods voluntary exits into every op pool.
+    # shard_committee_period is overridden to 0 (a spec_overrides pair)
+    # so genesis-epoch validators are exit-eligible inside the run.  The
+    # slashers must catch the equivocations and the exits must drain
+    # through packing + the transition without stalling convergence.
+    "slashing-flood": ScenarioSpec(
+        name="slashing-flood",
+        seed=31,
+        n_nodes=3,
+        n_validators=32,
+        epochs=3,
+        traffic=("equivocation-storm", "exit-flood"),
+        spec_overrides=(("shard_committee_period", 0),),
+        slo={
+            "min_slashings_detected": 2,
+            "min_exits_processed": 6,
+            "require_crash_recovery": False,
+        },
+    ),
+    # Checkpoint sync where a majority of the SyncManager's peers serve a
+    # structurally-valid byzantine fork (same genesis, different
+    # ancestry): a node anchored mid-run at the honest head must score
+    # out and ban the hostile servers, forward-sync off the lone honest
+    # peer, and land on the honest head.
+    "hostile-checkpoint-sync": ScenarioSpec(
+        name="hostile-checkpoint-sync",
+        seed=37,
+        n_nodes=3,
+        n_validators=16,
+        epochs=3,
+        adversity=("hostile-checkpoint:at=12,hostile=3",),
+        slo={
+            "require_checkpoint_convergence": True,
+            "min_hostile_peers_banned": 2,
+            # the all-hostile phase MUST stall exactly once (that stall is
+            # the regime); a second one means the honest re-arm failed
+            "max_sync_stalls": 1,
+            "require_crash_recovery": False,
+        },
+    ),
+    # The cheap-node acceptance run: 12 in-process nodes over a 100k-entry
+    # validator registry (16 interop + 99,984 inactive padding, frozen and
+    # copy-on-write shared).  No adversity — this scenario exists to pin
+    # that registry-scale state stays inside the fast-tier budget.
+    "registry-pressure": ScenarioSpec(
+        name="registry-pressure",
+        seed=41,
+        n_nodes=12,
+        n_validators=16,
+        epochs=1,
+        registry_padding=99_984,
+        slo={
+            "require_crash_recovery": False,
+        },
+    ),
 }
+
+
+# Integer spec fields a CLI arg (and the scenario-search mutator) may
+# override; everything richer stays declarative in the registry.
+OVERRIDABLE_INT_FIELDS = ("seed", "n_nodes", "n_validators", "epochs")
 
 
 def parse_scenario_arg(arg: str) -> ScenarioSpec:
     """Resolve a CLI ``--scenario`` argument: ``name[:key=val,...]``.
 
-    Supported overrides: ``seed`` (int).  Examples::
+    Supported overrides: ``seed``, ``n_nodes``, ``n_validators``,
+    ``epochs`` (all ints).  Examples::
 
         --scenario smoke
         --scenario mainnet-shape:seed=99
+        --scenario long-non-finality:seed=3,epochs=6
     """
+    from dataclasses import replace
+
     name, _, rest = arg.partition(":")
     name = name.strip()
     if name not in SCENARIOS:
@@ -205,8 +308,8 @@ def parse_scenario_arg(arg: str) -> ScenarioSpec:
         for kv in rest.split(","):
             k, _, v = kv.partition("=")
             k = k.strip()
-            if k == "seed":
-                spec = spec.with_seed(int(v))
+            if k in OVERRIDABLE_INT_FIELDS:
+                spec = replace(spec, **{k: int(v)})
             else:
                 raise ValueError(
                     f"unknown scenario override {k!r} in {arg!r}"

@@ -162,9 +162,78 @@ class Equivocation(Shape):
         return a
 
 
+class EquivocationStorm(Shape):
+    """Mass equivocation: at every slot in ``slots`` the scheduled
+    proposer double-proposes (the :class:`Equivocation` offence, times
+    four, hitting distinct proposers).  Slashed proposers whose turn
+    comes around again fail their proposal — a liveness fact the
+    finalization SLO judges, not a harness abort."""
+
+    name = "equivocation-storm"
+    slots = (5, 9, 13, 17)
+
+    def __init__(self):
+        self.proposers: list[int] = []
+
+    def proposes(self, engine, slot: int) -> bool:
+        return slot in self.slots
+
+    def propose(self, engine, slot: int):
+        a, _b = engine.sim.propose_equivocation(slot)
+        proposer = int(a.message.proposer_index)
+        self.proposers.append(proposer)
+        engine.note("equivocation-storm", slot=slot, proposer=proposer)
+        return a
+
+    def finalize(self, engine) -> None:
+        engine.run_facts["equivocations_proposed"] = len(self.proposers)
+
+
+class ExitFlood(Shape):
+    """Mass voluntary-exit traffic: at install, signed exits for the last
+    ``n_exits`` interop validators land in every node's op pool (dummy
+    signatures — block import in the mesh runs unverified, as gossip
+    tests do).  Packing validity-filters them (op_pool._exitable), so a
+    spec with the default 256-epoch ``shard_committee_period`` drains
+    nothing — the slashing-flood scenario overrides it to 0.  The
+    ``exits_processed`` fact counts flooded validators whose
+    ``exit_epoch`` actually left FAR_FUTURE, i.e. exits that survived
+    packing AND the transition's validity ladder."""
+
+    name = "exit-flood"
+    n_exits = 8
+
+    def __init__(self):
+        self.indices: list[int] = []
+
+    def install(self, engine) -> None:
+        from ..consensus.containers import SignedVoluntaryExit, VoluntaryExit
+
+        n = engine.spec.n_validators
+        self.indices = list(range(max(0, n - self.n_exits), n))
+        for idx in self.indices:
+            signed = SignedVoluntaryExit(
+                message=VoluntaryExit(epoch=0, validator_index=idx),
+                signature=b"\x00" * 96,
+            )
+            for node in engine.sim.nodes:
+                node.chain.op_pool.insert_voluntary_exit(signed)
+        engine.note("exit-flood", queued=len(self.indices))
+
+    def finalize(self, engine) -> None:
+        from ..consensus.testing import FAR_FUTURE_EPOCH
+
+        state = engine.sim.nodes[0].chain.head_state()
+        engine.run_facts["exits_processed"] = sum(
+            1 for i in self.indices
+            if int(state.validators[i].exit_epoch) != FAR_FUTURE_EPOCH
+        )
+
+
 SHAPES = {
     cls.name: cls
-    for cls in (AttestationFlood, DepositQueue, ProposerReorg, Equivocation)
+    for cls in (AttestationFlood, DepositQueue, ProposerReorg, Equivocation,
+                EquivocationStorm, ExitFlood)
 }
 
 

@@ -1,0 +1,297 @@
+"""Scenario-search + minimizer suite.
+
+The fast tier exercises the minimizer against a synthetic oracle with a
+known minimal core (pure spec surgery, no engine runs), the search loop
+against a synthetic runner (determinism, novelty accounting, corpus
+hygiene), and one small real-engine hunt: a narrowed two-candidate
+search over a weakened-breaker twin of ``smoke`` that must find the
+planted ``device_retries`` violation.  The full-surface budgeted search
+(≤32 candidates, real engine, minimization, standalone reproduction of
+the minimized spec) is marked ``slow``.
+"""
+
+import ast
+from dataclasses import replace
+
+import pytest
+
+from lighthouse_tpu.scenario.minimize import (
+    _strip_track_knob,
+    _track_knobs,
+    minimize,
+    render_spec,
+)
+from lighthouse_tpu.scenario.search import (
+    KNOB_RANGES,
+    MUTATION_SHAPES,
+    MUTATION_TRACKS,
+    ScenarioSearch,
+    SearchConfig,
+    failing_gates,
+    slo_proximity,
+    violation_oracle,
+)
+from lighthouse_tpu.scenario.spec import SCENARIOS, ScenarioSpec
+
+pytestmark = pytest.mark.search
+
+
+# ---------------------------------------------------------------------------
+# The planted violation: a weakened-breaker twin of smoke.  With the
+# breaker disabled, a device-fault window sends verify retries far past
+# the default max_device_retries=16 budget — the regime search.py hunts.
+# ---------------------------------------------------------------------------
+
+WEAK_TWIN = replace(
+    SCENARIOS["smoke"], name="smoke-weak", breaker_enabled=False,
+    n_nodes=2, n_validators=8, epochs=2,
+    traffic=("attestation-flood",), adversity=(),
+)
+
+# Fixed seed for the slow full-surface hunt: drives a device-faults
+# mutation onto the twin inside the 16-candidate budget (seed-hunted
+# once; the whole run is deterministic under it).
+SLOW_SEARCH_SEED = 9
+
+
+def _synthetic_runner(spec):
+    """Violates ``device_retries`` iff a device-faults track is present —
+    the planted condition, minus the engine cost."""
+    hostile = any(t.startswith("device-faults") for t in spec.adversity)
+    return {
+        "fingerprint": f"fp-{spec.seed}-{hostile}-{spec.traffic}"
+                       f"-{spec.adversity}",
+        "slo": [
+            {"name": "device_retries", "ok": not hostile,
+             "observed": 40 if hostile else 3, "threshold": 16,
+             "level": "fail"},
+            {"name": "overlap_wall_ratio", "ok": False, "observed": 9.9,
+             "threshold": 1.5, "level": "warn"},
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Minimizer (pure, synthetic oracle)
+# ---------------------------------------------------------------------------
+
+
+class TestMinimizer:
+    def test_shrinks_to_exact_known_core(self):
+        """A bloated violating spec shrinks to exactly its minimal core:
+        the device-faults track (knobs stripped) with the weak breaker —
+        every other dimension is noise the oracle ignores."""
+        bloated = replace(
+            WEAK_TWIN,
+            n_nodes=4, n_validators=32, epochs=4,
+            traffic=("attestation-flood", "deposit-queue"),
+            adversity=("gossip-faults:p=0.2",
+                       "device-faults:delay=0.0,start=2,end=30",
+                       "kill-recovery:at=20"),
+            registry_padding=1000,
+            spec_overrides=(("shard_committee_period", 0),),
+            slo={"min_finalized_advance": 0,
+                 "require_crash_recovery": False},
+        )
+
+        def reproduces(spec):
+            return any(t.startswith("device-faults")
+                       for t in spec.adversity) \
+                and not spec.breaker_enabled
+
+        res = minimize(bloated, reproduces, max_steps=128)
+        expect = replace(
+            bloated, traffic=(), adversity=("device-faults",),
+            epochs=1, n_nodes=1, n_validators=8,
+            registry_padding=0, spec_overrides=(), slo={},
+        )
+        assert res.spec == expect
+        assert res.steps <= 128
+        # the reduction log names what was stripped
+        assert any("gossip-faults" in r for r in res.removed)
+        assert any(r.startswith("knob -device-faults") for r in res.removed)
+
+    def test_breaker_toggle_kept_when_load_bearing(self):
+        """breaker_enabled=False survives minimization when restoring the
+        default kills the repro (the weakened breaker IS the bug)."""
+        spec = replace(WEAK_TWIN, adversity=("device-faults",))
+
+        def reproduces(s):
+            return bool(s.adversity) and not s.breaker_enabled
+
+        res = minimize(spec, reproduces, max_steps=64)
+        assert res.spec.breaker_enabled is False
+        assert res.spec.adversity == ("device-faults",)
+
+    def test_max_steps_bounds_oracle_calls(self):
+        calls = []
+
+        def reproduces(s):
+            calls.append(s)
+            return True
+
+        minimize(WEAK_TWIN, reproduces, max_steps=5)
+        assert len(calls) == 5
+
+    def test_knob_helpers(self):
+        t = "device-faults:delay=0.0,start=2,end=30"
+        assert _track_knobs(t) == ["delay", "start", "end"]
+        assert _strip_track_knob(t, "start") == \
+            "device-faults:delay=0.0,end=30"
+        assert _strip_track_knob("device-faults:start=2", "start") == \
+            "device-faults"
+        assert _track_knobs("device-faults") == []
+
+    def test_render_spec_is_a_literal_registry_entry(self):
+        """render_spec output must eval back to an equal ScenarioSpec —
+        the ready-to-register contract (and it must AST-parse, which is
+        what the registry lint consumes)."""
+        minimal = replace(
+            WEAK_TWIN, name="x", adversity=("device-faults",),
+            epochs=1, slo={"require_crash_recovery": False},
+        )
+        rendered = render_spec(minimal, name="regress-device-retries")
+        ast.parse("{%s}" % rendered)  # literal, lintable
+        entry = eval("{%s}" % rendered, {"ScenarioSpec": ScenarioSpec})
+        got = entry["regress-device-retries"]
+        assert got == replace(minimal, name="regress-device-retries")
+        assert 'breaker_enabled=False' in rendered
+
+
+# ---------------------------------------------------------------------------
+# Search loop (synthetic runner: pure logic, no engine)
+# ---------------------------------------------------------------------------
+
+
+class TestSearchLoop:
+    def _search(self, seed=5, budget=32, **kw):
+        cfg = SearchConfig(seed=seed, budget=budget,
+                           corpus=("smoke-weak",),
+                           tracks=("device-faults", "gossip-faults"),
+                           shapes=(), minimize_steps=40, **kw)
+        return ScenarioSearch(cfg, runner=_synthetic_runner,
+                              scenarios={"smoke-weak": WEAK_TWIN})
+
+    def test_finds_planted_violation_and_minimizes(self):
+        res = self._search().run()
+        assert res.candidates_run == 32
+        hits = [v for v in res.violations if "device_retries" in v.failed]
+        assert hits
+        v = hits[0]
+        assert v.minimized is not None
+        m = v.minimized.spec
+        # minimal core: only the device-faults track survives
+        assert any(t.startswith("device-faults") for t in m.adversity)
+        assert m.traffic == ()
+        assert "device_retries" in v.rendered or "ScenarioSpec" in v.rendered
+        d = res.to_dict()
+        assert d["violations_found"] == len(res.violations)
+        assert d["candidates_run"] == 32
+        assert d["minimization_steps"] == res.minimization_steps > 0
+
+    def test_deterministic_under_fixed_seed(self):
+        r1 = self._search().run()
+        r2 = self._search().run()
+        key = lambda r: [(v.spec, v.failed, v.fingerprint,
+                          v.minimized.spec if v.minimized else None)
+                         for v in r.violations]
+        assert key(r1) == key(r2)
+        assert r1.novel_fingerprints == r2.novel_fingerprints
+        assert r1.corpus_names == r2.corpus_names
+
+    def test_warn_gates_never_count_as_violations(self):
+        """The synthetic runner always fails a warn-level gate; the
+        search must not treat it as a violation or minimize toward it."""
+        res = self._search(budget=8).run()
+        for v in res.violations:
+            assert "overlap_wall_ratio" not in v.failed
+
+    def test_violating_candidates_stay_out_of_corpus(self):
+        res = self._search().run()
+        violating = {v.spec.name for v in res.violations}
+        assert not (violating & set(res.corpus_names))
+
+    def test_constant_fingerprint_starves_novelty(self):
+        cfg = SearchConfig(seed=3, budget=8, corpus=("smoke-weak",),
+                           tracks=("gossip-faults",), shapes=(),
+                           minimize_steps=0)
+        runner = lambda spec: {"fingerprint": "same", "slo": []}
+        s = ScenarioSearch(cfg, runner=runner,
+                           scenarios={"smoke-weak": WEAK_TWIN})
+        res = s.run()
+        assert res.novel_fingerprints == 1
+        assert len(res.corpus_names) <= 2  # seed corpus + one novel child
+
+    def test_unknown_corpus_name_rejected(self):
+        with pytest.raises(ValueError, match="unknown corpus scenario"):
+            ScenarioSearch(SearchConfig(corpus=("no-such",)),
+                           runner=_synthetic_runner)
+
+    def test_report_helpers(self):
+        rep = _synthetic_runner(replace(WEAK_TWIN,
+                                        adversity=("device-faults",)))
+        assert failing_gates(rep) == ("device_retries",)
+        assert slo_proximity(rep) == pytest.approx(40 / 16)
+        oracle = violation_oracle(_synthetic_runner, ("device_retries",))
+        assert oracle(replace(WEAK_TWIN, adversity=("device-faults",)))
+        assert not oracle(WEAK_TWIN)
+
+    def test_mutation_surface_names_are_registered(self):
+        from lighthouse_tpu.scenario.adversity import TRACKS
+        from lighthouse_tpu.scenario.traffic import SHAPES
+
+        assert set(MUTATION_SHAPES) <= set(SHAPES)
+        assert set(MUTATION_TRACKS) <= set(TRACKS)
+        assert set(KNOB_RANGES) <= set(MUTATION_TRACKS)
+        for track, knobs in KNOB_RANGES.items():
+            cls = TRACKS[track]
+            params = cls.__init__.__code__.co_varnames[
+                1:cls.__init__.__code__.co_argcount
+            ]
+            assert set(knobs) <= set(params), (track, knobs, params)
+
+
+# ---------------------------------------------------------------------------
+# Real engine: the planted-violation hunt
+# ---------------------------------------------------------------------------
+
+
+def test_search_smoke_finds_planted_violation_real_engine():
+    """Two real candidates over the weakened-breaker twin, adversity
+    surface narrowed to device-faults: the first mutation plants the
+    violation and the search must surface it (seed picked so the hit
+    lands inside the two-candidate fast budget)."""
+    cfg = SearchConfig(seed=55, budget=2, minimize_steps=0,
+                       corpus=("smoke-weak",), tracks=("device-faults",),
+                       shapes=())
+    res = ScenarioSearch(cfg, scenarios={"smoke-weak": WEAK_TWIN}).run()
+    assert res.candidates_run == 2
+    hits = [v for v in res.violations if v.failed == ("device_retries",)]
+    assert hits, [v.failed for v in res.violations]
+    assert hits[0].spec.adversity == ("device-faults:start=8",)
+
+
+@pytest.mark.slow
+def test_budgeted_search_minimizes_and_reproduces_standalone():
+    """The acceptance run: full mutation surface, fixed seed, ≤32
+    candidates.  The search must find the planted device_retries
+    violation, delta-debug it, and the minimized spec must reproduce the
+    violation standalone (fresh engine, no search state)."""
+    from lighthouse_tpu.scenario.engine import ScenarioEngine
+
+    cfg = SearchConfig(seed=SLOW_SEARCH_SEED, budget=16, minimize_steps=12,
+                       corpus=("smoke-weak",))
+    res = ScenarioSearch(cfg, scenarios={"smoke-weak": WEAK_TWIN}).run()
+    assert res.candidates_run <= 32
+    hits = [v for v in res.violations if "device_retries" in v.failed]
+    assert hits, [v.failed for v in res.violations]
+    v = hits[0]
+    assert v.minimized is not None and v.rendered
+    ast.parse("{%s}" % v.rendered)  # ready-to-register literal
+    minimal = v.minimized.spec
+    assert minimal.breaker_enabled is False  # the weakness is load-bearing
+    assert any(t.startswith("device-faults") for t in minimal.adversity)
+    # standalone reproduction: a fresh engine run of the minimized spec
+    # still fails the same gate
+    report = ScenarioEngine(minimal).run()
+    assert "device_retries" in failing_gates(report)

@@ -16,6 +16,10 @@ Usage:
     tools/pyrun tools/scenario_run.py --scenario smoke
     tools/pyrun tools/scenario_run.py --scenario mainnet-shape --json /tmp/r.json
     tools/pyrun tools/scenario_run.py --scenario mainnet-shape:seed=99 --no-history
+    tools/pyrun tools/scenario_run.py --scenario slashing-flood --repeat 3
+    tools/pyrun tools/scenario_run.py --scenario long-non-finality --repeat 2
+    tools/pyrun tools/scenario_run.py --scenario hostile-checkpoint-sync
+    tools/pyrun tools/scenario_run.py --scenario registry-pressure
 """
 
 from __future__ import annotations
@@ -39,6 +43,10 @@ def main(argv=None) -> int:
                     help="list registered scenarios and exit")
     ap.add_argument("--json", metavar="PATH",
                     help="write the full JSON report to PATH")
+    ap.add_argument("--repeat", type=int, default=1, metavar="N",
+                    help="run the scenario N times and fail (exit 2) if "
+                         "the run fingerprints diverge — the determinism "
+                         "gate behind every regression scenario")
     ap.add_argument("--no-history", action="store_true",
                     help="do not append a scenario row to BENCH_HISTORY.jsonl")
     args = ap.parse_args(argv)
@@ -59,9 +67,19 @@ def main(argv=None) -> int:
     history = None if args.no_history else os.path.join(
         ROOT, "BENCH_HISTORY.jsonl"
     )
-    report = ScenarioEngine(
-        spec, out_path=args.json, history_path=history
-    ).run()
+    if args.repeat < 1:
+        ap.error("--repeat must be >= 1")
+    reports = []
+    for i in range(args.repeat):
+        # one history row and one JSON report per invocation (the last
+        # run), however many determinism repeats were asked for
+        last = i == args.repeat - 1
+        reports.append(ScenarioEngine(
+            spec,
+            out_path=args.json if last else None,
+            history_path=history if last else None,
+        ).run())
+    report = reports[-1]
 
     for s in report["slo"]:
         if s["ok"]:
@@ -80,7 +98,13 @@ def main(argv=None) -> int:
           f"seed={report['seed']} fingerprint={report['fingerprint']} "
           f"slots={report['slots']} faults={len(report['fired_faults'])} "
           f"elapsed={report['elapsed_s']}s")
-    return 0 if report["pass"] else 1
+    if args.repeat > 1:
+        fps = [r["fingerprint"] for r in reports]
+        if len(set(fps)) > 1:
+            print(f"FINGERPRINT DIVERGENCE over {args.repeat} runs: {fps}")
+            return 2
+        print(f"fingerprint stable over {args.repeat} runs: {fps[0]}")
+    return 0 if all(r["pass"] for r in reports) else 1
 
 
 if __name__ == "__main__":
