@@ -171,6 +171,9 @@ def run_measurement(force_cpu: bool) -> None:
         _record_mxu_history(result)
     if os.environ.get("BENCH_PIPELINE", "") == "1":
         result["pipeline"] = _measure_pipeline(B, device_h2c)
+    if os.environ.get("BENCH_SERVE", "") == "1":
+        result["serve"] = _measure_serve(device_h2c)
+        _record_serve_history(result)
     if os.environ.get("BENCH_EPOCH", "") == "1":
         result["epoch_system"] = _measure_epoch_system(device_h2c)
     # every jit.compile span recorded this run, with per-program
@@ -552,6 +555,181 @@ def _measure_pipeline(B: int, device_h2c: bool) -> dict:
             file=sys.stderr,
         )
     return out
+
+
+def _measure_serve(device_h2c: bool) -> dict:
+    """BENCH_SERVE=1: the verification front door's fill-or-flush knob.
+
+    A closed-loop multi-tenant load generator (three tenants, paced
+    submissions, admission opened wide so batching economics are what is
+    measured) drives a real :class:`VerifyService` at two or more
+    ``flush_margin`` operating points and reports per-point p50/p99
+    end-to-end latency against device efficiency.  The expected shape —
+    a *later* effective flush deadline (small margin) fills compiled
+    batches and buys device throughput; an *earlier* one (large margin)
+    flushes partial batches and buys p99 — lands as ``kind="serve"``
+    BENCH_HISTORY rows.
+
+    The device rung defaults to a calibrated cost model
+    (``BENCH_SERVE_CALL_MS`` fixed per-call overhead +
+    ``BENCH_SERVE_SET_US`` per set) so the sweep isolates front-door
+    batching from kernel throughput, which the kind="tpu" rows already
+    track; ``BENCH_SERVE_REAL=1`` swaps in the real
+    JaxBackend/ResilientVerifier ladder over real signature sets."""
+    from lighthouse_tpu.beacon.processor import BatchOutcome
+    from lighthouse_tpu.serve.admission import TenantPolicy
+    from lighthouse_tpu.serve.service import VerifyService
+
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "200"))
+    sets_per = int(os.environ.get("BENCH_SERVE_SETS", "4"))
+    gap = float(os.environ.get("BENCH_SERVE_GAP_MS", "2.0")) / 1000.0
+    call_ms = float(os.environ.get("BENCH_SERVE_CALL_MS", "3.0"))
+    set_us = float(os.environ.get("BENCH_SERVE_SET_US", "100.0"))
+    deadline_s = float(os.environ.get("BENCH_SERVE_DEADLINE_MS", "250")) / 1e3
+    margins = [
+        float(m) / 1000.0
+        for m in os.environ.get("BENCH_SERVE_MARGINS_MS", "5,230").split(",")
+    ]
+    real = os.environ.get("BENCH_SERVE_REAL", "") == "1"
+
+    if real:
+        from lighthouse_tpu.beacon.processor import ResilientVerifier
+        from lighthouse_tpu.crypto.bls.api import (
+            PythonBackend,
+            SecretKey,
+            SignatureSet,
+        )
+        from lighthouse_tpu.crypto.bls.jax_backend.backend import JaxBackend
+
+        pool = []
+        for i in range(32):
+            sk = SecretKey(700 + i)
+            msg = bytes([i % 256, 11]) * 16
+            pool.append(SignatureSet(sk.sign(msg), [sk.public_key()], msg))
+        payload = [pool[j % len(pool)] for j in range(sets_per)]
+        backend = JaxBackend(min_batch=8, device_h2c=device_h2c)
+        backend.verify_signature_sets(payload)  # compile, untimed
+
+        def make_verifier():
+            return ResilientVerifier(
+                device_verify=backend.verify_signature_sets,
+                cpu_verify=PythonBackend().verify_signature_sets,
+            )
+    else:
+        payload = [("bench-set", j) for j in range(sets_per)]
+
+        class _ModelVerifier:
+            """Calibrated device cost model: a fixed per-call overhead
+            (dispatch + pad + transfer) plus a per-set marginal cost —
+            the economics the batcher amortizes."""
+
+            def __init__(self):
+                self.calls = 0
+                self.busy_s = 0.0
+
+            def verify_batch(self, sets):
+                d = call_ms / 1e3 + set_us / 1e6 * len(sets)
+                time.sleep(d)
+                self.calls += 1
+                self.busy_s += d
+                return BatchOutcome(
+                    verdicts=[True] * len(sets), device_calls=1
+                )
+
+        def make_verifier():
+            return _ModelVerifier()
+
+    points = []
+    for margin in margins:
+        verifier = make_verifier()
+        svc = VerifyService(
+            verifier,
+            default_policy=TenantPolicy(
+                rate=1e9, burst=1e9, max_queue=10**9,
+            ),
+            compiled_sizes=(8, 32, 128),
+            flush_margin=margin,
+            default_deadline_s=deadline_s,
+        )
+        ids = []
+        t0 = time.monotonic()
+        for r in range(n_requests):
+            res = svc.submit(f"vc-{r % 3}", payload, deadline_s=deadline_s)
+            if res.accepted:
+                ids.append(res.request_id)
+            svc.tick()
+            if gap:
+                time.sleep(gap)
+        svc.flush()
+        wall = time.monotonic() - t0
+        lats, misses, done_sets = [], 0, 0
+        for rid in ids:
+            req = svc._requests.get(rid)
+            if req is None or req.done_at is None:
+                continue
+            lats.append(req.done_at - req.submitted_at)
+            done_sets += len(req.sets)
+            misses += bool(req.deadline_missed)
+        lats.sort()
+        flushes = svc.batcher.flushes_full + svc.batcher.flushes_deadline
+        point = {
+            "flush_margin_ms": round(margin * 1e3, 3),
+            "deadline_ms": round(deadline_s * 1e3, 3),
+            "requests_done": len(lats),
+            "p50_ms": round(lats[len(lats) // 2] * 1e3, 3) if lats else None,
+            "p99_ms": round(
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 3
+            ) if lats else None,
+            "sets_per_s": round(done_sets / wall, 1) if wall > 0 else None,
+            "flushes_full": svc.batcher.flushes_full,
+            "flushes_deadline": svc.batcher.flushes_deadline,
+            "mean_batch": round(done_sets / flushes, 1) if flushes else None,
+            "deadline_miss_rate": round(misses / len(lats), 4) if lats else None,
+        }
+        if not real:
+            point["device_busy_share"] = round(verifier.busy_s / wall, 3)
+            point["sets_per_device_s"] = (
+                round(done_sets / verifier.busy_s, 1)
+                if verifier.busy_s > 0 else None
+            )
+        points.append(point)
+        print(f"serve point: {point}", file=sys.stderr)
+    return {
+        "mode": "real" if real else "model",
+        "call_ms": call_ms,
+        "set_us": set_us,
+        "gap_ms": gap * 1e3,
+        "requests": n_requests,
+        "sets_per_request": sets_per,
+        "points": points,
+    }
+
+
+def _record_serve_history(result: dict) -> None:
+    """Append a kind="serve" row per operating point so the front-door
+    latency/throughput trade-off is tracked in BENCH_HISTORY alongside
+    the pipeline and marshal rows.  Recorded for CPU children too (the
+    cost-model sweep is host-independent batching economics); the device
+    and mode fields keep rows comparable only with their own kind."""
+    try:
+        s = result.get("serve")
+        if not s:
+            return
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(_history_path(), "a") as f:
+            for p in s.get("points", ()):
+                row = {
+                    "kind": "serve",
+                    "device": result.get("device"),
+                    "mode": s.get("mode"),
+                    "gap_ms": s.get("gap_ms"),
+                    "sets_per_request": s.get("sets_per_request"),
+                    "measured_at": stamp,
+                }
+                row.update(p)
+                f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
 
 
 def _history_path() -> str:

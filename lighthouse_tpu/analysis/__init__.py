@@ -51,6 +51,7 @@ DEFAULT_NEVER_RAISE = (
     "lighthouse_tpu/beacon/processor.py::BeaconProcessor.try_send",
     "lighthouse_tpu/ingest/engine.py::IngestEngine.marshal_sets",
     "lighthouse_tpu/parallel/pod.py::PodVerifier.verify_batch",
+    "lighthouse_tpu/serve/service.py::VerifyService.tick",
 )
 
 ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr", "range")

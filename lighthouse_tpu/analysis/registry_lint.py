@@ -27,6 +27,10 @@ Three cross-reference families, all driven off the canonical registries:
   ``SPANS`` registry (obs/tracer.py), and every registered span must
   actually be opened somewhere (no orphaned registrations) — the same
   both-direction cross-reference the fault-site family enforces.
+* **serve-port** — every ``--serve-port <port>`` example in the docs
+  must be a concrete valid TCP port (an integer in 0..65535; 0 is the
+  ephemeral-port convention the serve tests use), the same
+  doc-example validation ``--chaos`` and ``--scenario`` get.
 
 The docs cross-check covers ``*_total``, ``*_seconds`` and ``*_percent``
 metric tokens (counters, histograms and gauges).
@@ -47,6 +51,7 @@ _UPPER = re.compile(r"^[A-Z][A-Z0-9_]*$")
 _DOC_METRIC = re.compile(r"\b([a-z][a-z0-9_]*_(?:total|seconds|percent))\b")
 _DOC_SPEC = re.compile(r"--chaos[ =]+([^\s`'\")]+)")
 _DOC_SCENARIO = re.compile(r"--scenario[ =]+([^\s`'\")]+)")
+_DOC_SERVE_PORT = re.compile(r"--serve-port[ =]+([^\s`'\")]+)")
 
 
 # -- metrics -------------------------------------------------------------
@@ -558,6 +563,35 @@ def scenario_spec_violations(docs, known_names,
     return out
 
 
+# -- serve ports ---------------------------------------------------------
+
+
+def serve_port_violations(docs) -> list[Violation]:
+    """Every concrete ``--serve-port PORT`` doc example must be an
+    integer in 0..65535 — a copy-pasteable example, exactly the way
+    chaos and scenario examples are held to their real grammars."""
+    out = []
+    for display, text in docs:
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            for raw in _DOC_SERVE_PORT.findall(line):
+                if "<" in raw or "[" in raw:
+                    continue  # usage template, not a concrete example
+                try:
+                    port = int(raw)
+                except ValueError:
+                    port = -1
+                if not 0 <= port <= 65535:
+                    out.append(Violation(
+                        rule="serve-port", path=display, line=lineno,
+                        symbol=raw,
+                        message=(
+                            f"--serve-port example {raw!r} is not a valid "
+                            f"TCP port (integer in 0..65535)"
+                        ),
+                    ))
+    return out
+
+
 # -- scenario-search mutation surface ------------------------------------
 
 
@@ -756,4 +790,5 @@ def run(
             traffic_defs_path or "lighthouse_tpu/scenario/traffic.py",
             adversity_defs_path or "lighthouse_tpu/scenario/adversity.py",
         ))
+    out.extend(serve_port_violations(docs))
     return out

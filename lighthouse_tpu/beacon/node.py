@@ -143,61 +143,27 @@ class BeaconNode:
         # back to the pure-Python engine instead of dropping (or worse,
         # wrongly rejecting) the gossip message.  Signature INVALIDITY is
         # unaffected — both engines return the same verdicts.
-        from ..crypto.bls import api as _bls_api
-        from .processor import CircuitBreaker, ResilientVerifier
+        # The ingest -> resilient -> pod ladder comes from the one shared
+        # construction path (serve/stack.py) — the standalone
+        # VerifyService builds the identical stack, so node-embedded and
+        # service verification take byte-identical decisions.
+        # ``injector`` lets multi-node chaos tests arm faults on ONE node.
+        from ..serve.stack import build_verify_stack
 
-        self.breaker = CircuitBreaker()
-        # Vectorized ingest engine (lighthouse_tpu/ingest): when the active
-        # backend exposes the marshal/dispatch/resolve split, route the
-        # device rung's marshal through the cache-backed batch engine —
-        # byte-identical to backend.marshal_sets, degrading to it
-        # internally, so the ladder semantics are unchanged.  The pure-
-        # Python backend has no stage split and keeps the direct call.
-        self.ingest = None
-        _active = _bls_api.get_backend()
-        if hasattr(_active, "marshal_sets") and hasattr(_active, "dispatch"):
-            from ..ingest import IngestEngine
-
-            self.ingest = IngestEngine(
-                _active,
-                pubkey_cache=getattr(self.chain, "pubkey_cache", None),
-            )
-            device_verify = self._ingest_device_verify
-        else:
-            device_verify = (
-                lambda s: _bls_api.get_backend().verify_signature_sets(s)
-            )
-        self.verifier = ResilientVerifier(
-            device_verify=device_verify,
-            cpu_verify=lambda s: _bls_api.cpu_backend().verify_signature_sets(s),
-            breaker=self.breaker,
+        stack = build_verify_stack(
+            pubkey_cache=getattr(self.chain, "pubkey_cache", None),
+            injector=injector,
         )
+        self.breaker = stack.breaker
+        self.ingest = stack.ingest
+        self.verifier = stack.verifier
+        self.pod = stack.pod
+        self.injector = stack.injector
         # adversarial network boundary: the host's peer manager scores
         # req/resp misbehavior too (not only gossip), and the SyncManager
         # replaces the old single-peer trusting range-sync loop — validated
         # batches, bulk segment verification through the ResilientVerifier
         # ladder, peer rotation + penalties, STALLED instead of give-up.
-        # ``injector`` lets multi-node chaos tests arm faults on ONE node.
-        from ..utils import faults as faults_mod
-
-        self.injector = injector if injector is not None else faults_mod.INJECTOR
-        # pod-scale serving: with more than one device visible and a
-        # shardable backend, put the PodVerifier's per-shard fault domains
-        # in front of the single-device ladder.  Drop-in: it exposes the
-        # same verify_batch/breaker/journal surface, so SyncManager and
-        # the gossip handlers below are untouched.  maybe_build never
-        # raises and returns None on single-device hosts.
-        self.pod = None
-        if self.ingest is not None:
-            from ..parallel.pod import PodVerifier
-
-            self.pod = PodVerifier.maybe_build(
-                self.verifier, backend=_active,
-                marshal=self.ingest.marshal_sets,
-                injector=self.injector,
-            )
-            if self.pod is not None:
-                self.verifier = self.pod
         self.peer_manager = self.host.peer_manager
         from .sync import SyncManager
 
@@ -211,24 +177,6 @@ class BeaconNode:
         )
         self.slot_timer = None
         self._running = False
-
-    def _ingest_device_verify(self, sets) -> bool:
-        """Device rung of the resilience ladder, marshalled by the ingest
-        engine.  Fires the same ``bls.device_verify`` chaos site
-        ``verify_signature_sets`` does, so armed device faults still trip
-        the breaker and fall down the ladder."""
-        from ..crypto.bls import api as _bls_api
-        from ..utils import faults as _faults
-
-        be = _bls_api.get_backend()
-        if self.ingest is None or be is not self.ingest._backend:
-            # backend swapped since wiring: use it directly
-            return be.verify_signature_sets(sets)
-        _faults.fire("bls.device_verify")
-        mb = self.ingest.marshal_sets(sets)
-        if mb.invalid:
-            return False
-        return be.resolve(be.dispatch(mb))
 
     def _subscribe_topics(self, digest: bytes) -> None:
         """Subscribe every gossip topic family under ``digest`` and point

@@ -59,6 +59,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "recorder, loadable in Perfetto) on this port "
                          "(0 = ephemeral); separate from the beacon API "
                          "server, like the reference's http_metrics")
+    bn.add_argument("--serve-port", type=int, default=None,
+                    metavar="PORT",
+                    help="open the multi-tenant batch-verification "
+                         "service (Beacon-API-shaped JSON submit/poll, "
+                         "serve/http.py) on this port (0 = ephemeral); "
+                         "shares the node's verifier ladder, e.g. "
+                         "--serve-port 5053 next to the beacon API or "
+                         "--serve-port 0 in tests; standalone twin: "
+                         "tools/serve.py")
     bn.add_argument("--scenario", default=None,
                     metavar="NAME[:seed=N]",
                     help="run a named adversarial scenario (SLO-gated, "
@@ -256,6 +265,22 @@ def run_bn(args) -> int:
         log_with(log, logging.INFO, "Metrics endpoint up",
                  url=f"http://127.0.0.1:{metrics_server.port}/metrics",
                  endpoints="/metrics,/health,/trace")
+    serve_service = serve_server = None
+    if args.serve_port is not None:
+        from .serve import ServeApiServer, VerifyService
+
+        # the shared construction path (serve/stack.py) builds the same
+        # ingest/resilient/pod ladder the node wires, over this chain's
+        # pubkey cache — node-embedded serving, identical verdicts
+        serve_service = VerifyService.standalone(
+            pubkey_cache=getattr(h.chain, "pubkey_cache", None),
+        ).start()
+        serve_server = ServeApiServer(
+            serve_service, port=args.serve_port
+        ).start()
+        log_with(log, logging.INFO, "Verification service up",
+                 url=f"http://127.0.0.1:{serve_server.port}"
+                     "/eth/v1/verify/batch")
     discovery = None
     if args.discovery_port is not None:
         from .network.discv5 import Discv5Service
@@ -321,6 +346,10 @@ def run_bn(args) -> int:
             upnp.stop()  # delete the WAN mapping; stop the renewals
         if discovery is not None:
             discovery.stop()
+        if serve_server is not None:
+            serve_server.stop()
+        if serve_service is not None:
+            serve_service.stop()
         if metrics_server is not None:
             metrics_server.stop()
         server.stop()

@@ -66,6 +66,9 @@ SPANS: dict[str, str] = {
     # pod-scale verification service (parallel/pod.py)
     "pod.dispatch": "one pod round: per-shard device dispatch + gather",
     "pod.reshard": "mesh shrink onto surviving devices (instant event)",
+    # multi-tenant verification front door (serve/service.py)
+    "serve.submit": "one tenant submission: admission through enqueue",
+    "serve.dispatch": "one coalesced device batch: flush through verdicts",
 }
 
 

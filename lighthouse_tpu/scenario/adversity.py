@@ -403,11 +403,144 @@ class HostileCheckpointTrack(Track):
                     banned=banned, head_slot=head_slot)
 
 
+class TenantOverloadTrack(Track):
+    """Multi-tenant front-door overload: a standalone
+    :class:`~...serve.service.VerifyService` (a stub device rung under a
+    real ``ResilientVerifier``, sharing the engine's injector) serves two
+    tenants over the slot window — a greedy tenant submitting at
+    ``greedy_mult`` times its admitted rate and a deadline-sensitive
+    honest tenant inside its own — while a ``slow_p`` fraction of honest
+    submissions arrive from slow clients (the ``serve.submit``
+    slow-client arm fires for the fault fingerprint; the burned deadline
+    headroom is modeled by halving those submissions' budgets, since
+    scenario time is virtual).  Each slot is split into ``steps``
+    sub-slot micro-steps on a fractional-offset clock over the engine's
+    virtual clock, so the batcher's fill-or-flush policy runs at its
+    natural sub-second scale.  The isolation SLOs judge the finalize
+    facts: the honest tenant's deadline-miss rate stays bounded and none
+    of its ingress is shed while the greedy tenant's overage is."""
+
+    name = "tenant-overload"
+
+    def __init__(self, greedy_rate="64", greedy_mult="10",
+                 honest_rate="16", deadline="0.5", slow_p="0.2",
+                 steps="10", start="1", end="999"):
+        self.greedy_rate = float(greedy_rate)
+        self.greedy_mult = float(greedy_mult)
+        self.honest_rate = float(honest_rate)
+        self.deadline = float(deadline)
+        self.slow_p = float(slow_p)
+        self.steps = max(1, int(steps))
+        self.start = int(start)
+        self.end = int(end)
+        self.service = None
+        self.slow_submissions = 0
+        self._frac = 0.0
+
+    def _now_factory(self, engine):
+        def now() -> float:
+            return engine.clock.now() + self._frac
+        return now
+
+    def install(self, engine) -> None:
+        from ..beacon.processor import CircuitBreaker, ResilientVerifier
+        from ..serve.admission import TenantPolicy
+        from ..serve.service import VerifyService
+
+        now = self._now_factory(engine)
+        # A stub device rung: verdicts are not under test here (the serve
+        # tests pin those); admission/batching under overload is.  The
+        # real ladder would repay its crypto cost with nothing.
+        resilient = ResilientVerifier(
+            device_verify=lambda sets: True,
+            cpu_verify=lambda sets: True,
+            breaker=CircuitBreaker(now=now),
+            now=now,
+            injector=engine.injector,
+        )
+        self.service = VerifyService(
+            resilient,
+            policies={
+                "greedy": TenantPolicy(
+                    rate=self.greedy_rate, burst=self.greedy_rate,
+                    max_queue=4096, priority="p1",
+                ),
+                "honest": TenantPolicy(
+                    rate=self.honest_rate * 4.0,
+                    burst=self.honest_rate * 4.0, priority="p0",
+                ),
+            },
+            compiled_sizes=(8, 32),
+            # the flush margin must cover the pump period or deadline
+            # flushes land one tick late — here the pump is the sub-slot
+            # micro-step, so the margin is one step plus headroom
+            flush_margin=1.0 / self.steps + 0.02,
+            default_deadline_s=self.deadline,
+            injector=engine.injector,
+            now=now,
+        )
+        if self.slow_p > 0.0:
+            engine.injector.arm("serve.submit", "slow-client",
+                                probability=self.slow_p, delay=0.0)
+
+    def on_slot(self, engine, slot: int) -> None:
+        if self.service is None or not (self.start <= slot <= self.end):
+            return
+        svc = self.service
+        greedy_per = int(round(
+            self.greedy_rate * self.greedy_mult / self.steps
+        ))
+        honest_per = max(1, int(round(self.honest_rate / self.steps)))
+        for i in range(self.steps):
+            # never rewound: the engine advances its clock a full virtual
+            # second per slot, strictly more than the largest fraction
+            self._frac = i / self.steps
+            for j in range(greedy_per):
+                svc.submit("greedy", [("greedy", slot, i, j)],
+                           deadline_s=self.deadline)
+            for j in range(honest_per):
+                dl = self.deadline
+                if engine.rng.random() < self.slow_p:
+                    # the slow client burned half its deadline budget
+                    # dribbling the request in
+                    self.slow_submissions += 1
+                    dl *= 0.5
+                svc.submit("honest", [("honest", slot, i, j)],
+                           deadline_s=dl)
+            svc.tick()
+
+    def finalize(self, engine) -> None:
+        engine.injector.disarm("serve.submit")
+        if self.service is None:
+            return
+        svc = self.service
+        svc.flush()
+        adm = svc.admission
+        completed = svc.completed.get("honest", 0)
+        misses = svc.deadline_misses.get("honest", 0)
+        honest_shed = sum(adm.shed.get("honest", {}).values())
+        greedy_shed = sum(adm.shed.get("greedy", {}).values())
+        greedy_total = adm.accepted.get("greedy", 0) + greedy_shed
+        miss_rate = (misses / completed) if completed else 0.0
+        shed_rate = (greedy_shed / greedy_total) if greedy_total else 0.0
+        engine.run_facts["serve_honest_completed"] = completed
+        engine.run_facts["serve_honest_deadline_miss_rate"] = round(
+            miss_rate, 6
+        )
+        engine.run_facts["serve_honest_shed"] = honest_shed
+        engine.run_facts["serve_greedy_shed_rate"] = round(shed_rate, 6)
+        engine.run_facts["serve_slow_submissions"] = self.slow_submissions
+        engine.note("tenant-overload-result", honest_completed=completed,
+                    honest_miss_rate=round(miss_rate, 6),
+                    honest_shed=honest_shed, greedy_shed=greedy_shed,
+                    slow=self.slow_submissions)
+
+
 TRACKS = {
     cls.name: cls
     for cls in (GossipFaultTrack, DeviceFaultTrack, ByzantineSyncTrack,
                 KillRecoveryTrack, PodDeviceDropTrack, FinalityStallTrack,
-                HostileCheckpointTrack)
+                HostileCheckpointTrack, TenantOverloadTrack)
 }
 
 

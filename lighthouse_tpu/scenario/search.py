@@ -60,6 +60,7 @@ MUTATION_TRACKS = (
     "kill-recovery",
     "pod-device-drop",
     "finality-stall",
+    "tenant-overload",
 )
 
 # knob -> (lo, hi) ranges drawn uniformly (ints when both ends are ints)
@@ -70,6 +71,8 @@ KNOB_RANGES = {
     "pod-device-drop": {"p": (0.3, 0.9), "shards": (2, 6),
                         "start": (4, 12), "end": (8, 18)},
     "finality-stall": {"p": (0.35, 0.8), "start": (2, 8), "end": (16, 64)},
+    "tenant-overload": {"greedy_mult": (2, 20), "slow_p": (0.0, 0.9),
+                        "deadline": (0.2, 2.0), "steps": (4, 16)},
 }
 
 # hard caps so mutation can't wander into hour-long candidates

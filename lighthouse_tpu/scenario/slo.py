@@ -230,4 +230,29 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
              int(v), t["min_hostile_peers_banned"],
              "peer scoring must ban byzantine checkpoint servers")
 
+    # ---- verification-front-door tenancy gates (tenant-overload) -------
+
+    if t.get("max_honest_deadline_miss_rate") is not None:
+        v = run.get("serve_honest_deadline_miss_rate", 0.0)
+        gate("honest_deadline_misses",
+             v <= t["max_honest_deadline_miss_rate"], round(v, 4),
+             t["max_honest_deadline_miss_rate"],
+             "the deadline-sensitive tenant must keep its deadlines while "
+             f"a greedy tenant floods ({run.get('serve_honest_completed', 0)}"
+             " honest requests completed)")
+
+    if t.get("max_honest_shed") is not None:
+        v = run.get("serve_honest_shed", 0)
+        gate("honest_shed", v <= t["max_honest_shed"], int(v),
+             t["max_honest_shed"],
+             "admission must shed only the offender, never the honest "
+             "tenant's in-rate ingress")
+
+    if t.get("min_greedy_shed_rate") is not None:
+        v = run.get("serve_greedy_shed_rate", 0.0)
+        gate("greedy_shed", v >= t["min_greedy_shed_rate"], round(v, 4),
+             t["min_greedy_shed_rate"],
+             "the greedy tenant's overage must actually be shed — its "
+             "token bucket is the isolation boundary")
+
     return out

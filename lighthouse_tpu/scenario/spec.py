@@ -59,6 +59,12 @@ DEFAULT_SLO: dict = {
     "min_exits_processed": None,        # exit-flood must drain on-chain
     "require_checkpoint_convergence": False,  # ckpt-synced node reaches head
     "min_hostile_peers_banned": None,   # scoring must ban byzantine servers
+    # verification-front-door tenancy gates (None = not asserted): honest
+    # tenants keep their deadlines and none of their ingress is shed while
+    # admission sheds the greedy tenant's overage (tenant-overload track)
+    "max_honest_deadline_miss_rate": None,  # honest deadline misses / done
+    "max_honest_shed": None,            # honest submissions shed (any reason)
+    "min_greedy_shed_rate": None,       # greedy submissions shed / submitted
 }
 
 
@@ -259,6 +265,30 @@ SCENARIOS: dict[str, ScenarioSpec] = {
             # the all-hostile phase MUST stall exactly once (that stall is
             # the regime); a second one means the honest re-arm failed
             "max_sync_stalls": 1,
+            "require_crash_recovery": False,
+        },
+    ),
+    # The verification front door under tenant overload: a standalone
+    # VerifyService serves a greedy tenant submitting at 10x its admitted
+    # rate next to a deadline-sensitive honest tenant, with a fifth of
+    # honest submissions arriving through slow clients.  The isolation
+    # SLOs are the point: the honest tenant misses (almost) no deadlines
+    # and none of its ingress is shed, while admission sheds the bulk of
+    # the greedy tenant's overage — one tenant's flood must never become
+    # everyone's outage.
+    "tenant-overload": ScenarioSpec(
+        name="tenant-overload",
+        seed=43,
+        n_nodes=3,
+        n_validators=16,
+        epochs=2,
+        adversity=(
+            "tenant-overload:greedy_mult=10,slow_p=0.2,deadline=0.5",
+        ),
+        slo={
+            "max_honest_deadline_miss_rate": 0.02,
+            "max_honest_shed": 0,
+            "min_greedy_shed_rate": 0.5,
             "require_crash_recovery": False,
         },
     ),

@@ -1,0 +1,34 @@
+"""lighthouse_tpu.serve — the multi-tenant verification front door.
+
+Verification-as-a-service (ROADMAP item 3): many validator clients and
+light nodes submit signature-set batches over a Beacon-API-shaped HTTP
+edge; a deadline-aware batcher coalesces them into device batches; a
+per-tenant admission controller (token buckets, bounded queue depth,
+priority classes, degraded-mode shedding) keeps one greedy tenant from
+collapsing everyone else.  The verifier underneath is the same
+``IngestEngine`` -> ``ResilientVerifier`` -> ``PodVerifier`` ladder the
+node runs, built by the one shared construction path in
+:mod:`~lighthouse_tpu.serve.stack` — so node-embedded and standalone
+serving produce byte-identical verdicts.
+"""
+
+from .admission import AdmissionController, PRIORITY_CLASSES, TenantPolicy
+from .batcher import DeadlineAwareBatcher
+from .http import ServeApiServer, decode_sets, last_server
+from .service import ServeRequest, SubmitResult, VerifyService
+from .stack import VerifyStack, build_verify_stack
+
+__all__ = [
+    "AdmissionController",
+    "DeadlineAwareBatcher",
+    "PRIORITY_CLASSES",
+    "ServeApiServer",
+    "ServeRequest",
+    "SubmitResult",
+    "TenantPolicy",
+    "VerifyService",
+    "VerifyStack",
+    "build_verify_stack",
+    "decode_sets",
+    "last_server",
+]

@@ -1,0 +1,151 @@
+"""Per-tenant admission control for the verification front door.
+
+Three gates, in order, before a submission reaches the batcher:
+
+1. **degraded-mode shedding** — the same posture as PR 1's
+   ``DEGRADED_SHED_KINDS``: while the circuit breaker is open (device
+   down, everything on the CPU fallback) the service sheds ingress whose
+   work-queue kind is in that set.  Priority classes map onto the
+   existing work-queue kinds — ``"p0"`` -> ``WorkKind.API_REQUEST_P0``
+   (never shed: block-critical client work) and ``"p1"`` ->
+   ``WorkKind.API_REQUEST_P1`` (sheddable: replaceable per-validator
+   data) — so overload degrades exactly like the node's own queues
+   instead of collapsing.
+2. **per-tenant queue depth** — a tenant may not pool more than
+   ``max_queue`` signature sets in the batcher; a greedy tenant fills
+   its own bound, not the device.
+3. **token bucket** — sustained ``rate`` sets/s with ``burst``
+   headroom, refilled from the injectable clock so scenario runs are
+   deterministic.
+
+The controller never raises: every decision is an ``(admitted, reason)``
+pair, and shed reasons are the label values of ``serve_shed_total``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..beacon.processor import DEGRADED_SHED_KINDS, PRIORITY_ORDER, WorkKind
+
+#: priority class wire names -> work-queue kinds (PRIORITY_ORDER gives
+#: them their place in the dispatch ladder; DEGRADED_SHED_KINDS decides
+#: who is shed while the breaker is open)
+PRIORITY_CLASSES = {
+    "p0": WorkKind.API_REQUEST_P0,
+    "p1": WorkKind.API_REQUEST_P1,
+}
+
+assert all(k in PRIORITY_ORDER for k in PRIORITY_CLASSES.values())
+
+
+@dataclass
+class TenantPolicy:
+    """One tenant's admission contract."""
+
+    rate: float = 200.0        # sustained signature sets / second
+    burst: float = 400.0       # bucket capacity (sets)
+    max_queue: int = 1024      # sets the tenant may have pooled
+    priority: str = "p1"       # "p0" | "p1" (PRIORITY_CLASSES)
+
+    @property
+    def kind(self) -> WorkKind:
+        return PRIORITY_CLASSES[self.priority]
+
+
+@dataclass
+class _Bucket:
+    tokens: float
+    stamp: float
+    policy: TenantPolicy = field(default_factory=TenantPolicy)
+
+    def take(self, n: float, now: float) -> bool:
+        self.tokens = min(
+            self.policy.burst,
+            self.tokens + (now - self.stamp) * self.policy.rate,
+        )
+        self.stamp = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+class AdmissionController:
+    """Thread-safe per-tenant gatekeeper in front of the batcher."""
+
+    def __init__(self, policies: dict[str, TenantPolicy] | None = None,
+                 default_policy: TenantPolicy | None = None,
+                 breaker=None, now=time.monotonic):
+        self.policies = dict(policies or {})
+        self.default_policy = default_policy or TenantPolicy()
+        self.breaker = breaker
+        self._now = now
+        self._lock = threading.Lock()
+        self._buckets: dict[str, _Bucket] = {}
+        #: sets currently pooled per tenant; the service decrements on
+        #: dispatch via release()
+        self.queued: dict[str, int] = {}
+        self.accepted: dict[str, int] = {}
+        self.shed: dict[str, dict[str, int]] = {}
+
+    def policy_for(self, tenant: str) -> TenantPolicy:
+        return self.policies.get(tenant, self.default_policy)
+
+    @property
+    def degraded(self) -> bool:
+        """Device down: shed the sheddable (mirrors
+        ``BeaconProcessor.degraded``)."""
+        return self.breaker is not None and not self.breaker.is_closed
+
+    def admit(self, tenant: str, n_sets: int) -> tuple[bool, str]:
+        """Decide one submission of ``n_sets`` sets: ``(True, "ok")`` or
+        ``(False, reason)`` with reason in rate-limit / queue-full /
+        degraded."""
+        pol = self.policy_for(tenant)
+        now = self._now()
+        with self._lock:
+            if self.degraded and pol.kind in DEGRADED_SHED_KINDS:
+                return self._shed(tenant, "degraded")
+            if self.queued.get(tenant, 0) + n_sets > pol.max_queue:
+                return self._shed(tenant, "queue-full")
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = self._buckets[tenant] = _Bucket(
+                    tokens=pol.burst, stamp=now, policy=pol,
+                )
+            if not b.take(float(n_sets), now):
+                return self._shed(tenant, "rate-limit")
+            self.queued[tenant] = self.queued.get(tenant, 0) + n_sets
+            self.accepted[tenant] = self.accepted.get(tenant, 0) + 1
+        return True, "ok"
+
+    def _shed(self, tenant: str, reason: str) -> tuple[bool, str]:
+        per = self.shed.setdefault(tenant, {})
+        per[reason] = per.get(reason, 0) + 1
+        return False, reason
+
+    def release(self, tenant: str, n_sets: int) -> None:
+        """Return ``n_sets`` of pooled depth after their batch left."""
+        with self._lock:
+            left = self.queued.get(tenant, 0) - n_sets
+            self.queued[tenant] = max(0, left)
+
+    def stats(self) -> dict:
+        """Per-tenant accept/shed/queued snapshot (the HTTP stats
+        endpoint's body)."""
+        with self._lock:
+            tenants = (
+                set(self.accepted) | set(self.shed) | set(self.queued)
+            )
+            return {
+                t: {
+                    "accepted": self.accepted.get(t, 0),
+                    "shed": dict(self.shed.get(t, {})),
+                    "queued_sets": self.queued.get(t, 0),
+                    "priority": self.policy_for(t).priority,
+                }
+                for t in sorted(tenants)
+            }

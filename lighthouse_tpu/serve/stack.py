@@ -1,0 +1,122 @@
+"""The one verifier-stack construction path.
+
+``BeaconNode`` and the standalone :class:`~.service.VerifyService` used
+to wire the ``IngestEngine`` -> ``ResilientVerifier`` -> ``PodVerifier``
+ladder independently; this module is the single factory both consume, so
+a signature batch takes byte-identical decisions whichever front end
+submitted it.  The ladder, bottom up:
+
+* the active BLS backend (``crypto/bls/api.get_backend()``) — the device
+  rung; when it exposes the marshal/dispatch/resolve split, the
+  vectorized :class:`~lighthouse_tpu.ingest.IngestEngine` marshals for it
+  (byte-identical to the scalar marshal, degrading to it internally);
+* :class:`~lighthouse_tpu.beacon.processor.ResilientVerifier` — the
+  breaker-guarded device/CPU degradation ladder;
+* :class:`~lighthouse_tpu.parallel.pod.PodVerifier` — per-shard fault
+  domains across the device mesh when more than one device is visible
+  (``maybe_build`` returns None on single-device hosts).
+
+The returned :class:`VerifyStack` exposes the outermost ``verifier``
+(the object whose ``verify_batch`` callers use) plus every rung, so a
+caller that needs the breaker or the ingest engine directly (the node's
+sync manager, the service's epoch hook) reaches the same instances.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class VerifyStack:
+    """The assembled ladder: ``verifier`` is the outermost verify_batch
+    surface (the pod when one was built, else the resilient rung)."""
+
+    breaker: object
+    verifier: object
+    resilient: object
+    ingest: object | None
+    pod: object | None
+    injector: object
+
+
+def _make_ingest_device_verify(ingest):
+    """Device rung of the resilience ladder, marshalled by the ingest
+    engine.  Fires the same ``bls.device_verify`` chaos site
+    ``verify_signature_sets`` does, so armed device faults still trip
+    the breaker and fall down the ladder."""
+    def device_verify(sets) -> bool:
+        from ..crypto.bls import api as _bls_api
+        from ..utils import faults as _faults
+
+        be = _bls_api.get_backend()
+        if be is not ingest._backend:
+            # backend swapped since wiring: use it directly
+            return be.verify_signature_sets(sets)
+        _faults.fire("bls.device_verify")
+        mb = ingest.marshal_sets(sets)
+        if mb.invalid:
+            return False
+        return be.resolve(be.dispatch(mb))
+
+    return device_verify
+
+
+def build_verify_stack(pubkey_cache=None, injector=None,
+                       breaker=None) -> VerifyStack:
+    """Assemble the full verification ladder against the active backend.
+
+    Parameters
+    ----------
+    pubkey_cache:
+        Optional beacon ``ValidatorPubkeyCache`` handed to the ingest
+        engine's limb cache (the node passes its chain's; a standalone
+        service usually has none).
+    injector:
+        Fault injector for the pod's per-shard sites; defaults to the
+        process-global one, exactly as the node wired it.
+    breaker:
+        Pre-built ``CircuitBreaker`` (scenario engines pin its clock);
+        defaults to a fresh real-time one.
+    """
+    from ..beacon.processor import CircuitBreaker, ResilientVerifier
+    from ..crypto.bls import api as _bls_api
+    from ..utils import faults as faults_mod
+
+    if breaker is None:
+        breaker = CircuitBreaker()
+    if injector is None:
+        injector = faults_mod.INJECTOR
+    ingest = None
+    _active = _bls_api.get_backend()
+    if hasattr(_active, "marshal_sets") and hasattr(_active, "dispatch"):
+        from ..ingest import IngestEngine
+
+        ingest = IngestEngine(_active, pubkey_cache=pubkey_cache)
+        device_verify = _make_ingest_device_verify(ingest)
+    else:
+        # the pure-Python backend has no stage split: direct call
+        device_verify = (
+            lambda s: _bls_api.get_backend().verify_signature_sets(s)
+        )
+    resilient = ResilientVerifier(
+        device_verify=device_verify,
+        cpu_verify=lambda s: _bls_api.cpu_backend().verify_signature_sets(s),
+        breaker=breaker,
+    )
+    verifier = resilient
+    pod = None
+    if ingest is not None:
+        from ..parallel.pod import PodVerifier
+
+        pod = PodVerifier.maybe_build(
+            resilient, backend=_active,
+            marshal=ingest.marshal_sets,
+            injector=injector,
+        )
+        if pod is not None:
+            verifier = pod
+    return VerifyStack(
+        breaker=breaker, verifier=verifier, resilient=resilient,
+        ingest=ingest, pod=pod, injector=injector,
+    )

@@ -26,6 +26,10 @@ the stack:
                             — shard loss, hung devices mid-batch
   ``pod.gather``            PodVerifier's per-shard verdict gather (L3)
                             — corrupted shard results on the way back
+  ``serve.submit``          VerifyService ingress, one tenant submission
+                            (L8) — slow or garbage-sending clients
+  ``serve.dispatch``        VerifyService device-batch dispatch (L8) —
+                            infrastructure failure under a full batch
 
 A site that nothing armed costs one dict lookup (an unarmed ``fire`` is a
 no-op), so production paths keep the hooks compiled in — the same sites
@@ -73,6 +77,18 @@ back — parallel/pod.py):
                            rescues the batch
 * ``corrupt-shard-result`` invert (or ``mutate``) the gathered shard
                            verdict — a device returning garbage
+
+Serve front-door kinds (armed at the tenancy sites ``serve.submit``, the
+ingress of one tenant submission, and ``serve.dispatch``, around one
+device-batch dispatch — serve/service.py):
+
+* ``slow-client:<secs>``   sleep ``delay`` seconds, then pass — a client
+                           dribbling its submission in; the request burns
+                           deadline headroom before it is even admitted
+* ``malformed-request``    apply ``mutate`` to the submission payload
+                           (default: strip its ``sets`` field) — a client
+                           sending garbage; validation must shed the
+                           request, never crash the service
 
 Arming is bounded: ``times=N`` auto-disarms after N firings (the breaker
 recovery tests ride this), ``probability`` makes soak tests stochastic.
@@ -134,7 +150,7 @@ class NetworkFault(FaultError):
 _KINDS = ("error", "slow", "corrupt", "overflow", "crash", "io-error",
           "torn-write", "drop", "stall", "corrupt-chunk", "wrong-blocks",
           "extra-blocks", "shard-drop", "device-hang",
-          "corrupt-shard-result")
+          "corrupt-shard-result", "slow-client", "malformed-request")
 
 # Canonical site registry.  Every literal site string fired anywhere in
 # the package must appear here (the static audit's fault-sites family
@@ -153,6 +169,8 @@ SITES = {
     "ingest.marshal": "IngestEngine vectorized marshal entry (ingest/engine.py)",
     "pod.dispatch": "PodVerifier per-shard device place+run (parallel/pod.py)",
     "pod.gather": "PodVerifier per-shard verdict gather (parallel/pod.py)",
+    "serve.submit": "VerifyService tenant submission ingress (serve/service.py)",
+    "serve.dispatch": "VerifyService device-batch dispatch (serve/service.py)",
 }
 
 SITE_PREFIXES = (
@@ -190,6 +208,17 @@ _NETWORK_MUTATORS = {
     "wrong-blocks": lambda chunks: list(reversed(list(chunks))),
     "extra-blocks": lambda chunks: list(chunks) + list(chunks)[-1:],
 }
+
+
+def _malform_submission(payload):
+    """Default ``malformed-request`` mutator: strip the ``sets`` field
+    from a submission-shaped dict (a client POSTing garbage); any other
+    payload shape is replaced with ``None`` outright."""
+    if isinstance(payload, dict):
+        bad = dict(payload)
+        bad.pop("sets", None)
+        return bad
+    return None
 
 
 @dataclass
@@ -298,6 +327,8 @@ class FaultInjector:
             pod.dispatch=shard-dropx1
             pod.dispatch=device-hang:2.0
             pod.gather=corrupt-shard-result
+            serve.submit=slow-client:0.2
+            serve.submit=malformed-requestx1
         """
         site, _, rest = spec.partition("=")
         if not site or not rest:
@@ -313,7 +344,8 @@ class FaultInjector:
         kind = kind.strip()
         delay = (
             float(arg)
-            if (arg and kind in ("slow", "stall", "device-hang"))
+            if (arg and kind in ("slow", "stall", "device-hang",
+                                 "slow-client"))
             else 0.0
         )
         fraction = float(arg) if (arg and kind == "torn-write") else 0.5
@@ -354,11 +386,14 @@ class FaultInjector:
         f = self._take(site)
         if f is None:
             return payload
-        if f.kind in ("slow", "stall", "device-hang"):
+        if f.kind in ("slow", "stall", "device-hang", "slow-client"):
             time.sleep(f.delay)
             return payload
         if f.kind == "corrupt":
             return f.mutate(payload) if f.mutate is not None else payload
+        if f.kind == "malformed-request":
+            fn = f.mutate or _malform_submission
+            return fn(payload)
         if f.kind == "corrupt-shard-result":
             # default mutator inverts a boolean shard verdict
             fn = f.mutate or (lambda ok: not ok)

@@ -439,6 +439,55 @@ DISPATCH_CALLS = Gauge(
     ("config",),
 )
 
+# ---------------------------------------------------------------------------
+# Verification service (lighthouse_tpu/serve/): the multi-tenant front door.
+# Per-tenant SLO surface — admission decisions, deadline outcomes, and the
+# two latencies a tenant experiences (queue wait before a batch flushes,
+# end-to-end submit-to-verdict).  Tenant label cardinality is bounded by the
+# admission controller's policy table, not by the wire.
+# ---------------------------------------------------------------------------
+
+SERVE_ACCEPTED = Counter(
+    "serve_accepted_total",
+    "Submissions admitted into the batcher, by tenant",
+    ("tenant",),
+)
+SERVE_SHED = Counter(
+    "serve_shed_total",
+    "Submissions refused at admission, by tenant and reason "
+    "(rate-limit / queue-full / degraded / malformed)",
+    ("tenant", "reason"),
+)
+SERVE_DEADLINE_MISS = Counter(
+    "serve_deadline_miss_total",
+    "Accepted submissions whose verdicts landed after their deadline, "
+    "by tenant",
+    ("tenant",),
+)
+SERVE_FLUSHES = Counter(
+    "serve_flushes_total",
+    "Device-batch flushes out of the deadline-aware batcher, by trigger "
+    "(full = batch reached the largest compiled size, deadline = the "
+    "oldest request's deadline neared)",
+    ("trigger",),
+)
+SERVE_ERRORS = Counter(
+    "serve_errors_total",
+    "VerifyService dispatch failures absorbed by the never-raise tick "
+    "(affected requests fail closed)",
+)
+SERVE_QUEUE_WAIT = Histogram(
+    "serve_queue_wait_seconds",
+    "Wait between admission and batch dispatch, by tenant — the price of "
+    "the fill/flush knob",
+    label_names=("tenant",),
+)
+SERVE_E2E_LATENCY = Histogram(
+    "serve_e2e_latency_seconds",
+    "End-to-end submit-to-verdict latency, by tenant",
+    label_names=("tenant",),
+)
+
 
 def render() -> str:
     """Prometheus text exposition of every registered metric."""

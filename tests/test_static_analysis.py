@@ -160,6 +160,22 @@ def test_scenario_spec_fires_on_unknown_name_only(corpus_result):
     assert not any("<name>" in s for s in symbols)
 
 
+def test_serve_port_fires_on_non_int_and_out_of_range(corpus_result):
+    vios = _by_rule(corpus_result)["serve-port"]
+    symbols = {v.symbol for v in vios}
+    assert symbols == {"banana", "70000"}  # 5053 and 0 pass
+    # the `--serve-port <port>` usage template is skipped
+    assert not any("<port>" in s for s in symbols)
+
+
+def test_live_serve_port_docs_are_valid(live_result):
+    # every concrete --serve-port example in README/docs must be a real
+    # TCP port, same doc-example contract as --chaos / --scenario
+    assert not [
+        v for v in live_result.violations if v.rule == "serve-port"
+    ]
+
+
 def test_span_registry_fires_on_ghost_and_orphan(corpus_result):
     symbols = {v.symbol for v in _by_rule(corpus_result)["span-registry"]}
     assert "fixture.span.ghost" in symbols   # opened but unregistered
