@@ -79,6 +79,9 @@ class AuditConfig:
     search_defs: str = "lighthouse_tpu/scenario/search.py"
     traffic_defs: str = "lighthouse_tpu/scenario/traffic.py"
     adversity_defs: str = "lighthouse_tpu/scenario/adversity.py"
+    # sharded-program rule table: PARTITION_RULES must stay total over
+    # OPERAND_LEAVES and free of dead/shadowed rules
+    partition_defs: str = "lighthouse_tpu/parallel/partition.py"
     docs: tuple = ("README.md", "STATUS.md")
     hot_path: dict = field(
         default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
@@ -219,6 +222,8 @@ def load_config(path: str) -> AuditConfig:
         cfg.traffic_defs = a["traffic_defs"]
     if "adversity_defs" in a:
         cfg.adversity_defs = a["adversity_defs"]
+    if "partition_defs" in a:
+        cfg.partition_defs = a["partition_defs"]
     if "docs" in a:
         cfg.docs = tuple(a["docs"])
     if "site_scan_exclude" in a:
@@ -319,6 +324,7 @@ def run_audit(
             search_defs_path=cfg.search_defs,
             traffic_defs_path=cfg.traffic_defs,
             adversity_defs_path=cfg.adversity_defs,
+            partition_defs_path=cfg.partition_defs,
         ))
         fam_t["registry"] = time.perf_counter() - t
 

@@ -31,6 +31,15 @@ Three cross-reference families, all driven off the canonical registries:
   must be a concrete valid TCP port (an integer in 0..65535; 0 is the
   ephemeral-port convention the serve tests use), the same
   doc-example validation ``--chaos`` and ``--scenario`` get.
+* **partition-rules** — the rule table that drives the sharded verify
+  program (``parallel/partition.py``) is proven total and live: every
+  ``PARTITION_RULES`` regex must compile, name a registered
+  ``SPEC_TOKENS`` spec, and match at least one ``OPERAND_LEAVES`` name
+  not already claimed by an earlier rule (first match wins, so a
+  fully-shadowed rule is dead code); every operand leaf must be
+  matched by some rule (an orphan leaf would raise at program build).
+  All three constants are AST-parsed, never imported, so they must
+  stay literals.
 
 The docs cross-check covers ``*_total``, ``*_seconds`` and ``*_percent``
 metric tokens (counters, histograms and gauges).
@@ -752,13 +761,121 @@ def search_surface_violations(
     return out
 
 
+def partition_defs(src: str, path: str):
+    """AST-parse the literal partition constants from
+    ``parallel/partition.py``: ``PARTITION_RULES`` (tuple of
+    ``(regex, token)`` pairs, with lines), ``OPERAND_LEAVES`` (tuple of
+    leaf names, with lines) and the ``SPEC_TOKENS`` key set.  Pure AST
+    — the rule table must stay a literal for the audit to bind."""
+    tree = ast.parse(src, filename=path)
+    rules: list[tuple[str, str, int]] = []
+    leaves: dict[str, int] = {}
+    tokens: dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        v = node.value
+        if "PARTITION_RULES" in names and isinstance(v, (ast.Tuple, ast.List)):
+            for e in v.elts:
+                if (isinstance(e, (ast.Tuple, ast.List))
+                        and len(e.elts) == 2
+                        and all(isinstance(x, ast.Constant)
+                                and isinstance(x.value, str)
+                                for x in e.elts)):
+                    rules.append(
+                        (e.elts[0].value, e.elts[1].value, e.lineno)
+                    )
+        elif "OPERAND_LEAVES" in names and isinstance(v, (ast.Tuple, ast.List)):
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    leaves[e.value] = e.lineno
+        elif "SPEC_TOKENS" in names and isinstance(v, ast.Dict):
+            for k in v.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    tokens[k.value] = k.lineno
+    return rules, leaves, tokens
+
+
+def partition_rule_violations(files, partition_defs_path) -> list[Violation]:
+    """The rule table must be total over the operand leaves (no orphan
+    leaf — ``operand_partition_specs`` would raise at program build) and
+    free of dead weight (every rule compiles, names a registered spec
+    token, and is the FIRST match for at least one leaf — first match
+    wins, so a fully-shadowed rule can never fire)."""
+    files = dict(files)
+    out: list[Violation] = []
+    src = files.get(partition_defs_path)
+    if src is None:
+        return out  # corpus without the sharded program: skip the family
+    rules, leaves, tokens = partition_defs(src, partition_defs_path)
+    if not (rules and leaves and tokens):
+        return [Violation(
+            rule="partition-rules", path=partition_defs_path, line=0,
+            symbol="PARTITION_RULES",
+            message="partition constants missing or non-literal "
+                    "(PARTITION_RULES / OPERAND_LEAVES / SPEC_TOKENS)",
+        )]
+    compiled: list = []
+    for pattern, token, line in rules:
+        try:
+            rx = re.compile(pattern)
+        except re.error as exc:
+            out.append(Violation(
+                rule="partition-rules", path=partition_defs_path,
+                line=line, symbol=pattern,
+                message=f"rule regex does not compile: {exc}",
+            ))
+            rx = None
+        if token not in tokens:
+            out.append(Violation(
+                rule="partition-rules", path=partition_defs_path,
+                line=line, symbol=pattern,
+                message=(
+                    f"rule names unregistered spec token {token!r} "
+                    f"(SPEC_TOKENS: {', '.join(sorted(tokens))})"
+                ),
+            ))
+        compiled.append((pattern, rx, line))
+    claimed: dict[str, str] = {}   # leaf -> winning rule pattern
+    first_hits: dict[str, int] = {p: 0 for p, _rx, _l in compiled}
+    for leaf in leaves:
+        for pattern, rx, _line in compiled:
+            if rx is not None and rx.search(leaf):
+                claimed[leaf] = pattern
+                first_hits[pattern] += 1
+                break
+    for leaf, line in sorted(leaves.items()):
+        if leaf not in claimed:
+            out.append(Violation(
+                rule="partition-rules", path=partition_defs_path,
+                line=line, symbol=leaf,
+                message=(
+                    f"operand leaf {leaf!r} matches no partition rule "
+                    f"(program build would raise)"
+                ),
+            ))
+    for pattern, rx, line in compiled:
+        if rx is None or first_hits.get(pattern):
+            continue
+        matches_any = any(rx.search(leaf) for leaf in leaves)
+        shape = ("shadowed by an earlier rule for every leaf it matches"
+                 if matches_any else "matches no operand leaf")
+        out.append(Violation(
+            rule="partition-rules", path=partition_defs_path,
+            line=line, symbol=pattern,
+            message=f"dead rule: {shape}",
+        ))
+    return out
+
+
 def run(
     files, docs, metrics_defs_path, faults_defs_path,
     site_scan_exclude=("tests/",), spec_validator=None,
     scenarios_defs_path=None, spans_defs_path=None,
     scenario_arg_validator=None,
     search_defs_path=None, traffic_defs_path=None,
-    adversity_defs_path=None,
+    adversity_defs_path=None, partition_defs_path=None,
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
@@ -790,5 +907,7 @@ def run(
             traffic_defs_path or "lighthouse_tpu/scenario/traffic.py",
             adversity_defs_path or "lighthouse_tpu/scenario/adversity.py",
         ))
+    if partition_defs_path is not None:
+        out.extend(partition_rule_violations(files, partition_defs_path))
     out.extend(serve_port_violations(docs))
     return out

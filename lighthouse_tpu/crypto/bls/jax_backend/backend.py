@@ -22,6 +22,7 @@ import json
 import secrets
 import time
 from dataclasses import dataclass, field
+from typing import Any
 
 import numpy as np
 
@@ -537,6 +538,20 @@ class JaxBackend:
             args = (pk_aff, sig_aff, h_aff, wbits)
         return MarshalledBatch(n, B, self.device_h2c, args)
 
+    def local_verify_fn(self):
+        """The raw (unjitted) batch kernel for SPMD wrapping: the
+        rule-driven sharded program (parallel/partition.py) runs this
+        per device on its batch shard under shard_map, instead of
+        slicing arrays around the jitted single-device program."""
+        return _verify_kernel_h2c if self.device_h2c else _verify_kernel
+
+    @staticmethod
+    def registry_pk_wrap(x, y):
+        """Wrap psum-gathered canonical Montgomery limb planes as the
+        kernel's pubkey operand — the partition layer's seam so it
+        never imports the field stack (bound 1.0 = encode_mont's)."""
+        return (F.LFp(x, 1.0), F.LFp(y, 1.0))
+
     def dispatch(self, mb: MarshalledBatch):
         """Device stage, NON-BLOCKING: enqueue transfers and the kernel,
         return the in-flight result.  jax dispatch is async — device_put
@@ -581,6 +596,11 @@ class MarshalledBatch:
     device_h2c: bool
     args: tuple = field(default=())
     invalid: bool = False
+    # registry mode (ingest marshal_for_mesh): the (B,) validator-slot
+    # vector when the pubkey operand is DEFERRED to the sharded
+    # program's partitioned-registry gather — args then exclude pk, and
+    # only the mesh path (parallel/partition.py) may consume the batch.
+    slots: Any = None
 
 
 def register() -> "JaxBackend":

@@ -18,30 +18,23 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as PS
 
-from ....parallel.mesh import allgather_tree, and_reduce, batch_spec, ring_reduce
+from ....parallel.mesh import (
+    allgather_tree,
+    and_reduce,
+    batch_spec,
+    compat_shard_map,
+    ring_reduce,
+)
 from . import fp as F
 from . import pairing as PR
 from . import points as P
 from . import tower as T
 from .backend import _neg_gen_const, _tree_reduce_g2
 
-
-def _shard_map(f, mesh, in_specs, out_specs):
-    """shard_map across jax versions: top-level ``jax.shard_map`` with
-    ``check_vma`` where available, else ``jax.experimental.shard_map``
-    with its older ``check_rep`` spelling.  Both flags are the same
-    check disabled for the same reason (the scan-carry vma note in
-    make_verify_sharded)."""
-    try:
-        from jax import shard_map as sm
-
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_vma=False)
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as sm
-
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
+# The version shim lives in parallel/mesh.py now so the rule-driven
+# sharded program (parallel/partition.py) and these kernels share one
+# guard; the old private name stays importable for external callers.
+_shard_map = compat_shard_map
 
 
 def _trailing_extent(tree) -> int:

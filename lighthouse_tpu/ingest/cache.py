@@ -69,6 +69,9 @@ class PubkeyLimbCache:
         self._epoch: int | None = None
         # lazily-built device mirror of the registry columns
         self._dev = None
+        # mesh-sharded mirrors keyed by Mesh (validator axis split
+        # across devices — the partition-rule table's "registry" spec)
+        self._dev_sharded: dict = {}
 
     # -- registry tier -----------------------------------------------------
 
@@ -90,6 +93,7 @@ class PubkeyLimbCache:
                 self._slot_by_id[id(pk)] = start + off
             self._reg_keys.extend(new)
             self._dev = None  # mirror is stale
+            self._dev_sharded.clear()
             M.INGEST_CACHE_KEYS.set(len(self._reg_keys) + len(self._lru))
             return end - start
 
@@ -108,6 +112,35 @@ class PubkeyLimbCache:
                 self._dev = (jnp.asarray(self._reg_x),
                              jnp.asarray(self._reg_y))
             return self._dev
+
+    def registry_device_sharded(self, mesh, axis: str = "batch"):
+        """The mesh-PARTITIONED device mirror: (jnp_x, jnp_y), each
+        (N, n_padded) sharded on the validator axis — every device
+        holds only n/width columns instead of a full replica (104 MB
+        apiece at mainnet's ~1M keys).  The validator axis pads to a
+        width multiple with zero columns (slots never reference them).
+        Gathers ride the sharded program's masked take + psum
+        (parallel/partition.py), not this host process.  Cached per
+        mesh; invalidated by registry growth."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        with self._lock:
+            cached = self._dev_sharded.get(mesh)
+            if cached is None:
+                width = int(mesh.devices.size)
+                n = self._reg_x.shape[1]
+                pad = (-n) % width
+                rx, ry = self._reg_x, self._reg_y
+                if pad:
+                    z = np.zeros((rx.shape[0], pad), dtype=rx.dtype)
+                    rx = np.hstack([rx, z])
+                    ry = np.hstack([ry, z])
+                sharding = NamedSharding(mesh, PS(None, axis))
+                cached = (jax.device_put(rx, sharding),
+                          jax.device_put(ry, sharding))
+                self._dev_sharded[mesh] = cached
+            return cached
 
     def gather_device(self, slots):
         """On-device gather of registry columns by validator slot:

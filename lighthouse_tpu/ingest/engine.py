@@ -132,9 +132,29 @@ class IngestEngine:
         return MarshalledBatch(len(sets), 0, self._backend.device_h2c,
                                invalid=True)
 
+    def marshal_for_mesh(self, sets, weights=None) -> MarshalledBatch:
+        """Marshal for the rule-driven sharded program: when every set
+        resolves to a single-signer registry slot, the pubkey operand is
+        DEFERRED — the batch carries the (B,) slot vector
+        (``mb.slots``) and the sharded program gathers the columns from
+        the mesh-partitioned registry mirror on device, so the pubkey
+        operand never exists on host and never rides H2D.  Any other
+        shape (LRU hits, cold sets, registry misses) degrades to the
+        ordinary ``marshal_sets``.  Never raises (same ladder)."""
+        try:
+            _faults.fire("ingest.marshal")
+            return self._marshal_vectorized(sets, weights,
+                                            defer_registry=True)
+        except Exception:
+            M.INGEST_FALLBACKS.inc()
+            log.warning("ingest: mesh marshal failed; degrading to the "
+                        "standard path", exc_info=True)
+        return self.marshal_sets(sets, weights)
+
     # -- vectorized pipeline ----------------------------------------------
 
-    def _marshal_vectorized(self, sets, weights=None) -> MarshalledBatch:
+    def _marshal_vectorized(self, sets, weights=None,
+                            defer_registry: bool = False) -> MarshalledBatch:
         backend = self._backend
         if not sets:
             return MarshalledBatch(0, 0, backend.device_h2c, invalid=True)
@@ -155,10 +175,17 @@ class IngestEngine:
             reps = B - n
 
             with TRACER.span("ingest.encode", sets=n):
-                pk_operand = self._pk_operand(sets, n, B, reps)
-                if pk_operand is None:  # an aggregate was infinity
-                    return MarshalledBatch(n, 0, backend.device_h2c,
-                                           invalid=True)
+                slots_arr = None
+                pk_operand = None
+                resolved = None
+                if defer_registry:
+                    slots_arr, resolved = self._registry_slots(sets, reps)
+                if slots_arr is None:
+                    pk_operand = self._pk_operand(sets, n, B, reps,
+                                                  resolved=resolved)
+                    if pk_operand is None:  # an aggregate was infinity
+                        return MarshalledBatch(n, 0, backend.device_h2c,
+                                               invalid=True)
                 sig_pts = [s.signature.point for s in sets]
                 sig_pts += [sig_pts[0]] * reps
                 sig_aff = P.g2_encode(sig_pts)
@@ -173,7 +200,9 @@ class IngestEngine:
                 us += [us[0]] * reps
                 u0 = T.fp2_encode([u[0] for u in us])
                 u1 = T.fp2_encode([u[1] for u in us])
-                args = (pk_operand, sig_aff, u0, u1, wbits)
+                args = (sig_aff, u0, u1, wbits)
+                if slots_arr is None:
+                    args = (pk_operand,) + args
             else:
                 # Host hash-to-curve: the field draws still run through
                 # the batched SHA lanes; the curve steps (SSWU, isogeny,
@@ -197,11 +226,14 @@ class IngestEngine:
                     h_pts.append(h)
                 h_pts += [h_pts[0]] * reps
                 h_aff = P.g2_encode(h_pts)
-                args = (pk_operand, sig_aff, h_aff, wbits)
+                args = (sig_aff, h_aff, wbits)
+                if slots_arr is None:
+                    args = (pk_operand,) + args
         elapsed = time.perf_counter() - t0
         if elapsed > 0:
             M.INGEST_MARSHAL_RATE.set(n / elapsed)
-        return MarshalledBatch(n, B, backend.device_h2c, args)
+        return MarshalledBatch(n, B, backend.device_h2c, args,
+                               slots=slots_arr)
 
     # -- stage helpers -----------------------------------------------------
 
@@ -220,7 +252,23 @@ class IngestEngine:
         by_msg = dict(zip(uniq, us_u))
         return [by_msg[m] for m in msgs]
 
-    def _pk_operand(self, sets, n: int, B: int, reps: int):
+    def _registry_slots(self, sets, reps: int):
+        """The deferred-pk fast path's precondition check: a padded
+        (B,) int32 slot vector when EVERY set is a single-signer
+        registry hit, else (None, resolved) so the operand path reuses
+        the one cache resolve (mixed batches keep that path — a
+        half-deferred batch would still marshal pk columns on host,
+        paying both costs)."""
+        resolved = self.cache.resolve_batch(sets)
+        slots, cols, missing = resolved
+        if cols or missing or (slots < 0).any():
+            return None, resolved
+        if reps:
+            slots = np.concatenate(
+                [slots, np.full(reps, slots[0], dtype=slots.dtype)])
+        return slots.astype(np.int32), resolved
+
+    def _pk_operand(self, sets, n: int, B: int, reps: int, resolved=None):
         """Aggregated-pubkey LFp pair for the padded batch, cache-first.
 
         Returns ``None`` if any signer set aggregates to infinity (the
@@ -229,7 +277,8 @@ class IngestEngine:
         from ..crypto.bls.curve import from_jacobian, jac_add, to_jacobian
         from ..crypto.bls.fields import Fp
 
-        slots, cols, missing = self.cache.resolve_batch(sets)
+        slots, cols, missing = (resolved if resolved is not None
+                                else self.cache.resolve_batch(sets))
         if missing:
             agg_pts = []
             for i in missing:

@@ -7,6 +7,19 @@ of the mesh fails underneath it.  Each shard is its own fault domain —
 one hung or dying device costs retries and (past a threshold) its mesh
 seat, never the batch:
 
+* **sharded program first** (r14) — when the backend exposes its raw
+  kernel (``local_verify_fn``), the batch runs as ONE rule-partitioned
+  SPMD program (:mod:`.partition`): the operand pytree is device_put
+  straight onto its PartitionSpec shardings, each device verifies its
+  batch columns, and the per-shard conjunctions all_gather into a
+  ``(width,)`` verdict vector on-device — no host gather loop, no
+  per-shard thread, and a slot-mode batch (``mb.slots``) reads its
+  pubkey operand from the mesh-partitioned registry mirror instead of
+  carrying it over H2D.  A False verdict condemns only that shard's set
+  range, so the ladder re-verifies a 1/width slice instead of the whole
+  batch.  Any failure of the program (device loss, compile trouble, a
+  hang past the sharded deadline) falls back to the per-device
+  coordinator below, which still owns health scoring and re-shard.
 * **shard planner** — contiguous trailing-axis slices of the marshalled
   batch, one per device.  The mesh width is always a power of two
   (8→4→2→1), so with the backend's power-of-two padded batches every
@@ -247,6 +260,10 @@ class PodVerifier:
         probe_after: int = 2,
         max_rounds: int = 6,
         injector=None,
+        sharded: bool = True,
+        sharded_marshal: Callable[[list], Any] | None = None,
+        registry_provider: Callable | None = None,
+        sharded_timeout: float | None = None,
     ):
         if backend is None and shard_verify is None:
             raise ValueError(
@@ -260,6 +277,19 @@ class PodVerifier:
             else getattr(backend, "marshal_sets", None)
         )
         self.shard_verify = shard_verify
+        # sharded-program fast path (parallel/partition.py): on unless
+        # disabled, engaged only when the backend exposes its raw
+        # kernel.  sharded_marshal may defer the pubkey operand to the
+        # partitioned registry (mb.slots); registry_provider maps a
+        # mesh to that sharded mirror.
+        self.sharded = sharded
+        self.sharded_marshal = sharded_marshal
+        self.registry_provider = registry_provider
+        self.sharded_timeout = (
+            sharded_timeout if sharded_timeout is not None
+            else 4.0 * shard_timeout
+        )
+        self._sharded_programs: dict = {}
         self.shard_timeout = shard_timeout
         self.max_shard_retries = max(0, max_shard_retries)
         self.backoff_base = backoff_base
@@ -363,6 +393,16 @@ class PodVerifier:
         job = self._prepare(sets)
         if job is None:
             return self._ladder(sets)
+        outcome = self._try_sharded(job, sets, health)
+        if outcome is not None:
+            return outcome
+        if job.mb is not None and getattr(job.mb, "slots", None) is not None:
+            # a slot-mode batch has no host pubkey operand, so the
+            # per-device coordinator below cannot slice it: re-marshal
+            # through the standard path before taking the threaded road
+            job = self._prepare_plain(sets)
+            if job is None:
+                return self._ladder(sets)
         for round_no in range(1, self.max_rounds + 1):
             healthy = health.healthy()
             width = mesh_width(len(healthy))
@@ -403,13 +443,141 @@ class PodVerifier:
         try:
             if self.shard_verify is not None:
                 return _PodJob(sets=sets, total=len(sets))
-            mb = self.marshal(sets)
+            marshal = self.marshal
+            if self._sharded_enabled() and self.sharded_marshal is not None:
+                marshal = self.sharded_marshal
+            mb = marshal(sets)
             if mb is None or getattr(mb, "invalid", False):
                 return None
             return _PodJob(sets=sets, mb=mb, total=int(mb.B))
         except Exception as exc:  # noqa: BLE001 — marshal is a ladder rung
             log.warning("pod marshal failed, taking the ladder: %s", exc)
             return None
+
+    def _prepare_plain(self, sets: list) -> _PodJob | None:
+        """Standard-marshal re-prepare for the threaded coordinator."""
+        try:
+            mb = self.marshal(sets)
+            if mb is None or getattr(mb, "invalid", False):
+                return None
+            return _PodJob(sets=sets, mb=mb, total=int(mb.B))
+        except Exception as exc:  # noqa: BLE001 — marshal is a ladder rung
+            log.warning("pod re-marshal failed, taking the ladder: %s", exc)
+            return None
+
+    # -- the sharded-program fast path (parallel/partition.py) --------------
+
+    def _sharded_enabled(self) -> bool:
+        return (self.sharded and self.shard_verify is None
+                and self.backend is not None
+                and hasattr(self.backend, "local_verify_fn"))
+
+    def _sharded_program(self, key: tuple):
+        prog = self._sharded_programs.get(key)
+        if prog is None:
+            import numpy as np
+            from jax.sharding import Mesh
+
+            from .mesh import BATCH_AXIS
+            from .partition import ShardedVerifyProgram
+
+            devs = [self.devices()[i] for i in key]
+            mesh = Mesh(np.array(devs), (BATCH_AXIS,))
+            prog = ShardedVerifyProgram(
+                mesh,
+                self.backend.local_verify_fn(),
+                pk_wrap=getattr(self.backend, "registry_pk_wrap", None),
+            )
+            self._sharded_programs[key] = prog
+        return prog
+
+    def _run_sharded(self, program, mb):
+        self.injector.fire("pod.dispatch")
+        if getattr(mb, "slots", None) is not None:
+            if self.registry_provider is None:
+                raise RuntimeError(
+                    "slot-mode batch without a registry provider")
+            registry = self.registry_provider(program.mesh)
+            return program.verdict_vector_registry(
+                registry, mb.slots, mb.args)
+        return program.verdict_vector(mb.args)
+
+    def _try_sharded(self, job: _PodJob, sets: list,
+                     health: DeviceHealth) -> BatchOutcome | None:
+        """One rule-partitioned SPMD dispatch over the healthy mesh.
+        Returns the outcome, or None to fall back to the per-device
+        coordinator (program raised, timed out, or mesh too small).
+        The program call runs on a daemon worker under
+        ``sharded_timeout`` so a hung device costs this path its turn,
+        never the batch — the same leak-a-thread economics as a hung
+        per-device shard."""
+        if not self._sharded_enabled() or job.mb is None:
+            return None
+        healthy = health.healthy()
+        width = mesh_width(len(healthy))
+        if width < 2:
+            return None
+        key = tuple(healthy[:width])
+        result: dict = {}
+
+        def run() -> None:
+            try:
+                program = self._sharded_program(key)
+                result["verdicts"] = self._run_sharded(program, job.mb)
+                result["bounds"] = program.shard_bounds(job.total)
+            except Exception as exc:  # noqa: BLE001 — program fault domain
+                result["error"] = exc
+
+        M.POD_ACTIVE_SHARDS.set(width)
+        with TRACER.span("pod.dispatch", shards=width, sets=len(sets),
+                         round=0, sharded=True):
+            worker = threading.Thread(target=run, daemon=True,
+                                      name="pod-sharded")
+            worker.start()
+            worker.join(self.sharded_timeout)
+        if "verdicts" not in result:
+            err = result.get("error")
+            log.warning(
+                "pod sharded program %s; falling back to per-device "
+                "dispatch: %s",
+                "failed" if err is not None else "timed out", err)
+            return None
+        try:
+            verdicts = [
+                bool(self.injector.fire("pod.gather", bool(v)))
+                for v in result["verdicts"]
+            ]
+        except Exception as exc:  # noqa: BLE001 — chaos gather domain
+            log.warning("pod sharded gather failed: %s", exc)
+            return None
+        self.resilient.breaker.record_success()
+        n = len(sets)
+        if all(verdicts):
+            self.resilient.journal.append(("pod", n))
+            self._probe_excluded(job, health)
+            return BatchOutcome(verdicts=[True] * n, device_calls=width)
+        # Partial fallback: a shard verdict covers exactly its column
+        # range, so only failing shards' sets need the single-device
+        # bisection ladder — 1/width of the batch per bad shard instead
+        # of all of it.  Padding columns are duplicates of set 0, so a
+        # padding-only failing shard implicates set 0 (whose own shard
+        # fails too; adding it is belt and braces, never wrong).
+        suspect: set[int] = set()
+        for sid, ok in enumerate(verdicts):
+            if ok:
+                continue
+            a, b = result["bounds"][sid]
+            idxs = range(a, min(b, n))
+            if not idxs:
+                suspect.add(0)
+            suspect.update(idxs)
+        order = sorted(suspect)
+        sub = self._ladder([sets[i] for i in order])
+        merged = [True] * n
+        for j, i in enumerate(order):
+            merged[i] = bool(sub.verdicts[j])
+        return BatchOutcome(verdicts=merged,
+                            device_calls=width + sub.device_calls)
 
     def _run_round(self, job: _PodJob, device_indices: list[int],
                    health: DeviceHealth) -> bool | None:

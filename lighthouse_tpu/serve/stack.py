@@ -109,9 +109,15 @@ def build_verify_stack(pubkey_cache=None, injector=None,
     if ingest is not None:
         from ..parallel.pod import PodVerifier
 
+        # the pod fronts the service whenever a mesh is visible; the
+        # sharded-program path gets the mesh-aware marshal (defers the
+        # pubkey operand for all-registry batches) and the partitioned
+        # registry mirror provider so slot-mode batches gather on-device
         pod = PodVerifier.maybe_build(
             resilient, backend=_active,
             marshal=ingest.marshal_sets,
+            sharded_marshal=ingest.marshal_for_mesh,
+            registry_provider=ingest.cache.registry_device_sharded,
             injector=injector,
         )
         if pod is not None:

@@ -1,0 +1,25 @@
+# Seeded partition-rule table: every partition-rules finding shape.
+# The fixture audit (lint.toml re-points partition_defs here) must flag:
+#   * the non-compiling regex,
+#   * the rule naming an unregistered spec token,
+#   * the rule fully shadowed by an earlier one (first match wins),
+#   * the rule matching no leaf at all,
+#   * the operand leaf no rule covers.
+
+SPEC_TOKENS = {
+    "batch": None,
+    "replicated": None,
+}
+
+PARTITION_RULES = (
+    (r"^pk/", "batch"),            # fine: claims pk/x and pk/y
+    (r"[invalid", "batch"),        # regex does not compile
+    (r"^pk/x$", "batch"),          # shadowed: ^pk/ already claims pk/x
+    (r"^ghost$", "warp"),          # dead (no leaf) + unregistered token
+)
+
+OPERAND_LEAVES = (
+    "pk/x",
+    "pk/y",
+    "wbits",                       # orphan: no rule matches it
+)

@@ -16,6 +16,7 @@ bound algebra hold on the real kernels.
 
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -166,6 +167,37 @@ def test_serve_port_fires_on_non_int_and_out_of_range(corpus_result):
     assert symbols == {"banana", "70000"}  # 5053 and 0 pass
     # the `--serve-port <port>` usage template is skipped
     assert not any("<port>" in s for s in symbols)
+
+
+def test_partition_rules_fire_on_every_seeded_shape(corpus_result):
+    vios = _by_rule(corpus_result)["partition-rules"]
+    msgs: dict[str, str] = {}
+    for v in vios:
+        msgs[v.symbol] = msgs.get(v.symbol, "") + " | " + v.message
+    assert "does not compile" in msgs["[invalid"]
+    assert "unregistered spec token 'warp'" in msgs["^ghost$"]
+    assert "matches no partition rule" in msgs["wbits"]       # orphan leaf
+    shadows = [v for v in vios if v.symbol == "^pk/x$"]
+    assert shadows and "shadowed" in shadows[0].message
+    dead = [v for v in vios if v.symbol == "^ghost$"
+            and "dead rule" in v.message]
+    assert dead and "matches no operand leaf" in dead[0].message
+    # the healthy first rule is not flagged
+    assert not any(v.symbol == "^pk/" for v in vios)
+
+
+def test_partition_rules_live_table_binds_runtime_leaves():
+    """The audited constants are the ones the program actually uses:
+    every OPERAND_LEAVES name resolves through PARTITION_RULES to a
+    registered spec token via the live matcher."""
+    from lighthouse_tpu.parallel import partition as P
+
+    for leaf in P.OPERAND_LEAVES:
+        token = next(
+            (tok for rx, tok in P.PARTITION_RULES if re.search(rx, leaf)),
+            None,
+        )
+        assert token in P.SPEC_TOKENS, leaf
 
 
 def test_live_serve_port_docs_are_valid(live_result):

@@ -14,10 +14,10 @@ This file consolidates the four accreted round-5 scripts
 (tpu_session.py / 2 / 3 / 4) into one driver: an agenda is a LIST OF
 STAGE DICTS, so adding a measurement campaign is one AGENDAS entry,
 not a fifth script.  The historical r5 agendas are kept declaratively
-for provenance (what each ledger section ran); ``r6`` is the live one.
+for provenance (what each ledger section ran); ``r7`` is the live one.
 
 Usage:
-    python tools/tpu_session.py --agenda r6      # the current campaign
+    python tools/tpu_session.py --agenda r7      # the current campaign
     python tools/tpu_session.py --list           # show agendas + stages
 
 Stage kinds:
@@ -25,7 +25,11 @@ Stage kinds:
                     miller, device_h2c, wsm (gate envs), mxu
                     (LIGHTHOUSE_TPU_MXU), bench_mxu (BENCH_MXU=1 — the
                     in-child MXU-vs-VPU mont_mul microbench + verify
-                    sweep), pipeline (BENCH_PIPELINE=1), timeout.
+                    sweep), pipeline (BENCH_PIPELINE=1), multichip
+                    (BENCH_MULTICHIP=1 — the in-child weak-scaling
+                    sweep of the sharded verify program over mesh
+                    widths 1/2/4/8, multichip_batch sets the
+                    per-device batch), timeout.
                     chains/miller/mxu accept "auto": resolved from the
                     round ledger (best measured config / A-B winner).
                     abort_on_fail: stop the agenda when the stage fails
@@ -104,6 +108,7 @@ def run_bench_child(
     batch: int, chains: bool = False, device_h2c: bool = False,
     miller: bool = True, wsm: bool = False, mxu: bool = False,
     bench_mxu: bool = False, pipeline: bool = False,
+    multichip: bool = False, multichip_batch: int = 64,
     timeout: float = 4000,
 ) -> dict | None:
     env = dict(os.environ)
@@ -121,12 +126,16 @@ def run_bench_child(
         env["BENCH_MXU"] = "1"
     if pipeline:
         env["BENCH_PIPELINE"] = "1"
+    if multichip:
+        env["BENCH_MULTICHIP"] = "1"
+        env["BENCH_MULTICHIP_BATCH"] = str(multichip_batch)
     return _run_child(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         f"verify B={batch} chains={int(chains)} miller={int(miller)} "
         f"wsm={int(wsm)} mxu={int(mxu)} h2c={int(device_h2c)}"
         + (" +BENCH_MXU" if bench_mxu else "")
-        + (" +pipeline" if pipeline else ""),
+        + (" +pipeline" if pipeline else "")
+        + (f" +multichip/{multichip_batch}" if multichip else ""),
         env,
         timeout,
     )
@@ -320,10 +329,31 @@ AGENDAS: dict[str, list[dict]] = {
          "timeout": 7000},                # headline in the winning arm
         {"kind": "entry_warm"},
     ],
+    # r7: the sharded-program scaling campaign (ROADMAP item 2).  The
+    # multichip stage is ONE agenda entry: BENCH_MULTICHIP=1 makes the
+    # bench child weak-scale the rule-driven ShardedVerifyProgram
+    # across mesh widths 1/2/4/8 (capped by visible devices), recording
+    # kind="multichip" BENCH_HISTORY rows with per-stage H2D / compute /
+    # verdict-gather attribution and scaling_efficiency per width.  The
+    # acceptance gate (>= 0.85 efficiency at width 8) is asserted on
+    # these rows when real hardware produced them; CPU-mesh runs record
+    # but never gate.
+    "r7": [
+        {"kind": "dispatch_audit"},
+        {"kind": "bench", "batch": 512, "miller": True,
+         "abort_on_fail": True},          # baseline refresh, warm cache
+        {"kind": "bench", "batch": 512, "miller": True, "bench_mxu": True,
+         "timeout": 9000},                # MXU A/B refresh on this tree
+        {"kind": "bench", "batch": 512, "miller": True, "mxu": "auto",
+         "multichip": True, "multichip_batch": 64,
+         "timeout": 9000},                # width 1/2/4/8 weak scaling
+        {"kind": "entry_warm"},
+    ],
 }
 
 _BENCH_KEYS = ("batch", "chains", "miller", "device_h2c", "wsm", "mxu",
-               "bench_mxu", "pipeline", "timeout")
+               "bench_mxu", "pipeline", "multichip", "multichip_batch",
+               "timeout")
 
 
 def run_stage(stage: dict) -> bool:
