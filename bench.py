@@ -176,6 +176,9 @@ def run_measurement(force_cpu: bool) -> None:
         _record_serve_history(result)
     if os.environ.get("BENCH_EPOCH", "") == "1":
         result["epoch_system"] = _measure_epoch_system(device_h2c)
+    if os.environ.get("BENCH_BOOT", "") == "1":
+        result["boot"] = _measure_boot()
+        _record_boot_history(result)
     # every jit.compile span recorded this run, with per-program
     # fingerprints — the compile-time attribution ROADMAP item 4 asks for
     from lighthouse_tpu.obs import TRACER
@@ -557,6 +560,67 @@ def _measure_pipeline(B: int, device_h2c: bool) -> dict:
     return out
 
 
+def _measure_boot() -> dict:
+    """BENCH_BOOT=1: cold-vs-prewarmed boot wall clock over the AOT
+    executable store (ROADMAP item 4's operational half).
+
+    Phase "cold" stages BENCH_BOOT_PROGRAMS synthetic programs through
+    ``traced_jit``'s capture hook — trace-compile plus export+serialize
+    into a throwaway store, exactly what a first boot pays.  Phase
+    "prewarm" boots a fresh backend from that store (``aot.prewarm`` +
+    first real call per program) — what every subsequent boot pays.
+    Synthetic programs keep the A/B about the *store machinery*
+    (serialize, verify, deserialize, install); the real kernels' compile
+    cost is already tracked by the kind="compile" rows, so the speedup
+    composes from history.  Feeds the kind="boot" BENCH_HISTORY rows."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.crypto.bls.jax_backend import aot
+    from lighthouse_tpu.crypto.bls.jax_backend.backend import (
+        JaxBackend,
+        program_fingerprint,
+        traced_jit,
+    )
+
+    n = int(os.environ.get("BENCH_BOOT_PROGRAMS", "4"))
+    root = tempfile.mkdtemp(prefix="bench-boot-")
+    store = aot.AotStore(os.path.join(root, "aot_cache"))
+    x = jnp.arange(64, dtype=jnp.float32)
+    t0 = time.perf_counter()
+    for i in range(n):
+        def prog(v, _i=i):
+            return ((v * jnp.float32(_i + 1)) + 0.5).sum()
+
+        key = ("bench-boot", i)
+
+        def hook(call, args, _key=key):
+            store.capture(call, _key, args, kernel="bench_boot_prog")
+
+        call = traced_jit(
+            prog, program_fingerprint("bench_boot_prog", i=i), capture=hook
+        )
+        float(call(x))
+    cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    backend = JaxBackend(min_batch=8, device_h2c=False)
+    report = aot.prewarm(backend, store)
+    for i in range(n):
+        float(backend._kernels[("bench-boot", i)](x))
+    prewarm_s = time.perf_counter() - t0
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "programs": n,
+        "cold_s": round(cold_s, 4),
+        "prewarm_s": round(prewarm_s, 4),
+        "speedup": round(cold_s / prewarm_s, 2) if prewarm_s else None,
+        "loaded": len(report.loaded),
+        "rejected": len(report.rejected),
+    }
+
+
 def _measure_serve(device_h2c: bool) -> dict:
     """BENCH_SERVE=1: the verification front door's fill-or-flush knob.
 
@@ -727,6 +791,34 @@ def _record_serve_history(result: dict) -> None:
                     "measured_at": stamp,
                 }
                 row.update(p)
+                f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
+def _record_boot_history(result: dict) -> None:
+    """Append kind="boot" rows (one per boot phase) so the cold-vs-
+    prewarmed boot trajectory lands in BENCH_HISTORY alongside the
+    compile rows — the same ledger ``cli.run_bn --prewarm`` appends its
+    own boot row to.  Recorded for CPU children too (store machinery is
+    host-side work); the device field keeps rows comparable only with
+    their own kind."""
+    try:
+        b = result.get("boot")
+        if not b:
+            return
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(_history_path(), "a") as f:
+            for phase in ("cold", "prewarm"):
+                row = {
+                    "kind": "boot",
+                    "device": result.get("device"),
+                    "phase": phase,
+                    "seconds": b.get(f"{phase}_s"),
+                    "programs": b.get("programs"),
+                    "loaded": b.get("loaded"),
+                    "measured_at": stamp,
+                }
                 f.write(json.dumps(row) + "\n")
     except OSError:
         pass

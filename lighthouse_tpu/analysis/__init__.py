@@ -82,6 +82,13 @@ class AuditConfig:
     # sharded-program rule table: PARTITION_RULES must stay total over
     # OPERAND_LEAVES and free of dead/shadowed rules
     partition_defs: str = "lighthouse_tpu/parallel/partition.py"
+    # AOT executable store: AOT_KERNELS (the registered program set)
+    # must name kernels defined in backend.py, and any audited store
+    # manifests must cross-reference it (orphan/stale entries + broken
+    # signatures are findings)
+    aot_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/aot.py"
+    aot_backend_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/backend.py"
+    aot_manifests: tuple = ()
     docs: tuple = ("README.md", "STATUS.md")
     hot_path: dict = field(
         default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
@@ -224,6 +231,12 @@ def load_config(path: str) -> AuditConfig:
         cfg.adversity_defs = a["adversity_defs"]
     if "partition_defs" in a:
         cfg.partition_defs = a["partition_defs"]
+    if "aot_defs" in a:
+        cfg.aot_defs = a["aot_defs"]
+    if "aot_backend_defs" in a:
+        cfg.aot_backend_defs = a["aot_backend_defs"]
+    if "aot_manifests" in a:
+        cfg.aot_manifests = tuple(a["aot_manifests"])
     if "docs" in a:
         cfg.docs = tuple(a["docs"])
     if "site_scan_exclude" in a:
@@ -312,6 +325,20 @@ def run_audit(
         live_scenarios = (
             cfg.scenarios_defs == AuditConfig.scenarios_defs
         )
+        # store manifests are JSON, outside the python corpus: read them
+        # the way docs are read, unreadable ones become findings
+        manifests = []
+        for rel in cfg.aot_manifests:
+            full = os.path.join(root, rel)
+            try:
+                with open(full, encoding="utf-8") as f:
+                    manifests.append((rel, f.read()))
+            except OSError:
+                violations.append(Violation(
+                    rule="parse-error", path=rel, line=0, symbol=rel,
+                    message="AOT manifest listed in audit config is "
+                            "unreadable",
+                ))
         violations.extend(registry_lint.run(
             files, docs, cfg.metrics_defs, cfg.faults_defs,
             cfg.site_scan_exclude,
@@ -325,6 +352,9 @@ def run_audit(
             traffic_defs_path=cfg.traffic_defs,
             adversity_defs_path=cfg.adversity_defs,
             partition_defs_path=cfg.partition_defs,
+            aot_defs_path=cfg.aot_defs,
+            aot_backend_defs_path=cfg.aot_backend_defs,
+            aot_manifests=manifests,
         ))
         fam_t["registry"] = time.perf_counter() - t
 

@@ -40,6 +40,14 @@ Three cross-reference families, all driven off the canonical registries:
   matched by some rule (an orphan leaf would raise at program build).
   All three constants are AST-parsed, never imported, so they must
   stay literals.
+* **aot-manifest** — the AOT executable store's registered program set
+  (``AOT_KERNELS`` in ``jax_backend/aot.py``, AST-parsed literal) must
+  bind both directions: every registered name must be a kernel actually
+  defined in ``jax_backend/backend.py`` (a ghost entry could never be
+  captured), and every entry of an audited store manifest must verify
+  under the manifest signature, name a registered kernel (orphans are
+  stale working sets the prewarm phase would waste boot time on), and
+  carry the metadata fields ``prewarm`` keys on.
 
 The docs cross-check covers ``*_total``, ``*_seconds`` and ``*_percent``
 metric tokens (counters, histograms and gauges).
@@ -869,6 +877,124 @@ def partition_rule_violations(files, partition_defs_path) -> list[Violation]:
     return out
 
 
+def aot_manifest_defs(src: str, path: str) -> dict[str, int]:
+    """AST-parse the literal ``AOT_KERNELS`` tuple from
+    ``jax_backend/aot.py``: kernel name -> line.  Pure AST — the
+    registered program set must stay a literal for the audit to bind."""
+    tree = ast.parse(src, filename=path)
+    out: dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "AOT_KERNELS" not in names:
+            continue
+        v = node.value
+        if isinstance(v, (ast.Tuple, ast.List)):
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out[e.value] = e.lineno
+    return out
+
+
+# manifest entry fields prewarm/load key on; an entry missing one can
+# never install (aot.py's _entry_current / AotStore.load contract)
+_AOT_ENTRY_FIELDS = ("kernel", "cache_key", "jax", "backend", "blob",
+                     "sha256")
+
+
+def aot_manifest_violations(files, aot_defs_path, aot_backend_defs_path,
+                            manifests=()) -> list[Violation]:
+    """Both-direction cross-reference for the AOT executable store:
+    ``AOT_KERNELS`` names must be kernels defined in backend.py, and
+    audited manifests (``manifests`` = ``[(display, json_text)]``) must
+    verify under the store's signature algorithm with every entry
+    naming a registered kernel and carrying the prewarm metadata."""
+    files = dict(files)
+    out: list[Violation] = []
+    src = files.get(aot_defs_path)
+    if src is None:
+        return out  # corpus without the AOT store: skip the family
+    kernels = aot_manifest_defs(src, aot_defs_path)
+    if not kernels:
+        return [Violation(
+            rule="aot-manifest", path=aot_defs_path, line=0,
+            symbol="AOT_KERNELS",
+            message="AOT_KERNELS missing or non-literal",
+        )]
+    backend_src = files.get(aot_backend_defs_path)
+    if backend_src is not None:
+        tree = ast.parse(backend_src, filename=aot_backend_defs_path)
+        defined = {
+            n.name for n in tree.body if isinstance(n, ast.FunctionDef)
+        }
+        for name, line in sorted(kernels.items()):
+            if name not in defined:
+                out.append(Violation(
+                    rule="aot-manifest", path=aot_defs_path, line=line,
+                    symbol=name,
+                    message=(
+                        f"AOT_KERNELS entry {name!r} is not a kernel "
+                        f"defined in {aot_backend_defs_path} — a ghost "
+                        f"registration can never be captured"
+                    ),
+                ))
+    if not manifests:
+        return out
+    import json
+
+    # the store's own signature algorithm — byte-identical, not a copy
+    from ..crypto.bls.jax_backend.aot import manifest_signature
+
+    for display, text in manifests:
+        try:
+            doc = json.loads(text)
+            entries = doc.get("entries", {})
+            if not isinstance(entries, dict):
+                raise ValueError("entries is not a table")
+        except Exception:  # noqa: BLE001 — a broken manifest is a finding
+            out.append(Violation(
+                rule="aot-manifest", path=display, line=0, symbol=display,
+                message="store manifest does not parse as JSON",
+            ))
+            continue
+        if doc.get("signature") != manifest_signature(entries):
+            out.append(Violation(
+                rule="aot-manifest", path=display, line=0,
+                symbol="signature",
+                message=(
+                    "manifest signature does not verify — truncated, "
+                    "tampered or hand-edited store index"
+                ),
+            ))
+        for fp_hex, meta in sorted(entries.items()):
+            if not isinstance(meta, dict):
+                meta = {}
+            kernel = meta.get("kernel")
+            if kernel not in kernels:
+                out.append(Violation(
+                    rule="aot-manifest", path=display, line=0,
+                    symbol=fp_hex,
+                    message=(
+                        f"manifest entry {fp_hex!r} names unregistered "
+                        f"kernel {kernel!r} (AOT_KERNELS: "
+                        f"{', '.join(sorted(kernels))}) — orphan/stale "
+                        f"working set"
+                    ),
+                ))
+            for fld in _AOT_ENTRY_FIELDS:
+                if fld not in meta:
+                    out.append(Violation(
+                        rule="aot-manifest", path=display, line=0,
+                        symbol=f"{fp_hex}.{fld}",
+                        message=(
+                            f"manifest entry {fp_hex!r} is missing the "
+                            f"{fld!r} field prewarm keys on"
+                        ),
+                    ))
+    return out
+
+
 def run(
     files, docs, metrics_defs_path, faults_defs_path,
     site_scan_exclude=("tests/",), spec_validator=None,
@@ -876,6 +1002,7 @@ def run(
     scenario_arg_validator=None,
     search_defs_path=None, traffic_defs_path=None,
     adversity_defs_path=None, partition_defs_path=None,
+    aot_defs_path=None, aot_backend_defs_path=None, aot_manifests=(),
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
@@ -909,5 +1036,12 @@ def run(
         ))
     if partition_defs_path is not None:
         out.extend(partition_rule_violations(files, partition_defs_path))
+    if aot_defs_path is not None:
+        out.extend(aot_manifest_violations(
+            files, aot_defs_path,
+            aot_backend_defs_path
+            or "lighthouse_tpu/crypto/bls/jax_backend/backend.py",
+            aot_manifests,
+        ))
     out.extend(serve_port_violations(docs))
     return out

@@ -50,6 +50,8 @@ class BeaconNode:
         slasher: bool = False,
         execution=None,
         injector=None,
+        aot_store=None,
+        prewarm: bool = False,
     ):
         self.spec = spec
         self.fork = fork
@@ -150,10 +152,17 @@ class BeaconNode:
         # ``injector`` lets multi-node chaos tests arm faults on ONE node.
         from ..serve.stack import build_verify_stack
 
+        # Boot ordering: the stack is built (and, with ``prewarm``, the
+        # AOT store's executables installed) HERE, in __init__ — before
+        # start() opens the libp2p host, discovery, or the HTTP API, so
+        # a prewarmed node never joins the network with a cold kernel
+        # cache.
         stack = build_verify_stack(
             pubkey_cache=getattr(self.chain, "pubkey_cache", None),
             injector=injector,
+            aot_store=aot_store, prewarm=prewarm,
         )
+        self.prewarm_report = stack.prewarm_report
         self.breaker = stack.breaker
         self.ingest = stack.ingest
         self.verifier = stack.verifier

@@ -80,6 +80,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "--scenario hostile-checkpoint-sync:epochs=4, "
                          "--scenario registry-pressure; exits 0/1 "
                          "on SLO pass/fail")
+    bn.add_argument("--prewarm", action="store_true",
+                    help="warm-boot phase: deserialize every current "
+                         "entry of the AOT executable store "
+                         "(<datadir>/aot_cache/, populated by earlier "
+                         "runs) into the kernel cache and trace-compile "
+                         "any misses BEFORE the beacon API, metrics, "
+                         "serve front door or discovery open — a node "
+                         "restarted over a populated store performs "
+                         "zero tracing-compiles of staged programs on "
+                         "its serving path (requires --datadir)")
     bn.add_argument("--upnp", action="store_true",
                     help="attempt UPnP port mapping for p2p/discovery "
                          "(best-effort; nat.rs analog)")
@@ -254,6 +264,43 @@ def run_bn(args) -> int:
             store=SlabStore(os.path.join(args.datadir, "beacon.slab")),
             types_family=types_for(spec.preset),
         )
+    # AOT executable store under the datadir: attach it whenever a
+    # datadir exists (normal operation then captures each compiled
+    # program), and with --prewarm install every current entry NOW —
+    # before the harness compiles anything and before any listener
+    # (API / metrics / serve / discovery) opens.  ROADMAP item 4.
+    aot_store = None
+    if args.datadir:
+        import os
+
+        from .crypto.bls import api as _bls_api
+        from .crypto.bls.jax_backend import aot as _aot
+
+        backend = _bls_api.get_backend()
+        if hasattr(backend, "attach_aot_store"):
+            aot_store = _aot.AotStore(
+                os.path.join(args.datadir, "aot_cache")
+            )
+            backend.attach_aot_store(aot_store)
+            if args.prewarm:
+                t_warm = time.perf_counter()
+                report = _aot.prewarm(
+                    backend, aot_store, compile_misses=True
+                )
+                log_with(log, logging.INFO, "Prewarm boot phase done",
+                         **report.to_row())
+                _aot.record_boot_row(dict(
+                    report.to_row(), phase="prewarm",
+                    wall_s=round(time.perf_counter() - t_warm, 3),
+                ))
+        elif args.prewarm:
+            log_with(log, logging.WARNING,
+                     "--prewarm: active BLS backend has no AOT seam",
+                     backend=getattr(backend, "name", "?"))
+    elif args.prewarm:
+        log_with(log, logging.WARNING,
+                 "--prewarm needs --datadir (the store lives under it); "
+                 "skipping")
     h = BeaconChainHarness(n_validators=args.validators, spec=spec, store=store)
     server = BeaconApiServer(h.chain, port=args.http_port)
     server.start()

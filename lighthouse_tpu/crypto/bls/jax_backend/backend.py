@@ -31,7 +31,7 @@ from ..curve import Fp, G1_GENERATOR, affine_neg, from_jacobian, jac_add, to_jac
 from ..fields import Fp2
 from ..hash_to_curve import hash_to_g2
 from ....obs.tracer import TRACER
-from ....utils.metrics import JIT_COMPILE_SECONDS
+from ....utils.metrics import COMPILE_CACHE_ERRORS, JIT_COMPILE_SECONDS
 from . import fp as F
 from . import pairing as PR
 from . import points as P
@@ -55,7 +55,16 @@ def enable_compile_cache(cache_dir: str) -> bool:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         return True
-    except Exception:  # noqa: BLE001 — cache is an optimization, not a dep
+    except Exception as exc:  # noqa: BLE001 — cache is an optimization,
+        # not a dep — but a dead cache re-pays full compile time on every
+        # boot, so the failure must be loud: a counter on /metrics plus a
+        # structured log line, not a swallowed warning.
+        COMPILE_CACHE_ERRORS.inc()
+        from ....utils import get_logger, log_with
+
+        log_with(get_logger("bls.jax"), 30,
+                 "persistent compile cache unavailable",
+                 cache_dir=cache_dir, error=str(exc))
         return False
 
 
@@ -74,12 +83,17 @@ def program_fingerprint(kernel: str, **attrs) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
 
-def traced_jit(fn, fingerprint: str, **jit_kw):
+def traced_jit(fn, fingerprint: str, *, capture=None, **jit_kw):
     """``jax.jit`` wrapped so the FIRST call per cache entry — the one
     that traces + compiles the program — is timed into the flight
     recorder as a ``jit.compile`` span (per-program fingerprint in its
     fields) and into ``jit_compile_seconds``.  Subsequent calls go
-    straight to the compiled callable."""
+    straight to the compiled callable.
+
+    ``capture``, when given, is invoked as ``capture(call, args)`` right
+    after the first call completes — the AOT store's export hook
+    (jax_backend/aot.py), which is never-raise by contract and works
+    from arg avals only (safe under donation)."""
     import jax
 
     jitted = jax.jit(fn, **jit_kw)
@@ -93,6 +107,8 @@ def traced_jit(fn, fingerprint: str, **jit_kw):
                              kernel=getattr(fn, "__name__", str(fn))):
                 out = jitted(*args)
             JIT_COMPILE_SECONDS.observe(time.perf_counter() - t0)
+            if capture is not None:
+                capture(call, args)
             return out
         return jitted(*args)
 
@@ -353,6 +369,7 @@ class JaxBackend:
 
     def __init__(self, min_batch: int = 8, device_h2c: bool | None = None):
         self._kernels = {}
+        self._aot_store = None
         self.min_batch = min_batch
         # device_h2c: map messages to G2 ON DEVICE (host only hashes).
         # Measured on the v5e at B=512 (PERF.md): host marshal 120 -> 5,008
@@ -376,6 +393,15 @@ class JaxBackend:
             import jax
 
             fn = _verify_kernel_h2c if self.device_h2c else _verify_kernel
+            fp_hex = program_fingerprint(
+                fn.__name__, B=B, device_h2c=self.device_h2c,
+                mxu=F.mxu_enabled(),
+            )
+            # Store-first: a cache miss consults the attached AOT store
+            # before paying a tracing-compile — a populated store makes
+            # the second boot's working set compile-free.
+            if self._install_from_store(key, fp_hex):
+                return self._kernels[key]
             # Donate the marshalled operands on TPU: they are fresh
             # per-batch buffers, and donation lets XLA alias them for
             # temporaries — required for double-buffered dispatch to
@@ -385,14 +411,82 @@ class JaxBackend:
             if jax.default_backend() == "tpu":
                 donate = tuple(range(5 if self.device_h2c else 4))
             self._kernels[key] = traced_jit(
-                fn,
-                program_fingerprint(
-                    fn.__name__, B=B, device_h2c=self.device_h2c,
-                    mxu=F.mxu_enabled(),
-                ),
+                fn, fp_hex,
+                capture=self._aot_capture(key, fn.__name__),
                 donate_argnums=donate,
             )
         return self._kernels[key]
+
+    # -- AOT executable store seams (jax_backend/aot.py) -------------------
+
+    def attach_aot_store(self, store) -> None:
+        """Attach an :class:`~.aot.AotStore`: cache misses consult it
+        before compiling, and fresh compiles are exported into it (the
+        ``traced_jit`` capture hook), so normal operation populates the
+        store the next boot prewarms from."""
+        self._aot_store = store
+
+    def install_kernel(self, cache_key, fingerprint: str, call) -> None:
+        """Install a deserialized AOT executable under a kernel-cache
+        key, wearing the ``traced_jit`` surface (``.jitted`` /
+        ``.fingerprint``) so dispatch and the dispatch audit cannot tell
+        it from an organically compiled program."""
+        def installed(*args):
+            return call(*args)
+
+        installed.jitted = call
+        installed.fingerprint = fingerprint
+        installed.aot = True
+        self._kernels[tuple(cache_key)] = installed
+
+    def _install_from_store(self, key, fp_hex: str) -> bool:
+        if self._aot_store is None:
+            return False
+        call = self._aot_store.load(fp_hex)
+        if call is None:
+            return False
+        self.install_kernel(key, fp_hex, call)
+        return True
+
+    def _aot_capture(self, key, kernel: str):
+        """The traced_jit first-call hook bound to this cache key, or
+        None when no store is attached (the common test path)."""
+        if self._aot_store is None:
+            return None
+        store = self._aot_store
+
+        def hook(call, args):
+            store.capture(call, key, args, kernel=kernel)
+
+        return hook
+
+    def warm_compile(self, B: int) -> bool:
+        """Trace+compile the batch-verify kernel for padded size ``B``
+        ahead of traffic: one synthetic valid set, marshalled once and
+        tiled along the batch axis (every kernel operand is batch-last).
+        Goes through the normal ``_kernel`` path, so spans, metrics and
+        AOT capture fire exactly as for organic traffic."""
+        from ..api import SecretKey, SignatureSet
+
+        import jax
+
+        if B < self.min_batch or B & (B - 1):
+            return False
+        sk = SecretKey(2)
+        msg = b"lighthouse-tpu warm-compile probe"
+        s = SignatureSet(sk.sign(msg), [sk.public_key()], msg)
+        mb = self.marshal_sets([s], weights=[1])
+        if mb.invalid:
+            return False
+        reps = B // mb.B
+        args = jax.tree_util.tree_map(
+            lambda a: np.tile(
+                np.asarray(a), (1,) * (np.asarray(a).ndim - 1) + (reps,)
+            ),
+            mb.args,
+        )
+        self._kernel(B)(*jax.device_put(args))
+        return True
 
     # -- single/aggregate verification reuses the set machinery ------------
 
@@ -419,10 +513,14 @@ class JaxBackend:
         B = len(pk_pts)
         key = ("agg", B)
         if key not in self._kernels:
-            self._kernels[key] = traced_jit(
-                _aggregate_verify_kernel,
-                program_fingerprint("_aggregate_verify_kernel", n=B),
-            )
+            fp_hex = program_fingerprint("_aggregate_verify_kernel", n=B)
+            if not self._install_from_store(key, fp_hex):
+                self._kernels[key] = traced_jit(
+                    _aggregate_verify_kernel, fp_hex,
+                    capture=self._aot_capture(
+                        key, "_aggregate_verify_kernel"
+                    ),
+                )
         fn = self._kernels[key]
         ok = fn(
             P.g1_encode(pk_pts),

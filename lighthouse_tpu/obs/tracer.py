@@ -57,6 +57,9 @@ SPANS: dict[str, str] = {
     "sync.batch": "sync batch lifecycle: request through import",
     # JIT compiles (crypto/bls/jax_backend/backend.py)
     "jit.compile": "XLA/Mosaic program compile, per-program fingerprint",
+    # AOT executable store (crypto/bls/jax_backend/aot.py)
+    "aot.capture": "export+serialize of a just-compiled staged program",
+    "prewarm.load": "AOT store load+install of one program at warm boot",
     # scenario engine virtual slots (scenario/engine.py)
     "scenario.slot": "one virtual slot of a scenario run",
     # vectorized ingest engine (ingest/engine.py)

@@ -255,4 +255,35 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
              "the greedy tenant's overage must actually be shed — its "
              "token bucket is the isolation boundary")
 
+    # ---- warm-standby handoff gates (warm-standby-handoff track) -------
+
+    if t.get("max_handoff_shed") is not None:
+        v = run.get("handoff_shed", 0)
+        gate("handoff_shed", v <= t["max_handoff_shed"], int(v),
+             t["max_handoff_shed"],
+             "zero-downtime means zero: no request may be shed while "
+             "the standby prewarms and the device rung cuts over "
+             f"({run.get('handoff_completed', 0)} requests completed)")
+
+    if t.get("require_handoff_cutover"):
+        done = run.get("handoff_cutover_done", False)
+        gate("handoff_cutover", done, done, True,
+             "the standby must actually take over serving after its "
+             "prewarm verified against the old node's outputs")
+
+    if t.get("max_standby_compiles") is not None:
+        v = run.get("handoff_standby_compiles", 0)
+        gate("standby_compiles", v <= t["max_standby_compiles"], int(v),
+             t["max_standby_compiles"],
+             "the standby must boot from the AOT store, not the "
+             "tracer — a compile here is the minutes-long stall the "
+             "store exists to delete")
+
+    if t.get("min_prewarm_loaded") is not None:
+        v = run.get("handoff_prewarm_loaded", 0)
+        gate("prewarm_loaded", v >= t["min_prewarm_loaded"], int(v),
+             t["min_prewarm_loaded"],
+             "every program the old node captured must deserialize and "
+             "install on the standby")
+
     return out

@@ -65,6 +65,14 @@ DEFAULT_SLO: dict = {
     "max_honest_deadline_miss_rate": None,  # honest deadline misses / done
     "max_honest_shed": None,            # honest submissions shed (any reason)
     "min_greedy_shed_rate": None,       # greedy submissions shed / submitted
+    # warm-standby handoff gates (None = not asserted): the upgrade
+    # contract — no request shed across the cutover window, the standby
+    # actually takes over, and it boots from the AOT store (zero
+    # tracing-compiles) with every captured program installed
+    "max_handoff_shed": None,           # requests shed over the whole run
+    "require_handoff_cutover": False,   # standby must end up serving
+    "max_standby_compiles": None,       # standby tracing-compiles
+    "min_prewarm_loaded": None,         # store entries installed on standby
 }
 
 
@@ -289,6 +297,30 @@ SCENARIOS: dict[str, ScenarioSpec] = {
             "max_honest_deadline_miss_rate": 0.02,
             "max_honest_shed": 0,
             "min_greedy_shed_rate": 0.5,
+            "require_crash_recovery": False,
+        },
+    ),
+    # The zero-downtime upgrade drill: an "old node" VerifyService keeps
+    # serving a steady tenant while it stages four programs through the
+    # real AOT executable store; a standby backend prewarms from the
+    # shared store mid-run and takes over the device rung at the cutover
+    # slot.  The SLOs are the upgrade contract (ROADMAP item 4): zero
+    # requests shed across the window, a completed cutover, a standby
+    # that loaded everything and compiled nothing.
+    "warm-handoff": ScenarioSpec(
+        name="warm-handoff",
+        seed=53,
+        n_nodes=3,
+        n_validators=16,
+        epochs=2,
+        adversity=(
+            "warm-standby-handoff:programs=4,prewarm_at=4,cutover=6",
+        ),
+        slo={
+            "max_handoff_shed": 0,
+            "require_handoff_cutover": True,
+            "max_standby_compiles": 0,
+            "min_prewarm_loaded": 4,
             "require_crash_recovery": False,
         },
     ),

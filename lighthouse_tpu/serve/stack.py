@@ -38,6 +38,9 @@ class VerifyStack:
     ingest: object | None
     pod: object | None
     injector: object
+    # the warm-boot report when the stack was built with prewarm=True
+    # (crypto/bls/jax_backend/aot.PrewarmReport), else None
+    prewarm_report: object | None = None
 
 
 def _make_ingest_device_verify(ingest):
@@ -63,7 +66,8 @@ def _make_ingest_device_verify(ingest):
 
 
 def build_verify_stack(pubkey_cache=None, injector=None,
-                       breaker=None) -> VerifyStack:
+                       breaker=None, aot_store=None,
+                       prewarm=False) -> VerifyStack:
     """Assemble the full verification ladder against the active backend.
 
     Parameters
@@ -78,6 +82,16 @@ def build_verify_stack(pubkey_cache=None, injector=None,
     breaker:
         Pre-built ``CircuitBreaker`` (scenario engines pin its clock);
         defaults to a fresh real-time one.
+    aot_store:
+        Optional :class:`~..crypto.bls.jax_backend.aot.AotStore`
+        attached to the active backend (when it has the seam): cache
+        misses deserialize from the store, fresh compiles are captured
+        into it.
+    prewarm:
+        Install every current store entry into the backend's kernel
+        cache NOW — before this function returns, so before any caller
+        can open a listener over the stack.  The report lands on the
+        returned stack's ``prewarm_report``.
     """
     from ..beacon.processor import CircuitBreaker, ResilientVerifier
     from ..crypto.bls import api as _bls_api
@@ -89,6 +103,13 @@ def build_verify_stack(pubkey_cache=None, injector=None,
         injector = faults_mod.INJECTOR
     ingest = None
     _active = _bls_api.get_backend()
+    prewarm_report = None
+    if aot_store is not None and hasattr(_active, "attach_aot_store"):
+        _active.attach_aot_store(aot_store)
+        if prewarm:
+            from ..crypto.bls.jax_backend import aot as _aot
+
+            prewarm_report = _aot.prewarm(_active, aot_store)
     if hasattr(_active, "marshal_sets") and hasattr(_active, "dispatch"):
         from ..ingest import IngestEngine
 
@@ -125,4 +146,5 @@ def build_verify_stack(pubkey_cache=None, injector=None,
     return VerifyStack(
         breaker=breaker, verifier=verifier, resilient=resilient,
         ingest=ingest, pod=pod, injector=injector,
+        prewarm_report=prewarm_report,
     )

@@ -423,6 +423,33 @@ JIT_COMPILE_SECONDS = Histogram(
     buckets=(0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0, 3600.0),
 )
 
+# AOT executable store (crypto/bls/jax_backend/aot.py): warm-boot loads
+# of serialized staged programs.  hits = deserialized + installed,
+# misses = program not in the store / stale for this jax version or
+# device kind, rejects = entry present but failed integrity (corrupt
+# blob, truncated or tampered manifest) or deserialization — a reject
+# always falls back to tracing-compile, never an error.
+AOT_CACHE_HITS = Counter(
+    "aot_cache_hits_total",
+    "AOT store entries deserialized and installed into the kernel cache",
+)
+AOT_CACHE_MISSES = Counter(
+    "aot_cache_misses_total",
+    "AOT store lookups with no usable entry (absent, or stale for this "
+    "jax version / device kind / backend config)",
+)
+AOT_CACHE_REJECTS = Counter(
+    "aot_cache_rejects_total",
+    "AOT store entries rejected by integrity checks (manifest signature, "
+    "blob sha256, deserialization) and fallen back to tracing-compile",
+)
+COMPILE_CACHE_ERRORS = Counter(
+    "compile_cache_errors_total",
+    "Failures enabling the persistent XLA compile cache — a dead cache "
+    "silently re-pays full compile time on every boot, so it must be "
+    "visible on /metrics",
+)
+
 # Per-config Pallas dispatch accounting (tools/dispatch_audit.py): distinct
 # lowered programs and stacked pallas_call dispatches in the traced verify
 # composition, labelled by backend config string (e.g. "chains+miller+h2c").

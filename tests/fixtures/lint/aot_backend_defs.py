@@ -1,0 +1,7 @@
+"""Fixture kernel definitions for the aot-manifest family: defines only
+``fixture_kernel_good`` — the registry's ``fixture_kernel_ghost`` entry
+has no definition here, which is the seeded violation."""
+
+
+def fixture_kernel_good(x):
+    return x

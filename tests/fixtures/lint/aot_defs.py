@@ -1,0 +1,9 @@
+"""Fixture AOT program registry for the aot-manifest family: one name
+that resolves to a kernel definition in aot_backend_defs.py and one
+ghost entry that does not (a registered program that could never be
+captured — the family must flag it)."""
+
+AOT_KERNELS = (
+    "fixture_kernel_good",
+    "fixture_kernel_ghost",
+)

@@ -200,6 +200,54 @@ def test_partition_rules_live_table_binds_runtime_leaves():
         assert token in P.SPEC_TOKENS, leaf
 
 
+def test_aot_manifest_fires_on_every_seeded_shape(corpus_result):
+    vios = _by_rule(corpus_result)["aot-manifest"]
+    symbols = {v.symbol for v in vios}
+    # direction 1: registered program with no kernel definition (ghost)
+    assert "fixture_kernel_ghost" in symbols
+    assert "fixture_kernel_good" not in symbols
+    # direction 2: manifest entry naming an unregistered kernel (orphan
+    # / stale working set), a signature that does not verify, and an
+    # entry missing the metadata prewarm keys on
+    assert "bbbbbbbbbbbb" in symbols
+    assert "signature" in symbols
+    assert "cccccccccccc.cache_key" in symbols
+    # the correctly-signed manifest over a registered kernel is clean
+    assert not any(
+        v.path.endswith("aot_manifest_good.json") for v in vios
+    )
+
+
+def test_aot_manifest_skipped_when_defs_absent():
+    # corpora without the AOT store (older fixture corpora) run the
+    # other families without an aot-manifest finding
+    from lighthouse_tpu.analysis import registry_lint
+
+    out = registry_lint.run(
+        [("a.py", "x = 1\n")], [],
+        metrics_defs_path="nope_metrics.py",
+        faults_defs_path="nope_faults.py",
+        aot_defs_path="nope_aot.py",
+    )
+    assert not [v for v in out if v.rule == "aot-manifest"]
+
+
+def test_aot_manifest_live_registry_binds_backend_kernels():
+    """The audited constants are the ones the store actually captures:
+    every AOT_KERNELS name is a callable kernel in the live backend,
+    and the AST parse sees exactly the runtime tuple."""
+    from lighthouse_tpu.analysis.registry_lint import aot_manifest_defs
+    from lighthouse_tpu.crypto.bls.jax_backend import aot
+    from lighthouse_tpu.crypto.bls.jax_backend import backend as B
+
+    path = "lighthouse_tpu/crypto/bls/jax_backend/aot.py"
+    with open(os.path.join(REPO, path), encoding="utf-8") as f:
+        kernels = aot_manifest_defs(f.read(), path)
+    assert set(kernels) == set(aot.AOT_KERNELS)
+    for name in kernels:
+        assert callable(getattr(B, name))
+
+
 def test_live_serve_port_docs_are_valid(live_result):
     # every concrete --serve-port example in README/docs must be a real
     # TCP port, same doc-example contract as --chaos / --scenario

@@ -14,10 +14,10 @@ This file consolidates the four accreted round-5 scripts
 (tpu_session.py / 2 / 3 / 4) into one driver: an agenda is a LIST OF
 STAGE DICTS, so adding a measurement campaign is one AGENDAS entry,
 not a fifth script.  The historical r5 agendas are kept declaratively
-for provenance (what each ledger section ran); ``r7`` is the live one.
+for provenance (what each ledger section ran); ``r8`` is the live one.
 
 Usage:
-    python tools/tpu_session.py --agenda r7      # the current campaign
+    python tools/tpu_session.py --agenda r8      # the current campaign
     python tools/tpu_session.py --list           # show agendas + stages
 
 Stage kinds:
@@ -29,7 +29,9 @@ Stage kinds:
                     (BENCH_MULTICHIP=1 — the in-child weak-scaling
                     sweep of the sharded verify program over mesh
                     widths 1/2/4/8, multichip_batch sets the
-                    per-device batch), timeout.
+                    per-device batch), boot (BENCH_BOOT=1 — the
+                    in-child cold-vs-prewarmed AOT-store boot timing,
+                    kind="boot" BENCH_HISTORY rows), timeout.
                     chains/miller/mxu accept "auto": resolved from the
                     round ledger (best measured config / A-B winner).
                     abort_on_fail: stop the agenda when the stage fails
@@ -109,7 +111,7 @@ def run_bench_child(
     miller: bool = True, wsm: bool = False, mxu: bool = False,
     bench_mxu: bool = False, pipeline: bool = False,
     multichip: bool = False, multichip_batch: int = 64,
-    timeout: float = 4000,
+    boot: bool = False, timeout: float = 4000,
 ) -> dict | None:
     env = dict(os.environ)
     env["BENCH_CHILD"] = "tpu"
@@ -129,13 +131,16 @@ def run_bench_child(
     if multichip:
         env["BENCH_MULTICHIP"] = "1"
         env["BENCH_MULTICHIP_BATCH"] = str(multichip_batch)
+    if boot:
+        env["BENCH_BOOT"] = "1"
     return _run_child(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         f"verify B={batch} chains={int(chains)} miller={int(miller)} "
         f"wsm={int(wsm)} mxu={int(mxu)} h2c={int(device_h2c)}"
         + (" +BENCH_MXU" if bench_mxu else "")
         + (" +pipeline" if pipeline else "")
-        + (f" +multichip/{multichip_batch}" if multichip else ""),
+        + (f" +multichip/{multichip_batch}" if multichip else "")
+        + (" +boot" if boot else ""),
         env,
         timeout,
     )
@@ -349,11 +354,32 @@ AGENDAS: dict[str, list[dict]] = {
          "timeout": 9000},                # width 1/2/4/8 weak scaling
         {"kind": "entry_warm"},
     ],
+    # r8: the warm-boot campaign (ROADMAP item 4's operational half).
+    # The boot stage is ONE agenda entry: BENCH_BOOT=1 makes the bench
+    # child time a cold boot (trace-compile + AOT capture into a
+    # throwaway store) against a prewarmed boot (aot.prewarm from that
+    # store + first call), recording kind="boot" BENCH_HISTORY rows —
+    # the on-chip wall-clock numbers behind `bn --prewarm`.  The MXU
+    # A/B refresh keeps the standing on-chip obligation (every round
+    # re-measures the winner on the current tree).
+    "r8": [
+        {"kind": "dispatch_audit"},
+        {"kind": "bench", "batch": 512, "miller": True,
+         "abort_on_fail": True},          # baseline refresh, warm cache
+        {"kind": "bench", "batch": 512, "miller": True, "bench_mxu": True,
+         "timeout": 9000},                # MXU A/B refresh on this tree
+        {"kind": "bench", "batch": 512, "miller": True, "mxu": "auto",
+         "multichip": True, "multichip_batch": 64,
+         "timeout": 9000},                # multichip scaling refresh
+        {"kind": "bench", "batch": 512, "miller": True, "mxu": "auto",
+         "boot": True, "timeout": 7000},  # cold vs prewarmed boot A/B
+        {"kind": "entry_warm"},
+    ],
 }
 
 _BENCH_KEYS = ("batch", "chains", "miller", "device_h2c", "wsm", "mxu",
                "bench_mxu", "pipeline", "multichip", "multichip_batch",
-               "timeout")
+               "boot", "timeout")
 
 
 def run_stage(stage: dict) -> bool:
