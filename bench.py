@@ -154,6 +154,9 @@ def run_measurement(force_cpu: bool) -> None:
         "unit": "sets/s",
         "vs_baseline": round(sets_per_s / NORTH_STAR, 6),
         "device": str(dev),
+        # the silicon identity every BENCH_HISTORY row kind carries, so
+        # bench rows join autotuned plans on the same key
+        "device_kind": _device_kind(),
         "batch": B,
         "compile_sec": round(t_compile, 1),
         "host_marshal_sets_per_s": round(B / t_marshal, 1),
@@ -179,6 +182,9 @@ def run_measurement(force_cpu: bool) -> None:
     if os.environ.get("BENCH_BOOT", "") == "1":
         result["boot"] = _measure_boot()
         _record_boot_history(result)
+    if os.environ.get("BENCH_AUTOTUNE", "") == "1":
+        result["autotune"] = _measure_autotune()
+        _record_autotune_history(result)
     # every jit.compile span recorded this run, with per-program
     # fingerprints — the compile-time attribution ROADMAP item 4 asks for
     from lighthouse_tpu.obs import TRACER
@@ -621,6 +627,42 @@ def _measure_boot() -> dict:
     }
 
 
+def _measure_autotune() -> dict:
+    """BENCH_AUTOTUNE=1: run the per-device-kind kernel autotuner
+    (crypto/bls/jax_backend/autotune.py) — timed trials of every
+    range-proven arm across the batch-shape ladder — and persist the
+    winning plan into an AOT store so the relay window leaves tuned
+    plans behind for the next boot's ``bn --prewarm``.
+
+    Knobs: BENCH_AUTOTUNE_SHAPES (ladder override), BENCH_AUTOTUNE_STORE
+    (plan destination; default ``aot_tuned/`` beside this script so the
+    artifact survives the session), BENCH_ITERS.  Feeds the
+    kind="autotune" BENCH_HISTORY rows."""
+    from lighthouse_tpu.crypto.bls.jax_backend import aot, autotune
+
+    shapes_env = os.environ.get("BENCH_AUTOTUNE_SHAPES", "")
+    shapes = (
+        tuple(int(s) for s in shapes_env.split(",") if s.strip())
+        if shapes_env
+        else autotune.default_shapes()
+    )
+    root = os.environ.get("BENCH_AUTOTUNE_STORE") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "aot_tuned"
+    )
+    iters = int(os.environ.get("BENCH_ITERS", "3"))
+    store = aot.AotStore(root)
+    t0 = time.perf_counter()
+    plan = autotune.tune_and_store(store, shapes=shapes, iters=iters)
+    return {
+        "device_kind": plan["device_kind"],
+        "jax": plan["jax"],
+        "store": root,
+        "arms": [a.arm for a in autotune.proven_arms()],
+        "shapes": plan["shapes"],
+        "tune_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 def _measure_serve(device_h2c: bool) -> dict:
     """BENCH_SERVE=1: the verification front door's fill-or-flush knob.
 
@@ -785,6 +827,7 @@ def _record_serve_history(result: dict) -> None:
                 row = {
                     "kind": "serve",
                     "device": result.get("device"),
+                    "device_kind": result.get("device_kind") or _device_kind(),
                     "mode": s.get("mode"),
                     "gap_ms": s.get("gap_ms"),
                     "sets_per_request": s.get("sets_per_request"),
@@ -813,6 +856,7 @@ def _record_boot_history(result: dict) -> None:
                 row = {
                     "kind": "boot",
                     "device": result.get("device"),
+                    "device_kind": result.get("device_kind") or _device_kind(),
                     "phase": phase,
                     "seconds": b.get(f"{phase}_s"),
                     "programs": b.get("programs"),
@@ -828,6 +872,43 @@ def _history_path() -> str:
     return os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
     )
+
+
+def _device_kind() -> str:
+    """Silicon identity stamped on every BENCH_HISTORY row kind — the
+    same key (device kind × jax version) autotuned plans persist under,
+    so history rows and plans join without guessing from device strings."""
+    from lighthouse_tpu.utils import device_kind
+
+    return device_kind()
+
+
+def _record_autotune_history(result: dict) -> None:
+    """Append kind="autotune" rows — one per tuned batch shape, carrying
+    the per-arm trial timings and the chosen arm — so plan decisions are
+    auditable in BENCH_HISTORY next to the mxu A/B rows they generalize.
+    Recorded for CPU children too (stub/interpret tuning proof runs);
+    device_kind keeps them from ever being read as chip plans."""
+    try:
+        a = result.get("autotune")
+        if not a:
+            return
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(_history_path(), "a") as f:
+            for shape, entry in (a.get("shapes") or {}).items():
+                row = {
+                    "kind": "autotune",
+                    "device": result.get("device"),
+                    "device_kind": a.get("device_kind"),
+                    "jax": a.get("jax"),
+                    "batch": int(shape),
+                    "store": a.get("store"),
+                    "measured_at": stamp,
+                }
+                row.update(entry)
+                f.write(json.dumps(row) + "\n")
+    except (OSError, ValueError):
+        pass
 
 
 def _record_tpu_history(result: dict) -> None:
@@ -855,6 +936,7 @@ def _record_compile_history(result: dict) -> None:
                     "kernel": c.get("kernel"),
                     "seconds": c["seconds"],
                     "device": result.get("device"),
+                    "device_kind": result.get("device_kind") or _device_kind(),
                     "batch": result.get("batch"),
                     "measured_at": time.strftime(
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -880,6 +962,7 @@ def _record_marshal_history(result: dict) -> None:
                     "kind": "marshal",
                     "shape": shape,
                     "device": result.get("device"),
+                    "device_kind": result.get("device_kind") or _device_kind(),
                     "device_h2c": m.get("device_h2c"),
                     "measured_at": time.strftime(
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -905,6 +988,7 @@ def _record_mxu_history(result: dict) -> None:
             base = {
                 "kind": "mxu",
                 "device": result.get("device"),
+                "device_kind": result.get("device_kind") or _device_kind(),
                 "interpret": m.get("interpret"),
                 "measured_at": stamp,
             }
@@ -931,6 +1015,7 @@ def _record_multichip_history(result: dict) -> None:
                 row = {
                     "kind": "multichip",
                     "device": result.get("device"),
+                    "device_kind": result.get("device_kind") or _device_kind(),
                     "measured_at": time.strftime(
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
                     ),

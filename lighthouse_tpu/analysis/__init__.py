@@ -89,6 +89,11 @@ class AuditConfig:
     aot_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/aot.py"
     aot_backend_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/backend.py"
     aot_manifests: tuple = ()
+    # kernel autotuner: ARM_TABLE arms must route through toggles defined
+    # in fp.py, and audited manifest plan tables must verify (signature,
+    # known proven arms, power-of-2 shapes, registered kernels)
+    tune_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/autotune.py"
+    fp_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/fp.py"
     docs: tuple = ("README.md", "STATUS.md")
     hot_path: dict = field(
         default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
@@ -237,6 +242,10 @@ def load_config(path: str) -> AuditConfig:
         cfg.aot_backend_defs = a["aot_backend_defs"]
     if "aot_manifests" in a:
         cfg.aot_manifests = tuple(a["aot_manifests"])
+    if "tune_defs" in a:
+        cfg.tune_defs = a["tune_defs"]
+    if "fp_defs" in a:
+        cfg.fp_defs = a["fp_defs"]
     if "docs" in a:
         cfg.docs = tuple(a["docs"])
     if "site_scan_exclude" in a:
@@ -355,6 +364,8 @@ def run_audit(
             aot_defs_path=cfg.aot_defs,
             aot_backend_defs_path=cfg.aot_backend_defs,
             aot_manifests=manifests,
+            tune_defs_path=cfg.tune_defs,
+            fp_defs_path=cfg.fp_defs,
         ))
         fam_t["registry"] = time.perf_counter() - t
 

@@ -995,6 +995,175 @@ def aot_manifest_violations(files, aot_defs_path, aot_backend_defs_path,
     return out
 
 
+def tune_plan_defs(src: str, path: str) -> dict[str, tuple]:
+    """AST-parse the literal ``ARM_TABLE`` from
+    ``jax_backend/autotune.py``: arm id -> (spec, toggle, value, proof,
+    line).  Pure AST — the kernel-arm registry must stay a literal for
+    the audit to bind, exactly like AOT_KERNELS / SPANS."""
+    tree = ast.parse(src, filename=path)
+    arms: dict[str, tuple] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "ARM_TABLE" not in names:
+            continue
+        v = node.value
+        if not isinstance(v, (ast.Tuple, ast.List)):
+            continue
+        for e in v.elts:
+            if not isinstance(e, (ast.Tuple, ast.List)) or len(e.elts) != 5:
+                continue
+            if not all(isinstance(x, ast.Constant) for x in e.elts):
+                continue
+            vals = [x.value for x in e.elts]
+            if isinstance(vals[0], str):
+                arms[vals[0]] = (
+                    vals[1], vals[2], vals[3], vals[4], e.lineno
+                )
+    return arms
+
+
+def _power_of_two_shape(shape) -> bool:
+    if not (isinstance(shape, str) and shape.isdigit()):
+        return False
+    n = int(shape)
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def tune_plan_violations(files, tune_defs_path, fp_defs_path,
+                         aot_defs_path=None,
+                         manifests=()) -> list[Violation]:
+    """Both-direction cross-reference for the kernel autotuner: every
+    ``ARM_TABLE`` arm must route through a toggle actually defined in
+    ``fp.py`` (a ghost toggle can never route), and audited manifest
+    ``plan`` tables must verify under the store's signature algorithm
+    with every tuned shape a power-of-2 batch selecting a known,
+    range-proven arm and a registered AOT kernel."""
+    files = dict(files)
+    out: list[Violation] = []
+    src = files.get(tune_defs_path)
+    if src is None:
+        return out  # corpus without the autotuner: skip the family
+    arms = tune_plan_defs(src, tune_defs_path)
+    if not arms:
+        return [Violation(
+            rule="tune-plan", path=tune_defs_path, line=0,
+            symbol="ARM_TABLE",
+            message="ARM_TABLE missing or non-literal",
+        )]
+    fp_src = files.get(fp_defs_path)
+    if fp_src is not None:
+        tree = ast.parse(fp_src, filename=fp_defs_path)
+        toggles = {
+            n.name for n in tree.body if isinstance(n, ast.FunctionDef)
+        }
+        for arm_id, (_spec, toggle, _value, _proof, line) in sorted(
+                arms.items()):
+            if toggle not in toggles:
+                out.append(Violation(
+                    rule="tune-plan", path=tune_defs_path, line=line,
+                    symbol=arm_id,
+                    message=(
+                        f"arm {arm_id!r} routes through toggle {toggle!r} "
+                        f"which is not defined in {fp_defs_path} — a "
+                        f"ghost toggle can never route a plan"
+                    ),
+                ))
+    kernels: dict[str, int] = {}
+    aot_src = files.get(aot_defs_path) if aot_defs_path else None
+    if aot_src is not None:
+        kernels = aot_manifest_defs(aot_src, aot_defs_path)
+    if not manifests:
+        return out
+    import json
+
+    # the store's own signature algorithm — byte-identical, not a copy
+    from ..crypto.bls.jax_backend.aot import manifest_signature
+
+    for display, text in manifests:
+        try:
+            doc = json.loads(text)
+        except Exception:  # noqa: BLE001 — aot-manifest flags parse errors
+            continue
+        plan = doc.get("plan")
+        if plan is None:
+            continue  # an untuned store is fine
+        if not isinstance(plan, dict):
+            out.append(Violation(
+                rule="tune-plan", path=display, line=0, symbol="plan",
+                message="manifest plan is not a table",
+            ))
+            continue
+        if doc.get("plan_signature") != manifest_signature(plan):
+            out.append(Violation(
+                rule="tune-plan", path=display, line=0,
+                symbol="plan_signature",
+                message=(
+                    "plan table signature does not verify — tampered or "
+                    "hand-edited tuned plan (prewarm would boot cold)"
+                ),
+            ))
+        for fld in ("schema", "jax", "device_kind"):
+            if fld not in plan:
+                out.append(Violation(
+                    rule="tune-plan", path=display, line=0,
+                    symbol=f"plan.{fld}",
+                    message=(
+                        f"plan is missing the {fld!r} field install "
+                        f"currency keys on"
+                    ),
+                ))
+        shapes = plan.get("shapes")
+        if not isinstance(shapes, dict):
+            out.append(Violation(
+                rule="tune-plan", path=display, line=0,
+                symbol="plan.shapes",
+                message="plan has no shapes table",
+            ))
+            continue
+        for shape, entry in sorted(shapes.items()):
+            sym = f"plan.shapes[{shape}]"
+            if not _power_of_two_shape(shape):
+                out.append(Violation(
+                    rule="tune-plan", path=display, line=0, symbol=sym,
+                    message=(
+                        f"tuned shape {shape!r} is not a positive "
+                        f"power-of-2 batch (the dispatcher never pads "
+                        f"to it; warm_compile would reject it)"
+                    ),
+                ))
+            entry = entry if isinstance(entry, dict) else {}
+            arm_id = entry.get("arm")
+            if arm_id not in arms:
+                out.append(Violation(
+                    rule="tune-plan", path=display, line=0, symbol=sym,
+                    message=(
+                        f"plan selects unknown arm {arm_id!r} "
+                        f"(ARM_TABLE: {', '.join(sorted(arms))})"
+                    ),
+                ))
+            elif not arms[arm_id][3]:
+                out.append(Violation(
+                    rule="tune-plan", path=display, line=0, symbol=sym,
+                    message=(
+                        f"plan selects arm {arm_id!r} which names no "
+                        f"range-proof program — an unproven arm may "
+                        f"never serve"
+                    ),
+                ))
+            kern = entry.get("kernel")
+            if kernels and kern not in kernels:
+                out.append(Violation(
+                    rule="tune-plan", path=display, line=0, symbol=sym,
+                    message=(
+                        f"plan entry names unregistered kernel {kern!r} "
+                        f"(AOT_KERNELS: {', '.join(sorted(kernels))})"
+                    ),
+                ))
+    return out
+
+
 def run(
     files, docs, metrics_defs_path, faults_defs_path,
     site_scan_exclude=("tests/",), spec_validator=None,
@@ -1003,6 +1172,7 @@ def run(
     search_defs_path=None, traffic_defs_path=None,
     adversity_defs_path=None, partition_defs_path=None,
     aot_defs_path=None, aot_backend_defs_path=None, aot_manifests=(),
+    tune_defs_path=None, fp_defs_path=None,
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
@@ -1042,6 +1212,13 @@ def run(
             aot_backend_defs_path
             or "lighthouse_tpu/crypto/bls/jax_backend/backend.py",
             aot_manifests,
+        ))
+    if tune_defs_path is not None:
+        out.extend(tune_plan_violations(
+            files, tune_defs_path,
+            fp_defs_path
+            or "lighthouse_tpu/crypto/bls/jax_backend/fp.py",
+            aot_defs_path, aot_manifests,
         ))
     out.extend(serve_port_violations(docs))
     return out

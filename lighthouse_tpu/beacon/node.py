@@ -156,7 +156,10 @@ class BeaconNode:
         # AOT store's executables installed) HERE, in __init__ — before
         # start() opens the libp2p host, discovery, or the HTTP API, so
         # a prewarmed node never joins the network with a cold kernel
-        # cache.
+        # cache.  The store's autotuned kernel plan (when one matches
+        # this device kind × jax version) rides the same pass: prewarm
+        # installs it first, so the node serves the fastest range-proven
+        # arm for this silicon from the first dispatched batch.
         stack = build_verify_stack(
             pubkey_cache=getattr(self.chain, "pubkey_cache", None),
             injector=injector,

@@ -90,6 +90,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "restarted over a populated store performs "
                          "zero tracing-compiles of staged programs on "
                          "its serving path (requires --datadir)")
+    bn.add_argument("--tune", action="store_true",
+                    help="first-contact kernel autotune: run timed "
+                         "trials of every range-proven kernel arm "
+                         "across the batch-shape ladder on THIS "
+                         "device kind, persist the winning plan into "
+                         "the AOT store's signed manifest, and install "
+                         "it before any listener opens; subsequent "
+                         "boots reinstall the plan via --prewarm with "
+                         "zero trials (requires --datadir; "
+                         "LIGHTHOUSE_TPU_MXU overrides the plan when "
+                         "set)")
     bn.add_argument("--upnp", action="store_true",
                     help="attempt UPnP port mapping for p2p/discovery "
                          "(best-effort; nat.rs analog)")
@@ -282,6 +293,24 @@ def run_bn(args) -> int:
                 os.path.join(args.datadir, "aot_cache")
             )
             backend.attach_aot_store(aot_store)
+            if args.tune:
+                # First-contact tuning: measure every legal arm on this
+                # silicon and persist the plan BEFORE prewarm, so the
+                # prewarm pass below (and every later boot's) installs
+                # and loads against the tuned routing.  Best-effort: a
+                # failed tune costs this boot the plan, nothing else.
+                try:
+                    from .crypto.bls.jax_backend import autotune as _autotune
+
+                    t_tune = time.perf_counter()
+                    plan = _autotune.tune_and_store(aot_store)
+                    log_with(log, logging.INFO, "Kernel autotune done",
+                             device_kind=plan.get("device_kind"),
+                             shapes=len(plan.get("shapes", {})),
+                             wall_s=round(time.perf_counter() - t_tune, 3))
+                except Exception as exc:  # noqa: BLE001 — tune is optional
+                    log_with(log, logging.WARNING, "Kernel autotune failed",
+                             error=str(exc))
             if args.prewarm:
                 t_warm = time.perf_counter()
                 report = _aot.prewarm(
@@ -293,14 +322,14 @@ def run_bn(args) -> int:
                     report.to_row(), phase="prewarm",
                     wall_s=round(time.perf_counter() - t_warm, 3),
                 ))
-        elif args.prewarm:
+        elif args.prewarm or args.tune:
             log_with(log, logging.WARNING,
-                     "--prewarm: active BLS backend has no AOT seam",
+                     "--prewarm/--tune: active BLS backend has no AOT seam",
                      backend=getattr(backend, "name", "?"))
-    elif args.prewarm:
+    elif args.prewarm or args.tune:
         log_with(log, logging.WARNING,
-                 "--prewarm needs --datadir (the store lives under it); "
-                 "skipping")
+                 "--prewarm/--tune needs --datadir (the store lives under "
+                 "it); skipping")
     h = BeaconChainHarness(n_validators=args.validators, spec=spec, store=store)
     server = BeaconApiServer(h.chain, port=args.http_port)
     server.start()

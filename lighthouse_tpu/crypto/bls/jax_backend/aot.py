@@ -39,7 +39,7 @@ import time
 from dataclasses import dataclass, field
 
 from ....obs.tracer import TRACER
-from ....utils import get_logger, log_with
+from ....utils import device_kind, get_logger, log_with
 from ....utils.metrics import (
     AOT_CACHE_HITS,
     AOT_CACHE_MISSES,
@@ -72,11 +72,19 @@ AOT_KERNELS = (
 
 
 def manifest_signature(entries: dict) -> str:
-    """Deterministic signature over the entries table (see
-    MANIFEST_DOMAIN).  Shared with the ``aot-manifest`` lint family so
-    an audited manifest is checked with the byte-identical algorithm."""
+    """Deterministic signature over a manifest table (see
+    MANIFEST_DOMAIN) — used for both the ``entries`` index and the
+    autotuned ``plan`` (each carries its own signature, so tampering
+    with either is detected independently).  Shared with the
+    ``aot-manifest`` / ``tune-plan`` lint families so an audited
+    manifest is checked with the byte-identical algorithm."""
     blob = json.dumps(entries, sort_keys=True, separators=(",", ":"))
     return hashlib.sha256((MANIFEST_DOMAIN + blob).encode()).hexdigest()
+
+
+# Sentinel for _write_manifest: "keep whatever plan the manifest already
+# holds" (capture must not drop a tuned plan when it re-signs entries).
+_KEEP_PLAN = object()
 
 
 _EXPORT_TYPES_REGISTERED = [False]
@@ -154,12 +162,56 @@ class AotStore:
                      path=self.manifest_path, error=str(exc))
             return {}
 
-    def _write_manifest(self, entries: dict) -> None:
+    def _read_doc(self) -> dict:
+        """Raw manifest document, ``{}`` on any problem (never-raise;
+        signature checks happen in :meth:`entries` / :meth:`plan`)."""
+        if not os.path.exists(self.manifest_path):
+            return {}
+        try:
+            with open(self.manifest_path, encoding="utf-8") as f:
+                return json.load(f)
+        except Exception:  # noqa: BLE001 — never-raise read path
+            return {}
+
+    def plan(self) -> dict:
+        """The signature-verified autotuned kernel plan
+        (autotune.tune's output: device kind × jax version × per-shape
+        winning arms), or ``{}`` when absent, corrupt, or tampered —
+        a bad plan behaves exactly like a cold boot."""
+        doc = self._read_doc()
+        plan = doc.get("plan")
+        if not isinstance(plan, dict) or not plan:
+            return {}
+        if doc.get("plan_signature") != manifest_signature(plan):
+            AOT_CACHE_REJECTS.inc()
+            log_with(log, 30, "AOT plan rejected",
+                     path=self.manifest_path, error="plan signature mismatch")
+            return {}
+        return plan
+
+    def write_plan(self, plan: dict | None) -> None:
+        """Persist (or clear, with ``None``) the autotuned plan alongside
+        the entries table.  The entries index is re-read and re-signed
+        through the verified path, so a tuner can never launder a
+        tampered entries table by writing a plan."""
+        os.makedirs(self.root, exist_ok=True)
+        self._write_manifest(self.entries(), plan=plan or None)
+
+    def _write_manifest(self, entries: dict, plan=_KEEP_PLAN) -> None:
+        if plan is _KEEP_PLAN:
+            # capture() rewrites entries; keep the tuned plan it rides
+            # with — but only if the plan still verifies (a tampered
+            # plan must not get re-signed into legitimacy).
+            held = self.plan()
+            plan = held or None
         doc = {
             "schema": MANIFEST_SCHEMA,
             "entries": entries,
             "signature": manifest_signature(entries),
         }
+        if plan:
+            doc["plan"] = plan
+            doc["plan_signature"] = manifest_signature(plan)
         tmp = self.manifest_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f, sort_keys=True, indent=1)
@@ -249,12 +301,14 @@ class PrewarmReport:
     rejected: list = field(default_factory=list)   # failed integrity/deser
     stale: list = field(default_factory=list)      # other jax/backend/config
     compiled: list = field(default_factory=list)   # misses trace-compiled
+    plan_shapes: int = 0                           # tuned shapes installed
     seconds: float = 0.0
 
     def to_row(self) -> dict:
         return {
             "loaded": len(self.loaded), "rejected": len(self.rejected),
             "stale": len(self.stale), "compiled": len(self.compiled),
+            "plan_shapes": self.plan_shapes,
             "seconds": round(self.seconds, 3),
         }
 
@@ -276,7 +330,14 @@ def _entry_current(meta: dict, backend) -> bool:
     if len(key) == 3 and key[0] != "agg":
         if bool(key[1]) != bool(getattr(backend, "device_h2c", key[1])):
             return False
-        if bool(key[2]) != F.mxu_enabled():
+        try:
+            batch = int(key[0])
+        except (TypeError, ValueError):
+            return False
+        # plan-aware: the arm the dispatcher will ask for at this batch
+        # shape (installed tuned plan, unless an override forces one arm
+        # for every shape — see fp.mxu_for_batch).
+        if bool(key[2]) != F.mxu_for_batch(batch):
             return False
     return True
 
@@ -292,9 +353,20 @@ def prewarm(backend, store: AotStore, *, compile_misses: bool = False,
     stale (the fingerprint the backend would ask for differs anyway);
     corrupt entries are rejected by :meth:`AotStore.load` and — when
     ``compile_misses`` — re-compiled through the normal traced path so
-    the store heals itself on the next capture."""
+    the store heals itself on the next capture.
+
+    The autotuned kernel plan installs FIRST: entry currency is judged
+    against the plan-resolved arm per batch shape, so the loaded set is
+    exactly the programs the tuned dispatcher will ask for.  A stale or
+    tampered plan installs nothing and the pass proceeds on the
+    env/default arm (cold-plan behavior)."""
     report = PrewarmReport()
     t0 = time.perf_counter()
+    plan = store.plan()
+    if plan:
+        from . import autotune
+
+        report.plan_shapes = autotune.install_plan(plan)
     entries = store.entries()
     for fp_hex, meta in sorted(entries.items()):
         if not _entry_current(meta, backend):
@@ -345,6 +417,7 @@ def record_boot_row(row: dict, path: str | None = None) -> None:
             )
         out = {
             "kind": "boot",
+            "device_kind": device_kind(),
             "measured_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),

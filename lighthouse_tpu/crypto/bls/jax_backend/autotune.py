@@ -1,0 +1,332 @@
+"""Per-device-kind kernel autotuner: measured arm × batch-shape plans.
+
+The backend now has multiple proven kernel *arms* — the VPU 26×15-bit
+plane (``fp.py``), the MXU 31×13-bit dot-product core (``pallas_mxu.py``)
+— and RANGE_REPORT.json proves a 43×9-bit split would fit the f32 MXU
+path.  Until this module, the serving path picked one statically via
+``LIGHTHOUSE_TPU_MXU``, so every boot on unfamiliar silicon served a
+guess.  The tuner here turns that guess into a measurement:
+
+1. **Arm registry** (``ARM_TABLE``): arm id → LimbSpec plane, ``fp``
+   routing toggle, toggle value, and the RANGE_REPORT.json program whose
+   clearance the arm requires.  The table is a pure literal — the
+   ``tune-plan`` lint family (``analysis/registry_lint.py``) AST-parses
+   it and cross-checks toggles against ``fp.py`` and plan kernels
+   against ``AOT_KERNELS`` without importing jax.  A future GPU
+   (Pallas-Triton) arm is a row here, not a fork.
+2. **Legality gate** (``proven_arms``): an arm may enter trials only if
+   its proof program is range_lint-proven (``contracts_ok``) at zero
+   range-family waivers.  Unproven arms never run, even off-plan.
+3. **Trial harness** (``trial``): the shared padding/tiling microbench
+   from ``BENCH_MXU`` — one jitted ``pallas_fp.mont_mul_limbs``
+   dispatch per call, identical operands for every arm, best-of-iters.
+   The timer is injectable (same pattern as the serve batcher's fake
+   clock) so fast-tier tests tune deterministically on CPU.
+4. **Plan** (``tune`` / ``tune_and_store``): per batch shape, the
+   winning arm plus its trial timings, keyed by (device kind × jax
+   version) and persisted into the AOT store's signed manifest
+   (``AotStore.write_plan``).  ``prewarm`` installs the plan before any
+   listener opens (``install_plan`` → ``fp.install_mxu_plan``), so the
+   arm is resolved at install/compile time — zero online experiments,
+   zero per-batch dispatch overhead.
+
+Override precedence (see ``fp.mxu_enabled``): ``fp.set_mxu`` in-process
+A/B > ``LIGHTHOUSE_TPU_MXU`` env flag > installed plan > off.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from dataclasses import dataclass
+
+from ....utils import device_kind, get_logger, log_with
+
+log = get_logger("bls.autotune")
+
+# ---------------------------------------------------------------------------
+# Arm registry.  Pure literal: the tune-plan lint family AST-parses this
+# tuple (never imports the module), exactly like AOT_KERNELS / SPANS.
+# Fields: (arm id, LimbSpec name in limbs.py, fp routing toggle, toggle
+# value, RANGE_REPORT.json program the arm's legality rides on; "" marks
+# an arm that may never enter trials).
+# ---------------------------------------------------------------------------
+
+ARM_TABLE = (
+    ("vpu15", "SPEC15", "set_mxu", False, "pallas_mont_mul"),
+    ("mxu13", "SPEC13", "set_mxu", True, "mxu_mont_mul"),
+)
+
+PLAN_SCHEMA = 1
+
+# Default batch-shape ladders: the compiled shapes the serving path
+# actually dispatches (bench headline ladder on device; two cheap shapes
+# under interpret mode elsewhere).
+TPU_SHAPES = (512, 4096, 8192)
+CPU_SHAPES = (64, 128)
+
+
+@dataclass(frozen=True)
+class Arm:
+    """One kernel arm: a routed limb plane plus its range-proof bond."""
+
+    arm: str      # registry id ("vpu15", "mxu13", ...)
+    spec: str     # LimbSpec name in limbs.py (limbs.SPECS key)
+    toggle: str   # fp.py routing setter consulted by the traced program
+    value: bool   # what the toggle must hold while this arm traces
+    proof: str    # RANGE_REPORT.json program name; "" = unproven
+
+
+ARMS: tuple[Arm, ...] = tuple(Arm(*row) for row in ARM_TABLE)
+
+
+def arm_by_id(arm_id: str) -> Arm | None:
+    for a in ARMS:
+        if a.arm == arm_id:
+            return a
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Legality: range_lint-proven at zero waivers.
+# ---------------------------------------------------------------------------
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.abspath(os.path.join(_HERE, "..", "..", "..", ".."))
+RANGE_REPORT_PATH = os.path.join(_REPO_ROOT, "RANGE_REPORT.json")
+WAIVERS_PATH = os.path.join(
+    _REPO_ROOT, "lighthouse_tpu", "analysis", "waivers.toml"
+)
+
+_RANGE_RULES = ("range-overflow", "range-contract", "range-lfp", "range-report")
+
+
+def _range_waiver_count(waivers_path: str) -> int:
+    """Number of range-family waivers on file.  Any > 0 voids every
+    arm's clearance: "proven at zero waivers" is the legality bar, and a
+    waived range finding means the proof no longer stands on its own."""
+    if not os.path.exists(waivers_path):
+        return 0
+    from ....analysis.waivers import load_waivers
+
+    return sum(
+        1
+        for w in load_waivers(waivers_path)
+        if any(fn_match(w.rule, rule) for rule in _RANGE_RULES)
+    )
+
+
+def fn_match(pattern: str, name: str) -> bool:
+    from fnmatch import fnmatchcase
+
+    return fnmatchcase(name, pattern)
+
+
+def proven_arms(
+    report_path: str = RANGE_REPORT_PATH,
+    waivers_path: str = WAIVERS_PATH,
+) -> tuple[Arm, ...]:
+    """The arms legal to tune: proof program present in RANGE_REPORT.json
+    with ``contracts_ok`` true, and zero range-family waivers on file.
+    An arm with no proof program (``proof == ""``) is never legal."""
+    try:
+        with open(report_path, encoding="utf-8") as f:
+            programs = json.load(f).get("programs", {})
+    except (OSError, ValueError):
+        return ()
+    if _range_waiver_count(waivers_path):
+        return ()
+    out = []
+    for arm in ARMS:
+        if not arm.proof:
+            continue
+        prog = programs.get(arm.proof)
+        if isinstance(prog, dict) and prog.get("contracts_ok") is True:
+            out.append(arm)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Trial harness: the BENCH_MXU padding/tiling microbench with an
+# injectable timer (serve-batcher fake-clock pattern: ctor-style
+# ``timer=time.perf_counter`` default, tests pass a stub).
+# ---------------------------------------------------------------------------
+
+
+def trial(
+    arm: Arm,
+    batch: int,
+    *,
+    iters: int = 3,
+    timer=time.perf_counter,
+    interpret: bool | None = None,
+) -> float:
+    """Best-of-``iters`` seconds for one jitted Montgomery-multiply
+    dispatch under ``arm`` at ``batch`` lanes.  Identical rng operands
+    and padding/tiling for every arm (only the routed plane differs), so
+    timings are comparable across the registry.  The arm's toggle is
+    pinned around compile+measure and restored exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ....obs.tracer import TRACER
+    from . import fp as F
+    from . import pallas_fp as PF
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0xA17)
+    a = jnp.asarray(rng.integers(0, 1 << 15, size=(26, batch), dtype=np.int64).astype(np.uint32))
+    b = jnp.asarray(rng.integers(0, 1 << 15, size=(26, batch), dtype=np.int64).astype(np.uint32))
+    setter = getattr(F, arm.toggle)
+    prev = setter(arm.value)
+    try:
+        fn = jax.jit(functools.partial(PF.mont_mul_limbs, interpret=interpret))
+        fn(a, b).block_until_ready()  # compile outside the timed window
+        best = float("inf")
+        with TRACER.span("autotune.trial", arm=arm.arm, batch=batch):
+            for _ in range(max(1, iters)):
+                t0 = timer()
+                fn(a, b).block_until_ready()
+                best = min(best, timer() - t0)
+    finally:
+        setter(prev)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# The tuner: trials → plan → persist/install.
+# ---------------------------------------------------------------------------
+
+
+def default_shapes() -> tuple[int, ...]:
+    import jax
+
+    return TPU_SHAPES if jax.default_backend() == "tpu" else CPU_SHAPES
+
+
+def tune(
+    shapes=None,
+    *,
+    arms=None,
+    measure=None,
+    iters: int = 3,
+    timer=time.perf_counter,
+    kernel: str = "_verify_kernel",
+) -> dict:
+    """Run timed trials of every legal arm across the batch-shape ladder
+    and return the winning plan (not yet persisted — see
+    ``tune_and_store``).  ``measure(arm, batch) -> seconds`` is
+    injectable for deterministic tests; the default is the real
+    ``trial`` harness with the given ``timer``.  Arms passed explicitly
+    are still filtered through the legality gate: an unproven arm never
+    enters trials."""
+    import jax
+
+    legal = proven_arms()
+    if arms is not None:
+        allowed = {a.arm for a in legal}
+        legal = tuple(a for a in arms if a.arm in allowed and a.proof)
+    if not legal:
+        raise ValueError("no range-proven arms to tune over")
+    if shapes is None:
+        shapes = default_shapes()
+    if measure is None:
+        measure = functools.partial(trial, iters=iters, timer=timer)
+    plan: dict = {
+        "schema": PLAN_SCHEMA,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": device_kind(),
+        "shapes": {},
+    }
+    for batch in shapes:
+        trials = {arm.arm: float(measure(arm, int(batch))) for arm in legal}
+        winner = min(trials, key=lambda k: (trials[k], k))
+        plan["shapes"][str(int(batch))] = {
+            "arm": winner,
+            "kernel": kernel,
+            "trials_ms": {k: round(v * 1e3, 6) for k, v in trials.items()},
+        }
+        log_with(
+            log,
+            20,
+            "autotune trial",
+            batch=int(batch),
+            winner=winner,
+            trials_ms=plan["shapes"][str(int(batch))]["trials_ms"],
+        )
+    return plan
+
+
+def tune_and_store(store, **tune_kw) -> dict:
+    """Tune, persist the plan into ``store``'s signed manifest, and
+    install it in-process.  The next ``prewarm`` against the same store
+    (same device kind × jax version) reinstalls it with zero trials."""
+    plan = tune(**tune_kw)
+    store.write_plan(plan)
+    install_plan(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Plan install: resolve the plan into fp's per-shape routing map.
+# ---------------------------------------------------------------------------
+
+
+def plan_current(plan: dict) -> bool:
+    """A plan binds only on the exact (device kind × jax version) pair it
+    was measured on; anything else behaves cold (stale-plan rejection)."""
+    import jax
+
+    return (
+        isinstance(plan, dict)
+        and plan.get("schema") == PLAN_SCHEMA
+        and plan.get("jax") == jax.__version__
+        and plan.get("device_kind") == device_kind()
+        and isinstance(plan.get("shapes"), dict)
+    )
+
+
+def install_plan(plan: dict) -> int:
+    """Install a tuned plan into ``fp``'s routing map.  Returns the
+    number of shapes installed (0 = stale/invalid plan, nothing
+    installed, boot behaves cold).  The largest tuned shape's arm also
+    becomes the ``"*"`` default so off-ladder programs (e.g. the sharded
+    epoch kernel) follow the headline arm."""
+    from . import fp as F
+
+    if not plan_current(plan):
+        return 0
+    shapes: dict = {}
+    for key, entry in plan["shapes"].items():
+        try:
+            batch = int(key)
+        except (TypeError, ValueError):
+            continue
+        arm = arm_by_id(entry.get("arm", "")) if isinstance(entry, dict) else None
+        if arm is None or arm.toggle != "set_mxu" or not arm.proof:
+            continue
+        shapes[batch] = bool(arm.value)
+    if not shapes:
+        return 0
+    shapes["*"] = shapes[max(k for k in shapes if isinstance(k, int))]
+    F.install_mxu_plan(shapes)
+    log_with(
+        log,
+        20,
+        "autotune plan installed",
+        shapes=len(shapes) - 1,
+        device_kind=plan.get("device_kind"),
+    )
+    return len(shapes) - 1
+
+
+def clear_plan() -> None:
+    """Drop any installed plan (tests; ``fp`` falls back to env/default)."""
+    from . import fp as F
+
+    F.install_mxu_plan(None)

@@ -362,6 +362,24 @@ def _neg_gen_const():
     return P.g1_encode([ng])
 
 
+def _pin_mxu(fn, mxu: bool):
+    """Trace ``fn`` under a pinned kernel arm.  The routed plane is read
+    by the program body at TRACE time (``fp.mxu_active`` inside the
+    Montgomery products), so a per-shape plan that differs from the
+    process-wide gate must hold the toggle around the traced call; the
+    override is restored exactly, and compiled executions skip the
+    Python body entirely — the pin costs nothing after the first call."""
+    def armed(*args):
+        prev = F.set_mxu(mxu)
+        try:
+            return fn(*args)
+        finally:
+            F.set_mxu(prev)
+
+    armed.__name__ = fn.__name__
+    return armed
+
+
 class JaxBackend:
     """Device batch verification backend, registered as "jax"."""
 
@@ -384,18 +402,25 @@ class JaxBackend:
         self.device_h2c = device_h2c
 
     def _kernel(self, B: int):
-        # mxu joins the cache key AND the compile fingerprint: flipping
-        # LIGHTHOUSE_TPU_MXU (bench A/Bs use set_mxu in-process) selects
-        # a different Mosaic program for every Montgomery product in the
-        # trace, so a stale cached executable would silently A/A.
-        key = (B, self.device_h2c, F.mxu_enabled())
+        # The arm (mxu) joins the cache key AND the compile fingerprint:
+        # a different arm means a different Mosaic program for every
+        # Montgomery product in the trace, so a stale cached executable
+        # would silently A/A.  The arm itself is resolved per padded
+        # batch shape through the installed autotuned plan
+        # (fp.mxu_for_batch); set_mxu / LIGHTHOUSE_TPU_MXU remain
+        # explicit overrides and force one arm for every shape.  Plan
+        # resolution happens HERE, at lookup/compile time — a cache hit
+        # never consults it again, so tuned routing costs nothing per
+        # dispatched batch.
+        mxu = F.mxu_for_batch(B)
+        key = (B, self.device_h2c, mxu)
         if key not in self._kernels:
             import jax
 
             fn = _verify_kernel_h2c if self.device_h2c else _verify_kernel
             fp_hex = program_fingerprint(
                 fn.__name__, B=B, device_h2c=self.device_h2c,
-                mxu=F.mxu_enabled(),
+                mxu=mxu,
             )
             # Store-first: a cache miss consults the attached AOT store
             # before paying a tracing-compile — a populated store makes
@@ -411,7 +436,7 @@ class JaxBackend:
             if jax.default_backend() == "tpu":
                 donate = tuple(range(5 if self.device_h2c else 4))
             self._kernels[key] = traced_jit(
-                fn, fp_hex,
+                _pin_mxu(fn, mxu), fp_hex,
                 capture=self._aot_capture(key, fn.__name__),
                 donate_argnums=donate,
             )

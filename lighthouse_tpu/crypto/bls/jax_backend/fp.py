@@ -498,28 +498,82 @@ def miller_fused_active() -> bool:
 
 
 _MXU_MODE: bool | None = None
+_MXU_PLAN: dict | None = None
 
 
 def mxu_enabled() -> bool:
-    """LIGHTHOUSE_TPU_MXU=1 routes every Montgomery product — the
-    standalone mont_mul kernel, the megachains, and the fused Miller
-    loop — through the 13-bit re-limbed dot-product core
-    (pallas_mxu.py) that runs the schoolbook column accumulation on the
-    MXU instead of the VPU.  Interpret-proven byte-identical to the VPU
-    kernels and range-proven under the int32 2^31 MXU budget; flips to
-    default-on once the on-chip A/B (tpu_keeper agenda r6) lands."""
+    """Routes every Montgomery product — the standalone mont_mul kernel,
+    the megachains, and the fused Miller loop — through the 13-bit
+    re-limbed dot-product core (pallas_mxu.py) that runs the schoolbook
+    column accumulation on the MXU instead of the VPU.  Interpret-proven
+    byte-identical to the VPU kernels and range-proven under the int32
+    2^31 MXU budget.
+
+    Resolution precedence: ``set_mxu`` in-process override (A/B sweeps)
+    > ``LIGHTHOUSE_TPU_MXU`` env flag (explicit operator override) >
+    installed autotuned plan default (``install_mxu_plan``, the largest
+    tuned shape's arm) > off.  The env flag is read live — an unset flag
+    never latches, so a plan installed later (prewarm) is not shadowed."""
+    if _MXU_MODE is not None:
+        return _MXU_MODE
+    import os
+
+    env = os.environ.get("LIGHTHOUSE_TPU_MXU")
+    if env is not None:
+        return env == "1"
+    if _MXU_PLAN is not None:
+        default = _MXU_PLAN.get("*")
+        if default is not None:
+            return bool(default)
+    return False
+
+
+def set_mxu(enabled: bool | None) -> bool | None:
+    """In-process A/B override (mirrors set_chains).  Beats both the env
+    flag and any installed autotuned plan; ``None`` clears the override.
+    Returns the previous override so callers can restore it exactly."""
     global _MXU_MODE
-    if _MXU_MODE is None:
-        import os
-
-        _MXU_MODE = os.environ.get("LIGHTHOUSE_TPU_MXU", "") == "1"
-    return _MXU_MODE
+    prev = _MXU_MODE
+    _MXU_MODE = None if enabled is None else bool(enabled)
+    return prev
 
 
-def set_mxu(enabled: bool) -> None:
-    """In-process A/B toggle (mirrors set_chains)."""
-    global _MXU_MODE
-    _MXU_MODE = enabled
+def install_mxu_plan(shapes: dict | None) -> None:
+    """Install the autotuned per-shape arm plan (autotune.install_plan's
+    seam): ``shapes`` maps padded batch size -> route-through-MXU, plus
+    an optional ``"*"`` default for off-plan shapes.  ``None`` clears.
+    Overrides (``set_mxu`` / ``LIGHTHOUSE_TPU_MXU``) still win — see
+    ``mxu_enabled``."""
+    global _MXU_PLAN
+    _MXU_PLAN = dict(shapes) if shapes else None
+
+
+def mxu_planned(batch) -> bool | None:
+    """The installed plan's arm for padded batch ``batch``, or ``None``
+    when no plan binds that shape or an explicit override (set_mxu / env
+    flag) is active — overrides force one arm for *every* shape."""
+    if _MXU_MODE is not None:
+        return None
+    import os
+
+    if os.environ.get("LIGHTHOUSE_TPU_MXU") is not None:
+        return None
+    if _MXU_PLAN is None:
+        return None
+    routed = _MXU_PLAN.get(batch)
+    if routed is None:
+        routed = _MXU_PLAN.get("*")
+    return None if routed is None else bool(routed)
+
+
+def mxu_for_batch(batch) -> bool:
+    """The arm the compiled program for padded batch ``batch`` should
+    trace under: the planned arm when a plan binds, the process-wide
+    gate otherwise.  This is what ``JaxBackend._kernel`` keys its cache
+    and fingerprints on — the plan is resolved here, at lookup/compile
+    time, never per dispatched batch."""
+    planned = mxu_planned(batch)
+    return mxu_enabled() if planned is None else planned
 
 
 def mxu_active() -> bool:

@@ -134,6 +134,17 @@ assert SPEC13.n * QMAX13 * QMAX13 < 1 << 31
 # ...and 14 bits is not, even at strict limbs (ceil(381/14) = 28 limbs):
 assert 28 * ((1 << 14) - 1) ** 2 >= 1 << 31
 
+# Named-plane registry: the kernel-arm table (autotune.ARM_TABLE) binds
+# each arm to a LimbSpec by NAME so the tune-plan lint can cross-check
+# the binding without importing jax.  A future plane (e.g. the
+# RANGE_REPORT-proven 43×9-bit f32 split — note 9 ∤ 390, so it needs a
+# relaxed radix contract before it can be a LimbSpec) registers here and
+# in ARM_TABLE, nowhere else.
+SPECS: dict[str, LimbSpec] = {
+    "SPEC15": SPEC15,
+    "SPEC13": SPEC13,
+}
+
 
 def convert(limbs, src: LimbSpec, dst: LimbSpec) -> np.ndarray:
     """Exact value-preserving re-limb (host reference codec).

@@ -33,8 +33,12 @@ two planes can disagree by exactly R there (output shifted by one P,
 still in-envelope).  The differential corpus in tests/test_pallas_fp.py
 pins byte-identity on random + all-QMAX inputs.
 
-Enable with LIGHTHOUSE_TPU_MXU=1 (fp.mont_mul, the megachains, and the
-fused Miller loop all route through fp.mxu_enabled()).
+Routing: fp.mont_mul, the megachains, and the fused Miller loop all
+route through fp.mxu_enabled().  This plane is the ``mxu13`` arm of the
+kernel-arm registry (autotune.ARM_TABLE): on a tuned boot the installed
+per-device-kind plan decides per batch shape whether programs trace
+through it (fp.mxu_for_batch), with LIGHTHOUSE_TPU_MXU=1 / fp.set_mxu
+demoted to explicit overrides that force it everywhere.
 """
 
 from __future__ import annotations

@@ -60,6 +60,8 @@ SPANS: dict[str, str] = {
     # AOT executable store (crypto/bls/jax_backend/aot.py)
     "aot.capture": "export+serialize of a just-compiled staged program",
     "prewarm.load": "AOT store load+install of one program at warm boot",
+    # kernel autotuner (crypto/bls/jax_backend/autotune.py)
+    "autotune.trial": "timed arm x batch-shape microbench (best-of-iters)",
     # scenario engine virtual slots (scenario/engine.py)
     "scenario.slot": "one virtual slot of a scenario run",
     # vectorized ingest engine (ingest/engine.py)

@@ -431,8 +431,11 @@ class ScenarioEngine:
         return report
 
     def _record_history(self, report: dict) -> None:
+        from ..utils import device_kind
+
         entry = {
             "kind": "scenario",
+            "device_kind": device_kind(),
             "measured_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),

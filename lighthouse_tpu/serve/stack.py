@@ -90,7 +90,11 @@ def build_verify_stack(pubkey_cache=None, injector=None,
     prewarm:
         Install every current store entry into the backend's kernel
         cache NOW — before this function returns, so before any caller
-        can open a listener over the stack.  The report lands on the
+        can open a listener over the stack.  When the store's manifest
+        carries an autotuned kernel plan for this (device kind × jax
+        version), the plan installs first (``PrewarmReport.plan_shapes``
+        counts the shapes), so the loaded programs are exactly the arms
+        the tuned dispatcher will ask for.  The report lands on the
         returned stack's ``prewarm_report``.
     """
     from ..beacon.processor import CircuitBreaker, ResilientVerifier

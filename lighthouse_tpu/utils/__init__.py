@@ -14,3 +14,19 @@ from .faults import (  # noqa: F401
 from .logging import TimeLatch, get_logger, log_with, recent_logs  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, render  # noqa: F401
 from .slot_clock import ManualSlotClock, SlotClock, SystemTimeSlotClock  # noqa: F401
+
+
+def device_kind() -> str:
+    """The silicon identity bench rows and autotuned kernel plans join
+    on: the accelerator's ``device_kind`` (e.g. ``"TPU v4"``) when a
+    device is visible, the jax platform name (``"cpu"``) otherwise, and
+    ``"host"`` when jax is unavailable entirely.  Never raises — this is
+    called from history writers that must not take a process down."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        kind = getattr(devices[0], "device_kind", "") if devices else ""
+        return str(kind) or str(jax.default_backend())
+    except Exception:  # noqa: BLE001 — identity probe is best-effort
+        return "host"

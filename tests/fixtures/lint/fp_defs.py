@@ -1,0 +1,12 @@
+"""Fixture routing-toggle surface for the tune-plan family: the module
+standing in for fp.py.  Defines ``set_fixture`` (the toggle the good and
+unproven arms route through); ``set_missing`` is deliberately absent so
+the ghost-toggle arm in tune_defs.py seeds its finding."""
+
+_FIXTURE_MODE = [False]
+
+
+def set_fixture(enabled):
+    prev = _FIXTURE_MODE[0]
+    _FIXTURE_MODE[0] = bool(enabled)
+    return prev

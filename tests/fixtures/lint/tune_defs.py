@@ -1,0 +1,11 @@
+"""Fixture kernel-arm registry for the tune-plan family: one arm that
+routes through a toggle defined in fp_defs.py and carries a range-proof
+program; one whose toggle is a ghost (the family must flag it — a ghost
+toggle can never route a plan); and one with no proof program at all
+(legal to register, but any plan that SELECTS it is a finding)."""
+
+ARM_TABLE = (
+    ("fix_good", "SPECF", "set_fixture", True, "fixture_prog"),
+    ("fix_ghost", "SPECF", "set_missing", False, "fixture_prog"),
+    ("fix_unproven", "SPECF", "set_fixture", False, ""),
+)

@@ -1,0 +1,307 @@
+"""Per-device-kind kernel autotuner (jax_backend/autotune.py).
+
+The fast tier pins the full plan lifecycle deterministically on CPU —
+stubbed ``measure`` / injected timer, no real arm timings: legality
+gating (range-proven at zero waivers), per-shape winner selection,
+persistence into the AOT store's signed manifest, cold-restart reinstall
+with zero tracing-compiles, stale/tampered-plan rejection (cold-boot
+behavior), and the override precedence contract (``set_mxu`` >
+``LIGHTHOUSE_TPU_MXU`` > plan > off).  One test runs the real trial
+harness (interpret-mode Pallas at B=8) to keep it honest.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto.bls.jax_backend import aot, autotune
+from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+from lighthouse_tpu.crypto.bls.jax_backend.backend import (
+    JaxBackend,
+    program_fingerprint,
+    traced_jit,
+)
+from lighthouse_tpu.utils import device_kind
+from lighthouse_tpu.utils.metrics import JIT_COMPILE_SECONDS
+
+VPU, MXU = autotune.ARMS  # ("vpu15", ...), ("mxu13", ...)
+
+
+@pytest.fixture(autouse=True)
+def _clean_routing(monkeypatch):
+    """Every test starts and ends with no override, no env flag, and no
+    installed plan — the routing state is process-global."""
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MXU", raising=False)
+    prev = F.set_mxu(None)
+    F.install_mxu_plan(None)
+    yield
+    F.set_mxu(prev)
+    F.install_mxu_plan(None)
+
+
+def _measure_by_shape(winners: dict):
+    """Stub ``measure(arm, batch) -> seconds``: the arm named in
+    ``winners[batch]`` gets 1ms, every other arm 2ms."""
+    def measure(arm, batch):
+        return 0.001 if winners[batch] == arm.arm else 0.002
+
+    return measure
+
+
+# ---------------------------------------------------------------------------
+# Selection: measured winner per shape, deterministic under a stub
+# ---------------------------------------------------------------------------
+
+
+def test_tune_selects_measured_winner_per_shape():
+    plan = autotune.tune(
+        shapes=(64, 128),
+        measure=_measure_by_shape({64: "vpu15", 128: "mxu13"}),
+    )
+    assert plan["schema"] == autotune.PLAN_SCHEMA
+    assert plan["jax"] == jax.__version__
+    assert plan["device_kind"] == device_kind()
+    assert plan["shapes"]["64"]["arm"] == "vpu15"
+    assert plan["shapes"]["128"]["arm"] == "mxu13"
+    # every legal arm was trialled at every shape, timings on record
+    for entry in plan["shapes"].values():
+        assert set(entry["trials_ms"]) == {"vpu15", "mxu13"}
+        assert entry["kernel"] == "_verify_kernel"
+
+
+def test_tune_is_deterministic_under_equal_timings():
+    # exact ties break lexicographically, not by dict order
+    p1 = autotune.tune(shapes=(64,), measure=lambda a, b: 0.001)
+    p2 = autotune.tune(shapes=(64,), measure=lambda a, b: 0.001)
+    assert p1["shapes"] == p2["shapes"]
+    assert p1["shapes"]["64"]["arm"] == "mxu13"  # min lexicographic id
+
+
+def test_install_plan_routes_per_shape_with_largest_as_default():
+    plan = autotune.tune(
+        shapes=(8, 64),
+        measure=_measure_by_shape({8: "vpu15", 64: "mxu13"}),
+    )
+    assert autotune.install_plan(plan) == 2
+    assert F.mxu_for_batch(8) is False
+    assert F.mxu_for_batch(64) is True
+    # off-ladder shapes follow the largest tuned shape's arm
+    assert F.mxu_for_batch(4096) is True
+    assert F.mxu_enabled() is True
+    autotune.clear_plan()
+    assert F.mxu_for_batch(64) is False
+
+
+# ---------------------------------------------------------------------------
+# Legality: unproven arms never enter trials
+# ---------------------------------------------------------------------------
+
+
+def test_unproven_arm_never_enters_trials():
+    ghost = autotune.Arm("ghost9", "SPEC15", "set_mxu", False, "")
+    ran = []
+
+    def measure(arm, batch):
+        ran.append(arm.arm)
+        return 0.001
+
+    plan = autotune.tune(shapes=(64,), arms=[ghost, MXU], measure=measure)
+    assert "ghost9" not in ran
+    assert set(plan["shapes"]["64"]["trials_ms"]) == {"mxu13"}
+    # nothing legal at all -> refuse to tune rather than guess
+    with pytest.raises(ValueError):
+        autotune.tune(shapes=(64,), arms=[ghost], measure=measure)
+
+
+def test_unregistered_arm_filtered_even_with_proof_claim():
+    # an arm not in the proven set (unknown proof program) is excluded
+    rogue = autotune.Arm("rogue1", "SPEC15", "set_mxu", True, "no_such_prog")
+    with pytest.raises(ValueError):
+        autotune.tune(shapes=(64,), arms=[rogue], measure=lambda a, b: 0.001)
+
+
+def test_proven_arms_require_contracts_ok_at_zero_waivers(tmp_path):
+    report = tmp_path / "range.json"
+    waivers = tmp_path / "waivers.toml"
+    report.write_text(json.dumps({"programs": {
+        "pallas_mont_mul": {"contracts_ok": True},
+        "mxu_mont_mul": {"contracts_ok": False},
+    }}))
+    got = autotune.proven_arms(str(report), str(waivers))
+    assert [a.arm for a in got] == ["vpu15"]
+    # one range-family waiver voids every arm's clearance
+    waivers.write_text(
+        '[[waiver]]\nrule = "range-overflow"\npath = "*"\n'
+        'reason = "test"\n'
+    )
+    assert autotune.proven_arms(str(report), str(waivers)) == ()
+    # a non-range waiver does not
+    waivers.write_text(
+        '[[waiver]]\nrule = "lock-discipline"\npath = "*"\n'
+        'reason = "test"\n'
+    )
+    assert [a.arm for a in autotune.proven_arms(str(report), str(waivers))] \
+        == ["vpu15"]
+
+
+def test_live_registry_arms_are_all_proven():
+    # the shipped ARM_TABLE must be fully legal against the shipped
+    # RANGE_REPORT.json — a regression here means tuning silently
+    # shrinks to a subset
+    proven = {a.arm for a in autotune.proven_arms()}
+    assert proven == {a.arm for a in autotune.ARMS}
+
+
+# ---------------------------------------------------------------------------
+# Persistence: signed plan table, round trip through a cold restart
+# ---------------------------------------------------------------------------
+
+
+def _stage_verify_kernel(store, *, B, mxu):
+    """Stage a toy program under the exact fingerprint + cache key the
+    tuned dispatcher will ask ``_verify_kernel`` for at batch ``B``."""
+    key = (B, False, mxu)
+    fp_hex = program_fingerprint(
+        "_verify_kernel", B=B, device_h2c=False, mxu=mxu
+    )
+
+    def prog(x):
+        return (x * 2.0).sum()
+
+    def hook(call, args):
+        store.capture(call, key, args, kernel="_verify_kernel")
+
+    call = traced_jit(prog, fp_hex, capture=hook)
+    x = jnp.arange(B, dtype=jnp.float32)
+    return key, float(call(x)), x
+
+
+def test_plan_round_trip_cold_restart_zero_compiles(tmp_path):
+    store = aot.AotStore(str(tmp_path / "aot_cache"))
+    key, want, x = _stage_verify_kernel(store, B=8, mxu=True)
+
+    plan = autotune.tune_and_store(
+        store, shapes=(8,), measure=_measure_by_shape({8: "mxu13"})
+    )
+    assert store.plan() == plan  # byte round trip through the manifest
+    assert F.mxu_for_batch(8) is True  # tune_and_store installs too
+
+    # "cold restart": routing state wiped, fresh backend, prewarm
+    autotune.clear_plan()
+    compiles0 = JIT_COMPILE_SECONDS.count()
+    backend = JaxBackend(min_batch=8, device_h2c=False)
+    report = aot.prewarm(backend, store)
+    assert report.plan_shapes == 1
+    assert F.mxu_for_batch(8) is True  # plan reinstalled before entries
+    assert key in backend._kernels
+    call = backend._kernels[key]
+    assert getattr(call, "aot", False)
+    # the dispatcher resolves the plan to the staged arm: same object,
+    # no second compile, first call serves from the store
+    assert backend._kernel(8) is call
+    assert float(call(x)) == want
+    assert JIT_COMPILE_SECONDS.count() == compiles0
+
+
+def test_stale_plan_on_jax_or_device_bump_behaves_cold(tmp_path):
+    store = aot.AotStore(str(tmp_path / "aot_cache"))
+    plan = autotune.tune(shapes=(8,), measure=_measure_by_shape({8: "mxu13"}))
+    for stale in (
+        dict(plan, jax="0.0.0"),
+        dict(plan, device_kind="TPU v9999"),
+        dict(plan, schema=autotune.PLAN_SCHEMA + 1),
+    ):
+        store.write_plan(stale)
+        assert store.plan() == stale  # signed fine — just not for us
+        assert autotune.install_plan(stale) == 0
+        backend = JaxBackend(min_batch=8, device_h2c=False)
+        report = aot.prewarm(backend, store)
+        assert report.plan_shapes == 0
+        assert F.mxu_for_batch(8) is False  # cold default
+
+
+def test_tampered_plan_rejected_by_manifest_signature(tmp_path):
+    store = aot.AotStore(str(tmp_path / "aot_cache"))
+    autotune.tune_and_store(
+        store, shapes=(8,), measure=_measure_by_shape({8: "mxu13"})
+    )
+    # hand-edit the plan WITHOUT re-signing: flip the winning arm
+    with open(store.manifest_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    doc["plan"]["shapes"]["8"]["arm"] = "vpu15"
+    with open(store.manifest_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+
+    autotune.clear_plan()
+    assert store.plan() == {}
+    backend = JaxBackend(min_batch=8, device_h2c=False)
+    report = aot.prewarm(backend, store)
+    assert report.plan_shapes == 0
+    assert F.mxu_for_batch(8) is False  # tampered == cold, never vpu-vs-mxu roulette
+
+
+def test_capture_preserves_plan_but_never_resigns_a_tampered_one(tmp_path):
+    store = aot.AotStore(str(tmp_path / "aot_cache"))
+    plan = autotune.tune(shapes=(8,), measure=_measure_by_shape({8: "mxu13"}))
+    store.write_plan(plan)
+    # a capture (entries rewrite) keeps the verified plan riding along
+    _stage_verify_kernel(store, B=8, mxu=False)
+    assert store.plan() == plan
+    # but once tampered, the next capture drops it instead of re-signing
+    with open(store.manifest_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    doc["plan"]["jax"] = "9.9.9"
+    with open(store.manifest_path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    _stage_verify_kernel(store, B=16, mxu=False)
+    with open(store.manifest_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert "plan" not in doc
+    assert len(doc["entries"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Override precedence: set_mxu > env flag > plan
+# ---------------------------------------------------------------------------
+
+
+def test_env_flag_override_beats_plan(monkeypatch):
+    plan = autotune.tune(shapes=(8,), measure=_measure_by_shape({8: "mxu13"}))
+    autotune.install_plan(plan)
+    assert F.mxu_for_batch(8) is True
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU", "0")
+    assert F.mxu_for_batch(8) is False  # operator forces one arm everywhere
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU", "1")
+    assert F.mxu_for_batch(4096) is True
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MXU")
+    assert F.mxu_for_batch(8) is True  # plan resumes, never latched out
+
+
+def test_set_mxu_override_beats_env_and_plan(monkeypatch):
+    plan = autotune.tune(shapes=(8,), measure=_measure_by_shape({8: "mxu13"}))
+    autotune.install_plan(plan)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU", "1")
+    prev = F.set_mxu(False)
+    try:
+        assert F.mxu_for_batch(8) is False
+    finally:
+        F.set_mxu(prev)
+    assert F.mxu_for_batch(8) is True
+
+
+# ---------------------------------------------------------------------------
+# The real trial harness, once, with an injected deterministic timer
+# ---------------------------------------------------------------------------
+
+
+def test_trial_harness_runs_real_kernel_with_injected_timer():
+    ticks = iter(range(1000))
+    best = autotune.trial(
+        VPU, 8, iters=2, timer=lambda: float(next(ticks))
+    )
+    # counter timer: every measured window is exactly one tick
+    assert best == 1.0
+    # the pinned toggle was restored
+    assert F.mxu_enabled() is False

@@ -248,6 +248,65 @@ def test_aot_manifest_live_registry_binds_backend_kernels():
         assert callable(getattr(B, name))
 
 
+def test_tune_plan_fires_on_every_seeded_shape(corpus_result):
+    vios = _by_rule(corpus_result)["tune-plan"]
+    symbols = {v.symbol for v in vios}
+    # direction 1: an arm routing through a toggle fp never defines
+    assert "fix_ghost" in symbols
+    assert "fix_good" not in symbols
+    assert "fix_unproven" not in symbols  # registering unproven is legal
+    # direction 2: audited plan tables — tampered signature, missing
+    # install-currency field, non-power-of-2 shape, unknown arm, arm
+    # with no range proof, and an unregistered kernel
+    assert "plan_signature" in symbols
+    assert "plan.device_kind" in symbols
+    assert "plan.shapes[12]" in symbols
+    assert "plan.shapes[16]" in symbols
+    assert "plan.shapes[32]" in symbols
+    assert "plan.shapes[64]" in symbols
+    # the correctly-signed plan selecting the proven arm is clean
+    assert not any(
+        v.path.endswith("aot_manifest_good.json") for v in vios
+    )
+
+
+def test_tune_plan_skipped_when_defs_absent():
+    # corpora without the autotuner (older fixture corpora) run the
+    # other families without a tune-plan finding
+    from lighthouse_tpu.analysis import registry_lint
+
+    out = registry_lint.run(
+        [("a.py", "x = 1\n")], [],
+        metrics_defs_path="nope_metrics.py",
+        faults_defs_path="nope_faults.py",
+        tune_defs_path="nope_tune.py",
+    )
+    assert not [v for v in out if v.rule == "tune-plan"]
+
+
+def test_tune_plan_live_registry_binds_proven_arms():
+    """The AST parse sees exactly the runtime ARM_TABLE, every toggle is
+    a real fp.py setter, and every proof program stands in the shipped
+    RANGE_REPORT.json at zero range-family waivers — the legality bar
+    ``autotune.tune`` trials against."""
+    from lighthouse_tpu.analysis.registry_lint import tune_plan_defs
+    from lighthouse_tpu.crypto.bls.jax_backend import autotune
+    from lighthouse_tpu.crypto.bls.jax_backend import fp as F
+
+    path = "lighthouse_tpu/crypto/bls/jax_backend/autotune.py"
+    with open(os.path.join(REPO, path), encoding="utf-8") as f:
+        arms = tune_plan_defs(f.read(), path)
+    assert set(arms) == {a.arm for a in autotune.ARMS}
+    for arm_id, (spec, toggle, value, proof, _line) in arms.items():
+        runtime = autotune.arm_by_id(arm_id)
+        assert (spec, toggle, value, proof) == (
+            runtime.spec, runtime.toggle, runtime.value, runtime.proof
+        )
+        assert callable(getattr(F, toggle))
+    # every shipped arm is provably legal to tune
+    assert {a.arm for a in autotune.proven_arms()} == set(arms)
+
+
 def test_live_serve_port_docs_are_valid(live_result):
     # every concrete --serve-port example in README/docs must be a real
     # TCP port, same doc-example contract as --chaos / --scenario

@@ -92,8 +92,11 @@ def main(argv=None) -> int:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2, default=str)
     if not args.no_history:
+        from lighthouse_tpu.utils import device_kind
+
         row = {
             "kind": "scenario_search",
+            "device_kind": device_kind(),
             "measured_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),

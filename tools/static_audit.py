@@ -54,8 +54,11 @@ DEFAULT_WAIVERS = "lighthouse_tpu/analysis/waivers.toml"
 
 
 def _record_history(result, history_path):
+    from lighthouse_tpu.utils import device_kind  # noqa: E402
+
     entry = {
         "kind": "static_audit",
+        "device_kind": device_kind(),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "pass": result.ok,
         "files_scanned": result.files_scanned,

@@ -111,7 +111,7 @@ def run_bench_child(
     miller: bool = True, wsm: bool = False, mxu: bool = False,
     bench_mxu: bool = False, pipeline: bool = False,
     multichip: bool = False, multichip_batch: int = 64,
-    boot: bool = False, timeout: float = 4000,
+    boot: bool = False, autotune: bool = False, timeout: float = 4000,
 ) -> dict | None:
     env = dict(os.environ)
     env["BENCH_CHILD"] = "tpu"
@@ -133,6 +133,13 @@ def run_bench_child(
         env["BENCH_MULTICHIP_BATCH"] = str(multichip_batch)
     if boot:
         env["BENCH_BOOT"] = "1"
+    if autotune:
+        # persist tuned plans under the repo so the relay window leaves
+        # them behind for the next boot's `bn --prewarm` (and for the
+        # round report: kind="autotune" BENCH_HISTORY rows carry the
+        # per-arm trial timings)
+        env["BENCH_AUTOTUNE"] = "1"
+        env.setdefault("BENCH_AUTOTUNE_STORE", os.path.join(ROOT, "aot_tuned"))
     return _run_child(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         f"verify B={batch} chains={int(chains)} miller={int(miller)} "
@@ -140,7 +147,8 @@ def run_bench_child(
         + (" +BENCH_MXU" if bench_mxu else "")
         + (" +pipeline" if pipeline else "")
         + (f" +multichip/{multichip_batch}" if multichip else "")
-        + (" +boot" if boot else ""),
+        + (" +boot" if boot else "")
+        + (" +autotune" if autotune else ""),
         env,
         timeout,
     )
@@ -375,11 +383,35 @@ AGENDAS: dict[str, list[dict]] = {
          "boot": True, "timeout": 7000},  # cold vs prewarmed boot A/B
         {"kind": "entry_warm"},
     ],
+    # r9: r8's standing hardware-verdict stages (dispatch audit → MXU
+    # A/B → multichip sweep → boot A/B → headline) PLUS the autotune
+    # stage: BENCH_AUTOTUNE=1 runs timed trials of every range-proven
+    # kernel arm across the batch-shape ladder on the real silicon and
+    # persists the winning plan into <repo>/aot_tuned/ — so the one
+    # relay window that settles the ROADMAP item 1 claims also leaves
+    # tuned per-device-kind plans behind for `bn --prewarm`.
+    "r9": [
+        {"kind": "dispatch_audit"},
+        {"kind": "bench", "batch": 512, "miller": True,
+         "abort_on_fail": True},          # baseline refresh, warm cache
+        {"kind": "bench", "batch": 512, "miller": True, "bench_mxu": True,
+         "timeout": 9000},                # MXU A/B refresh on this tree
+        {"kind": "bench", "batch": 512, "miller": True, "mxu": "auto",
+         "multichip": True, "multichip_batch": 64,
+         "timeout": 9000},                # multichip scaling refresh
+        {"kind": "bench", "batch": 512, "miller": True, "mxu": "auto",
+         "boot": True, "timeout": 7000},  # cold vs prewarmed boot A/B
+        {"kind": "bench", "batch": 512, "miller": True,
+         "autotune": True, "timeout": 9000},  # tuned plans left behind
+        {"kind": "bench", "batch": 8192, "miller": True, "mxu": "auto",
+         "timeout": 7000},                # headline in the winning arm
+        {"kind": "entry_warm"},
+    ],
 }
 
 _BENCH_KEYS = ("batch", "chains", "miller", "device_h2c", "wsm", "mxu",
                "bench_mxu", "pipeline", "multichip", "multichip_batch",
-               "boot", "timeout")
+               "boot", "autotune", "timeout")
 
 
 def run_stage(stage: dict) -> bool:
