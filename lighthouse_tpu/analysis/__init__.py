@@ -73,6 +73,10 @@ class AuditConfig:
     metrics_defs: str = "lighthouse_tpu/utils/metrics.py"
     faults_defs: str = "lighthouse_tpu/utils/faults.py"
     scenarios_defs: str = "lighthouse_tpu/scenario/spec.py"
+    # committed regression corpus the continuous scenario search feeds:
+    # every *.json under this directory must replay (scenario-fixture
+    # family); "" disables the family
+    scenario_fixture_dir: str = "tests/fixtures/scenarios"
     spans_defs: str = "lighthouse_tpu/obs/tracer.py"
     # scenario-search mutation surface: the literal constants in
     # search_defs must reference registered shapes/tracks/knobs
@@ -226,6 +230,8 @@ def load_config(path: str) -> AuditConfig:
         cfg.faults_defs = a["faults_defs"]
     if "scenarios_defs" in a:
         cfg.scenarios_defs = a["scenarios_defs"]
+    if "scenario_fixture_dir" in a:
+        cfg.scenario_fixture_dir = a["scenario_fixture_dir"]
     if "spans_defs" in a:
         cfg.spans_defs = a["spans_defs"]
     if "search_defs" in a:
@@ -348,6 +354,26 @@ def run_audit(
                     message="AOT manifest listed in audit config is "
                             "unreadable",
                 ))
+        # committed scenario fixtures are JSON, outside the python
+        # corpus: glob the corpus directory the way manifests are read
+        scenario_fixtures = []
+        if cfg.scenario_fixture_dir:
+            fix_dir = os.path.join(root, cfg.scenario_fixture_dir)
+            if os.path.isdir(fix_dir):
+                for fn in sorted(os.listdir(fix_dir)):
+                    if not fn.endswith(".json"):
+                        continue
+                    rel = f"{cfg.scenario_fixture_dir}/{fn}"
+                    try:
+                        with open(os.path.join(fix_dir, fn),
+                                  encoding="utf-8") as f:
+                            scenario_fixtures.append((rel, f.read()))
+                    except OSError:
+                        violations.append(Violation(
+                            rule="parse-error", path=rel, line=0,
+                            symbol=rel,
+                            message="scenario fixture is unreadable",
+                        ))
         violations.extend(registry_lint.run(
             files, docs, cfg.metrics_defs, cfg.faults_defs,
             cfg.site_scan_exclude,
@@ -366,6 +392,7 @@ def run_audit(
             aot_manifests=manifests,
             tune_defs_path=cfg.tune_defs,
             fp_defs_path=cfg.fp_defs,
+            scenario_fixtures=scenario_fixtures,
         ))
         fam_t["registry"] = time.perf_counter() - t
 

@@ -580,6 +580,125 @@ def scenario_spec_violations(docs, known_names,
     return out
 
 
+# -- scenario fixture corpus ---------------------------------------------
+
+
+def scenario_fixture_schema(src: str, path: str):
+    """AST-parse the fixture schema from the scenario spec module: the
+    ``_SPEC_JSON_FIELDS`` tuple (allowed fixture fields) and the
+    ``DEFAULT_SLO`` dict's string keys (registerable SLO thresholds).
+    Pure AST, never imported — both must stay literals."""
+    tree = ast.parse(src, filename=path)
+    json_fields: set[str] = set()
+    slo_keys: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign):
+            targets = (
+                [node.target] if isinstance(node.target, ast.Name) else []
+            )
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        else:
+            continue
+        names = {t.id for t in targets}
+        if "_SPEC_JSON_FIELDS" in names and isinstance(
+            value, (ast.Tuple, ast.List)
+        ):
+            for e in value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    json_fields.add(e.value)
+        elif "DEFAULT_SLO" in names and isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    slo_keys.add(k.value)
+    return json_fields, slo_keys
+
+
+def scenario_fixture_violations(fixtures, scenarios_defs_src,
+                                scenarios_defs_path,
+                                arg_validator=None) -> list[Violation]:
+    """The committed regression corpus (``tests/fixtures/scenarios/``)
+    must stay replayable: every fixture parses as a JSON object, carries
+    the required ``name``/``seed``, names only ``_SPEC_JSON_FIELDS``
+    fields and registered ``DEFAULT_SLO`` keys, and its ``name`` matches
+    the file stem ``--scenario`` resolves it by.  With ``arg_validator``
+    (the live audit passes the real ``parse_scenario_arg``) the fixture
+    must also rebuild a full ScenarioSpec end to end."""
+    import json
+
+    json_fields, slo_keys = (set(), set())
+    if scenarios_defs_src is not None:
+        json_fields, slo_keys = scenario_fixture_schema(
+            scenarios_defs_src, scenarios_defs_path
+        )
+    out: list[Violation] = []
+    for display, text in fixtures:
+        stem = os.path.splitext(os.path.basename(display))[0]
+        try:
+            doc = json.loads(text)
+            if not isinstance(doc, dict):
+                raise ValueError("not a JSON object")
+        except Exception:  # noqa: BLE001 — a broken fixture is a finding
+            out.append(Violation(
+                rule="scenario-fixture", path=display, line=0, symbol=stem,
+                message="scenario fixture does not parse as a JSON object",
+            ))
+            continue
+        for req in ("name", "seed"):
+            if req not in doc:
+                out.append(Violation(
+                    rule="scenario-fixture", path=display, line=0,
+                    symbol=req,
+                    message=f"scenario fixture is missing required "
+                            f"field {req!r}",
+                ))
+        name = doc.get("name")
+        if isinstance(name, str) and name != stem:
+            out.append(Violation(
+                rule="scenario-fixture", path=display, line=0, symbol=name,
+                message=(
+                    f"fixture name {name!r} does not match file stem "
+                    f"{stem!r} — parse_scenario_arg resolves by stem, so "
+                    f"the finding cannot replay under its own name"
+                ),
+            ))
+        if json_fields:
+            for fld in sorted(set(doc) - json_fields):
+                out.append(Violation(
+                    rule="scenario-fixture", path=display, line=0,
+                    symbol=fld,
+                    message=(
+                        f"fixture field {fld!r} is not in _SPEC_JSON_FIELDS "
+                        f"— spec_from_json would reject it"
+                    ),
+                ))
+        if slo_keys and isinstance(doc.get("slo"), dict):
+            for key in sorted(set(doc["slo"]) - slo_keys):
+                out.append(Violation(
+                    rule="scenario-fixture", path=display, line=0,
+                    symbol=key,
+                    message=(
+                        f"fixture names unregistered SLO key {key!r} "
+                        f"(not in DEFAULT_SLO)"
+                    ),
+                ))
+        if arg_validator is not None and isinstance(name, str) \
+                and name == stem:
+            err = arg_validator(name)
+            if err is not None:
+                out.append(Violation(
+                    rule="scenario-fixture", path=display, line=0,
+                    symbol=name,
+                    message=(
+                        f"fixture does not replay through "
+                        f"parse_scenario_arg: {err}"
+                    ),
+                ))
+    return out
+
+
 # -- serve ports ---------------------------------------------------------
 
 
@@ -1172,7 +1291,7 @@ def run(
     search_defs_path=None, traffic_defs_path=None,
     adversity_defs_path=None, partition_defs_path=None,
     aot_defs_path=None, aot_backend_defs_path=None, aot_manifests=(),
-    tune_defs_path=None, fp_defs_path=None,
+    tune_defs_path=None, fp_defs_path=None, scenario_fixtures=(),
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
@@ -1194,8 +1313,19 @@ def run(
         scn_src = files.get(scenarios_defs_path)
         # absent in fixture corpora: skip the family rather than flag it
         if scn_src is not None:
+            known = dict(scenario_defs(scn_src, scenarios_defs_path))
+            for rel, _ in scenario_fixtures:
+                # committed corpus fixtures are first-class --scenario
+                # names (parse_scenario_arg falls back to the corpus)
+                stem = os.path.splitext(os.path.basename(rel))[0]
+                known.setdefault(stem, 0)
             out.extend(scenario_spec_violations(
-                docs, scenario_defs(scn_src, scenarios_defs_path),
+                docs, known,
+                arg_validator=scenario_arg_validator,
+            ))
+        if scenario_fixtures:
+            out.extend(scenario_fixture_violations(
+                scenario_fixtures, scn_src, scenarios_defs_path,
                 arg_validator=scenario_arg_validator,
             ))
     if search_defs_path is not None:

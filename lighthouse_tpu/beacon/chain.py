@@ -37,6 +37,7 @@ from ..crypto.bls import api as bls
 from ..obs.tracer import TRACER
 from ..store import HotColdDB
 from ..utils import Counter, get_logger, log_with
+from ..utils import metrics as M
 from ..utils.metrics import BLOCK_IMPORT_LATENCY
 
 BLOCKS_IMPORTED = Counter("beacon_blocks_imported_total", "Blocks imported")
@@ -836,15 +837,17 @@ class BeaconChain:
             effective = (
                 vote if n_votes * 2 > period_slots else state.eth1_data
             )
-            need = min(
-                self.preset.max_deposits,
-                int(effective.deposit_count)
-                - int(state.eth1_deposit_index),
+            backlog = int(effective.deposit_count) - int(
+                state.eth1_deposit_index
             )
+            M.DEPOSIT_QUEUE_DEPTH.set(max(0, backlog))
+            need = min(self.preset.max_deposits, backlog)
             if need > 0:
                 body_kwargs["deposits"] = (
                     self.eth1.deposit_cache.deposits_for_block(
-                        int(state.eth1_deposit_index), need
+                        int(state.eth1_deposit_index),
+                        need,
+                        deposit_count=int(effective.deposit_count),
                     )
                 )
         if "sync_aggregate" in body_cls._fields:

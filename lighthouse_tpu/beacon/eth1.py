@@ -46,13 +46,24 @@ class DepositCache:
     def count(self) -> int:
         return len(self.deposits)
 
-    def deposits_for_block(self, start_index: int, count: int) -> list[Deposit]:
+    def deposits_for_block(
+        self, start_index: int, count: int, deposit_count: int | None = None
+    ) -> list[Deposit]:
         """Build proof-carrying Deposits for inclusion (genesis or block
-        production)."""
+        production).  ``deposit_count`` pins proofs to the voted
+        ``eth1_data`` snapshot — under saturation the log keeps growing
+        past the vote, and a live-tip proof would fail verification
+        against the snapshot's deposit_root."""
+        stop = min(start_index + count, len(self.deposits))
+        if deposit_count is not None:
+            stop = min(stop, deposit_count)
         out = []
-        for i in range(start_index, min(start_index + count, len(self.deposits))):
+        for i in range(start_index, stop):
             out.append(
-                Deposit(proof=self.tree.proof(i), data=self.deposits[i])
+                Deposit(
+                    proof=self.tree.proof(i, deposit_count),
+                    data=self.deposits[i],
+                )
             )
         return out
 

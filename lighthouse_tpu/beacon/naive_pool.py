@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 
 from ..crypto.bls import api as bls
+from ..utils import metrics as M
 
 
 class NaiveAggregationPool:
@@ -26,6 +27,14 @@ class NaiveAggregationPool:
         self._groups: dict[bytes, tuple[object, list[bool], list]] = {}
         self.max_data = max_data
         self._lock = threading.Lock()
+        # resident signatures across groups — the marginal cost of the
+        # next get_aggregates() BLS pass.  Disjoint bit-subset storms grow
+        # this superlinearly relative to attester count, which is exactly
+        # what the pool_estimated_verify_cost gauge is there to expose.
+        self._resident_sigs = 0
+
+    def _publish_cost(self) -> None:
+        M.POOL_ESTIMATED_VERIFY_COST.set(self._resident_sigs)
 
     def insert(self, attestation) -> bool:
         """True if the attestation added at least one new attester bit
@@ -37,8 +46,11 @@ class NaiveAggregationPool:
             entry = self._groups.get(key)
             if entry is None:
                 if len(self._groups) >= self.max_data:
-                    self._groups.pop(next(iter(self._groups)))
+                    evicted = self._groups.pop(next(iter(self._groups)))
+                    self._resident_sigs -= len(evicted[2])
                 self._groups[key] = (attestation.data, bits, [sig])
+                self._resident_sigs += 1
+                self._publish_cost()
                 return True
             data, have, sigs = entry
             new = [b and not h for b, h in zip(bits, have)]
@@ -50,6 +62,8 @@ class NaiveAggregationPool:
                 if b:
                     have[i] = True
             sigs.append(sig)
+            self._resident_sigs += 1
+            self._publish_cost()
             return True
 
     def _snapshot(self, entry):
@@ -99,6 +113,10 @@ class NaiveAggregationPool:
                 for key, entry in self._groups.items()
                 if int(entry[0].slot) + preset.slots_per_epoch >= current_slot
             }
+            self._resident_sigs = sum(
+                len(e[2]) for e in self._groups.values()
+            )
+            self._publish_cost()
 
     def __len__(self) -> int:
         with self._lock:

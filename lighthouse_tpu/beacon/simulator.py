@@ -180,7 +180,22 @@ class Simulator:
 
         spec = phase0_spec(S.MINIMAL)
         if spec_overrides:
-            spec = dataclasses.replace(spec, **dict(spec_overrides))
+            kv = dict(spec_overrides)
+            # route preset-level keys (slots_per_epoch, max_deposits, ...)
+            # into the nested Preset so scenarios can reshape drain math
+            preset_kv = {
+                k: kv.pop(k)
+                for k in list(kv)
+                if k not in spec.__dataclass_fields__
+                and k in spec.preset.__dataclass_fields__
+            }
+            if preset_kv:
+                spec = dataclasses.replace(
+                    spec,
+                    preset=dataclasses.replace(spec.preset, **preset_kv),
+                )
+            if kv:
+                spec = dataclasses.replace(spec, **kv)
         self.spec = spec
         genesis, self.keypairs = interop_state(
             n_validators, self.spec, fork=fork,

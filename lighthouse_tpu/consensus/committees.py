@@ -17,6 +17,7 @@ import numpy as np
 from ..ops import sha256
 from .shuffle import shuffle_list
 from .spec import DOMAIN_BEACON_ATTESTER, Preset
+from .ssz import CACHE_BUDGET
 
 DOMAIN_BEACON_PROPOSER_SEED = bytes([0, 0, 0, 0])
 
@@ -60,14 +61,20 @@ def get_active_validator_indices(state, epoch: int) -> np.ndarray:
         # identity-keyed sharing is only sound if every element is frozen
         # (an unfrozen element could mutate under the same id)
         if all(v.__dict__.get("_frozen") for v in vs):
-            if len(_ACTIVE_BY_ELEMS) >= 4:
-                _ACTIVE_BY_ELEMS.pop(next(iter(_ACTIVE_BY_ELEMS)))
+            CACHE_BUDGET.charge(len(vs) * 16 + arr.nbytes + 96)
             _ACTIVE_BY_ELEMS[ekey] = (arr, list(vs))
+            CACHE_BUDGET.trim(
+                _ACTIVE_BY_ELEMS,
+                lambda k, v: len(k[1]) * 16 + v[0].nbytes + 96,
+                4,
+            )
         else:
             return arr
-    if len(_ACTIVE_BY_ID) >= 8:
-        _ACTIVE_BY_ID.pop(next(iter(_ACTIVE_BY_ID)))
+    CACHE_BUDGET.charge(len(vs) * 8 + arr.nbytes + 96)
     _ACTIVE_BY_ID[key] = (arr, vs)
+    CACHE_BUDGET.trim(
+        _ACTIVE_BY_ID, lambda k, v: len(v[1]) * 8 + v[0].nbytes + 96, 8
+    )
     return arr
 
 

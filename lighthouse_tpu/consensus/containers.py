@@ -201,6 +201,11 @@ class Validator(Container):
         roots = sha256_many(lvl)
         for v, r in zip(todo, roots):
             v.__dict__["_root_memo"] = r.tobytes()
+        frozen = sum(1 for v in todo if v.__dict__.get("_frozen"))
+        if frozen:
+            from .ssz import CACHE_BUDGET
+
+            CACHE_BUDGET.charge_memo(96 * frozen)
 
 
 class AttestationData(Container):

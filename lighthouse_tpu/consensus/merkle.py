@@ -70,12 +70,19 @@ class DepositTree:
             size //= 2
         return sha256(node + self.count.to_bytes(8, "little") + bytes(24))
 
-    def proof(self, index: int) -> list[bytes]:
+    def proof(self, index: int, count: int | None = None) -> list[bytes]:
         """Branch for leaf ``index`` (+ the count chunk as the final
-        element, matching the Deposit.proof DEPTH+1 layout)."""
-        assert index < self.count
+        element, matching the Deposit.proof DEPTH+1 layout).
+
+        ``count`` selects a HISTORICAL snapshot of the tree (the first
+        ``count`` leaves): under deposit-queue saturation the contract
+        tree keeps growing while blocks drain against the *voted*
+        ``eth1_data`` snapshot, so proofs must verify against that
+        snapshot's root, not the live tip."""
+        count = self.count if count is None else count
+        assert 0 < count <= self.count and index < count
         # rebuild the level nodes (O(n); fine for test/genesis scale)
-        level_nodes = list(self._leaves)
+        level_nodes = list(self._leaves[:count])
         branch: list[bytes] = []
         idx = index
         for level in range(self.DEPTH):
@@ -95,5 +102,5 @@ class DepositTree:
                 nxt.append(sha256(a + b))
             level_nodes = nxt
             idx //= 2
-        branch.append(self.count.to_bytes(8, "little") + bytes(24))
+        branch.append(count.to_bytes(8, "little") + bytes(24))
         return branch

@@ -41,6 +41,74 @@ while len(_zero_hashes) < 64:
     _zero_hashes.append(h)
 
 
+class _CacheBudget:
+    """Approximate byte accounting for the registry-scale caches.
+
+    Two buckets feed the ``ssz_cache_bytes`` gauge:
+
+    * ``used_bytes`` — the shared evictable caches (the content-keyed
+      big-uint root cache, the SSZList registry root caches, and the
+      active-indices caches in committees.py).  ``trim`` bounds them:
+      oldest-first eviction while a cache is over its entry cap OR the
+      global byte budget, so a 1M-validator soak cannot accrete
+      multi-GB key material (each packed-balances key alone is ~8 MB
+      at mainnet registry scale).
+    * ``memo_bytes`` — per-frozen-container ``_ser_memo``/``_root_memo``
+      bytes.  Memos are 1:1 with immutable objects and die with them,
+      so they are gauged but never evicted and never counted against
+      the eviction budget (evicting them would just re-pay the root).
+
+    Counter updates are unlocked: readers race only against the
+    approximation, and every writer path already runs under the
+    per-node import flow.
+    """
+
+    def __init__(self, limit_bytes: int = 256 * 1024 * 1024):
+        self.limit_bytes = limit_bytes
+        self.used_bytes = 0
+        self.memo_bytes = 0
+
+    def _publish(self):
+        from ..utils import metrics as M
+
+        M.SSZ_CACHE_BYTES.set(float(self.used_bytes + self.memo_bytes))
+
+    def charge(self, nbytes: int) -> None:
+        self.used_bytes += int(nbytes)
+        self._publish()
+
+    def charge_memo(self, nbytes: int) -> None:
+        self.memo_bytes += int(nbytes)
+        self._publish()
+
+    def release(self, nbytes: int) -> None:
+        self.used_bytes = max(0, self.used_bytes - int(nbytes))
+        self._publish()
+
+    def trim(self, cache: dict, cost, cap: int) -> None:
+        """Evict oldest entries while ``cache`` is over its entry cap or
+        the global byte budget; ``cost(key, value)`` prices an entry the
+        same way its insert charged it."""
+        evicted = 0
+        while cache and (len(cache) > cap or self.used_bytes > self.limit_bytes):
+            key = next(iter(cache))
+            val = cache.pop(key)
+            self.release(cost(key, val))
+            evicted += 1
+        if evicted:
+            from ..utils import metrics as M
+
+            M.SSZ_CACHE_EVICTIONS.inc(evicted)
+
+
+CACHE_BUDGET = _CacheBudget()
+
+
+def set_cache_budget(limit_bytes: int) -> None:
+    """Rebind the evictable-cache byte budget (soak scenarios tighten it)."""
+    CACHE_BUDGET.limit_bytes = int(limit_bytes)
+
+
 def _sha256_pairs(data: np.ndarray) -> np.ndarray:
     """Hash rows of a (k, 64) uint8 array -> (k, 32) uint8 array.
 
@@ -371,16 +439,18 @@ class SSZList(SSZType):
                 root = hit2[0]
             elif all(v.__dict__.get("_frozen") for v in values):
                 root = _sequence_root(self.elem, values, self.limit)
-                if len(by_elems) >= 4:
-                    by_elems.pop(next(iter(by_elems)))
+                CACHE_BUDGET.charge(n * 16 + 96)
                 by_elems[key] = (root, list(values))
+                CACHE_BUDGET.trim(
+                    by_elems, lambda k, v: len(k) * 16 + 96, 4
+                )
             else:
                 return None
         else:
             root = _sequence_root(self.elem, values, self.limit)
-        if len(by_id) >= 8:
-            by_id.pop(next(iter(by_id)))
+        CACHE_BUDGET.charge(n * 8 + 96)
         by_id[id(values)] = (root, values)
+        CACHE_BUDGET.trim(by_id, lambda k, v: len(v[1]) * 8 + 96, 8)
         return root
 
     def default(self):
@@ -563,9 +633,11 @@ def _sequence_root(elem: SSZType, values: Sequence, limit: int | None) -> bytes:
                 if hit is not None:
                     return hit
                 root = _uint_sequence_root(elem, raw, limit)
-                if len(cache) >= 8:
-                    cache.pop(next(iter(cache)))
+                CACHE_BUDGET.charge(len(raw) + 96)
                 cache[key] = root
+                CACHE_BUDGET.trim(
+                    cache, lambda k, v: len(k[1]) + 96, 8
+                )
                 return root
         else:
             raw = b"".join(elem.serialize(v) for v in values)
@@ -744,6 +816,7 @@ class _ContainerField(SSZType):
         out = self.cls.serialize_value(value)
         if d.get("_frozen"):
             d["_ser_memo"] = out  # frozen => immutable => bytes never stale
+            CACHE_BUDGET.charge_memo(len(out) + 64)
         return out
 
     def deserialize(self, data):
@@ -757,6 +830,7 @@ class _ContainerField(SSZType):
         root = self.cls.hash_tree_root_value(value)
         if d.get("_frozen"):
             d["_root_memo"] = root  # frozen => immutable => memo never stale
+            CACHE_BUDGET.charge_memo(96)
         return root
 
     def default(self):

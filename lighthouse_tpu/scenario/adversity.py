@@ -35,6 +35,14 @@ class Track:
     def on_slot(self, engine, slot: int) -> None:
         """Called at the start of every slot (before the proposal)."""
 
+    def on_attestations(self, engine, slot: int, atts: list) -> None:
+        """Called after the honest committees attested at ``slot`` —
+        tracks that piggyback on the honest stream (e.g. crafting
+        near-duplicate aggregates from a real template) hook here."""
+
+    def on_epoch(self, engine, epoch: int, facts: dict) -> None:
+        """Contribute to the engine's per-epoch snapshot ``facts``."""
+
     def finalize(self, engine) -> None:
         """End-of-run bookkeeping into the engine report."""
 
@@ -536,6 +544,197 @@ class TenantOverloadTrack(Track):
                     slow=self.slow_submissions)
 
 
+class AggregationStormTrack(Track):
+    """Committee-overlap aggregation storm through the serve front door.
+
+    Each slot in the window the storm tenant submits ``payloads``
+    near-duplicate aggregation payloads: every payload is ``dup``
+    signature sets sharing ONE message (bit-twiddled participation sets
+    over the same attestation data), the shape that defeats both dedup
+    and batch amortization — set-count admission prices it at ``dup``
+    while its true marginal verify cost is superlinear (1+2+...+dup).
+    With ``cost=1`` the service's admission charges the token bucket
+    via :func:`~...serve.admission.estimated_verify_cost`; with
+    ``cost=0`` it charges raw set counts (the degraded twin).
+
+    Only ADMITTED storm payloads reach the node naive pools: each one
+    becomes ``dup`` disjoint-bit attestation variants over a crafted
+    far-future data root (real signature bytes cloned from the honest
+    template), so every insert appends a fresh resident signature —
+    the pool's estimated-verify-cost gauge — while staying packing-
+    ineligible (produced blocks stay valid).  A deadline-sensitive
+    honest tenant runs alongside; the SLOs judge whether cost-based
+    admission keeps the pools and the honest tenant inside budget.
+    """
+
+    name = "aggregation-storm"
+
+    def __init__(self, payloads="12", dup="6", cost="1", rate="96",
+                 honest_rate="16", deadline="0.5", unit="0",
+                 steps="4", start="2", end="999"):
+        self.payloads = int(payloads)
+        self.dup = max(1, int(dup))
+        self.cost = cost not in ("0", "false", "off")
+        self.rate = float(rate)
+        self.honest_rate = float(honest_rate)
+        self.deadline = float(deadline)
+        self.unit = float(unit)
+        self.steps = max(1, int(steps))
+        self.start = int(start)
+        self.end = int(end)
+        self.service = None
+        self.template = None
+        self.admitted = 0
+        self.submitted = 0
+        self._frac = 0.0
+        self._virt = 0.0
+
+    def _now_factory(self, engine):
+        def now() -> float:
+            return engine.clock.now() + self._frac + self._virt
+        return now
+
+    def install(self, engine) -> None:
+        from ..beacon.processor import CircuitBreaker, ResilientVerifier
+        from ..serve.admission import (
+            TenantPolicy,
+            estimated_verify_cost,
+        )
+        from ..serve.service import VerifyService
+
+        now = self._now_factory(engine)
+        track = self
+
+        def device_verify(sets) -> bool:
+            # verdicts are not under test (stub rung, tenant-overload
+            # posture).  With a non-zero ``unit`` knob the rung burns
+            # virtual time proportional to the batch's estimated
+            # marginal cost so the latency histogram sees the
+            # superlinear price of admitted near-duplicates — but the
+            # burned time also ages deadlines and refills buckets, so
+            # the default keeps it off and the pool gauges carry the
+            # cost story.
+            if track.unit > 0.0:
+                track._virt += track.unit * estimated_verify_cost(sets)
+            return True
+
+        resilient = ResilientVerifier(
+            device_verify=device_verify,
+            cpu_verify=lambda sets: True,
+            breaker=CircuitBreaker(now=now),
+            now=now,
+            injector=engine.injector,
+        )
+        self.service = VerifyService(
+            resilient,
+            policies={
+                "storm": TenantPolicy(
+                    rate=self.rate, burst=self.rate,
+                    max_queue=4096, priority="p1",
+                ),
+                "honest": TenantPolicy(
+                    rate=self.honest_rate * 4.0,
+                    burst=self.honest_rate * 4.0, priority="p0",
+                ),
+            },
+            compiled_sizes=(8, 32),
+            flush_margin=1.0 / self.steps + 0.02,
+            default_deadline_s=self.deadline,
+            injector=engine.injector,
+            now=now,
+            cost_model=estimated_verify_cost if self.cost else None,
+        )
+
+    def _storm_data(self, slot: int, p: int):
+        """One crafted AttestationData per (slot, payload): a unique
+        far-future slot + fake root, so pool groups are distinct, the
+        packing window never selects them (blocks stay valid), and the
+        one-epoch prune retention never fires."""
+        from ..consensus.containers import AttestationData
+
+        t = self.template.data
+        return AttestationData(
+            slot=100_000 + slot,
+            index=int(t.index),
+            beacon_block_root=(
+                b"\xab" + slot.to_bytes(8, "little")
+                + p.to_bytes(8, "little") + bytes(15)
+            ),
+            source=t.source,
+            target=t.target,
+        )
+
+    def on_attestations(self, engine, slot: int, atts: list) -> None:
+        if self.template is None and atts:
+            self.template = atts[0]
+        if (self.service is None or self.template is None
+                or not (self.start <= slot <= self.end)):
+            return
+        from ..consensus.containers import Attestation
+
+        svc = self.service
+        sig = bytes(self.template.signature)
+        per_step = max(1, self.payloads // self.steps)
+        honest_per = max(1, int(round(self.honest_rate / self.steps)))
+        p = 0
+        for i in range(self.steps):
+            self._frac = i / self.steps
+            for _ in range(per_step):
+                if p >= self.payloads:
+                    break
+                data = self._storm_data(slot, p)
+                msg = bytes(data.beacon_block_root)
+                sets = [(msg, k) for k in range(self.dup)]
+                self.submitted += 1
+                res = svc.submit("storm", sets,
+                                 deadline_s=self.deadline)
+                if res.accepted:
+                    self.admitted += 1
+                    for k in range(self.dup):
+                        bits = [j == k for j in range(self.dup)]
+                        att = Attestation(
+                            aggregation_bits=bits, data=data,
+                            signature=sig,
+                        )
+                        for node in engine.sim.nodes:
+                            node.chain.naive_pool.insert(att)
+                p += 1
+            for j in range(honest_per):
+                svc.submit("honest", [((b"honest", slot, i, j),)],
+                           deadline_s=self.deadline)
+            svc.tick()
+
+    def on_epoch(self, engine, epoch: int, facts: dict) -> None:
+        facts["storm_admitted"] = self.admitted
+        facts["storm_submitted"] = self.submitted
+
+    def finalize(self, engine) -> None:
+        if self.service is None:
+            return
+        svc = self.service
+        svc.flush()
+        adm = svc.admission
+        storm_shed = sum(adm.shed.get("storm", {}).values())
+        storm_total = self.submitted
+        shed_rate = (storm_shed / storm_total) if storm_total else 0.0
+        completed = svc.completed.get("honest", 0)
+        misses = svc.deadline_misses.get("honest", 0)
+        miss_rate = (misses / completed) if completed else 0.0
+        engine.run_facts["storm_submitted"] = storm_total
+        engine.run_facts["storm_admitted"] = self.admitted
+        engine.run_facts["storm_shed_rate"] = round(shed_rate, 6)
+        engine.run_facts["serve_honest_completed"] = completed
+        engine.run_facts["serve_honest_deadline_miss_rate"] = round(
+            miss_rate, 6
+        )
+        engine.note("aggregation-storm-result",
+                    submitted=storm_total, admitted=self.admitted,
+                    shed_rate=round(shed_rate, 4),
+                    honest_completed=completed,
+                    honest_miss_rate=round(miss_rate, 6),
+                    cost_model=self.cost)
+
+
 class WarmStandbyHandoffTrack(Track):
     """Zero-downtime upgrade drill over the REAL AOT machinery: an "old
     node" :class:`~...serve.service.VerifyService` (stub verdict rung,
@@ -727,7 +926,7 @@ TRACKS = {
     for cls in (GossipFaultTrack, DeviceFaultTrack, ByzantineSyncTrack,
                 KillRecoveryTrack, PodDeviceDropTrack, FinalityStallTrack,
                 HostileCheckpointTrack, TenantOverloadTrack,
-                WarmStandbyHandoffTrack)
+                AggregationStormTrack, WarmStandbyHandoffTrack)
 }
 
 

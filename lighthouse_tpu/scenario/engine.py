@@ -30,8 +30,8 @@ from ..obs import report as trace_report
 from ..obs.tracer import TRACER
 from ..utils.logging import get_logger, log_with
 from .adversity import build_tracks
-from .slo import MetricsSnapshot, evaluate
-from .spec import SCENARIOS, ScenarioSpec
+from .slo import MetricsSnapshot, evaluate, evaluate_epoch
+from .spec import ScenarioSpec, parse_scenario_arg
 from .traffic import build_shapes
 
 log = get_logger("lighthouse_tpu.scenario")
@@ -110,6 +110,12 @@ class ScenarioEngine:
             "crash_reports": [],
         }
         self._probe_sets: list = []  # last known-good sets, breaker probes
+        # per-epoch SLO snapshots (epoch -> metrics delta + facts + gate
+        # results); populated at every epoch boundary so a violation is
+        # localized to the epoch it first appears in
+        self.epoch_records: list[dict] = []
+        self._epoch_prev_snap: MetricsSnapshot | None = None
+        self._ssz_base = 0
 
     # ------------------------------------------------------------ plumbing
 
@@ -173,6 +179,8 @@ class ScenarioEngine:
         # only the run's own spans
         self._trace_mark = TRACER.mark()
         before = MetricsSnapshot()
+        self._epoch_prev_snap = before
+        self._ssz_base = self._ssz_cache_bytes_now()
         for shape in self.shapes:
             shape.install(self)
         for track in self.tracks:
@@ -186,6 +194,7 @@ class ScenarioEngine:
             self._run_slot(slot)
             if slot % self.slots_per_epoch == 0:
                 self._heal(slot)
+                self._snapshot_epoch(slot // self.slots_per_epoch)
         self._recover_breaker()
         self._heal(total_slots)  # final convergence pass
         for shape in self.shapes:
@@ -226,6 +235,8 @@ class ScenarioEngine:
             self.enqueue_attestation(att)
         for s in self.shapes:
             s.on_attestations(self, slot, atts)
+        for t in self.tracks:
+            t.on_attestations(self, slot, atts)
         self.processor.drain()
         # a tripped breaker sheds GOSSIP_ATTESTATION at ingress, so the
         # handler alone would never probe the device again; block/sync
@@ -240,6 +251,61 @@ class ScenarioEngine:
         if found:
             self.run_facts["slashings_detected"] += found
             self.note("slashings-detected", slot=slot, found=found)
+
+    # ---------------------------------------------------- epoch snapshots
+
+    @staticmethod
+    def _ssz_cache_bytes_now() -> int:
+        from ..consensus.ssz import CACHE_BUDGET
+
+        return CACHE_BUDGET.used_bytes + CACHE_BUDGET.memo_bytes
+
+    def _snapshot_epoch(self, epoch: int) -> None:
+        """One per-epoch SLO snapshot, taken at the boundary after the
+        heal pass.  Pure observation: consumes no engine RNG and fires
+        no faults, so run fingerprints are unchanged by snapshotting.
+        The metrics delta is against the PREVIOUS boundary (per-epoch
+        rates, not cumulative), while the byte/pool facts are absolute
+        at this boundary — what the epoch-level budgets gate."""
+        snap = MetricsSnapshot()
+        deltas = snap.delta(self._epoch_prev_snap)
+        self._epoch_prev_snap = snap
+        nodes = self.sim.nodes
+        facts: dict = {
+            # cache growth since run start — process-global counters
+            # carry earlier runs' memo bytes, so the run's own growth
+            # is the leak signal
+            "ssz_cache_bytes": max(
+                0, self._ssz_cache_bytes_now() - self._ssz_base
+            ),
+            "pool_estimated_verify_cost": max(
+                n.chain.naive_pool._resident_sigs for n in nodes
+            ),
+            "naive_pool_groups": max(
+                len(n.chain.naive_pool._groups) for n in nodes
+            ),
+            "op_pool_attestations": max(
+                n.chain.op_pool.num_attestations() for n in nodes
+            ),
+        }
+        for shape in self.shapes:
+            shape.on_epoch(self, epoch, facts)
+        for track in self.tracks:
+            track.on_epoch(self, epoch, facts)
+        results = evaluate_epoch(self.spec.slo_thresholds(), facts)
+        self.epoch_records.append({
+            "epoch": epoch,
+            "metrics": deltas,
+            "facts": facts,
+            "slo": [r.to_dict() for r in results],
+        })
+        # roll the worst-epoch values up into the run facts the
+        # run-level gates read — one source of truth for the verdict
+        for key in ("deposit_queue_depth", "ssz_cache_bytes",
+                    "pool_estimated_verify_cost"):
+            if key in facts:
+                prev = self.run_facts.get(f"{key}_max", 0)
+                self.run_facts[f"{key}_max"] = max(prev, facts[key])
 
     # ------------------------------------------------------------- healing
 
@@ -382,6 +448,14 @@ class ScenarioEngine:
         # warn-level gates are advisory: logged and reported, never the
         # verdict (slo.SLOResult.level)
         ok = all(r.ok for r in results if r.level == "fail")
+        # localize: the first epoch whose boundary snapshot failed an
+        # epoch-level gate (None = no epoch-localized violation)
+        first_violation_epoch = next(
+            (rec["epoch"] for rec in self.epoch_records
+             if any(not g["ok"] and g["level"] == "fail"
+                    for g in rec["slo"])),
+            None,
+        )
         trace_dump = None
         if not ok:
             # a failing run must leave a flight-recorder artifact: next
@@ -415,6 +489,8 @@ class ScenarioEngine:
             ],
             "metrics": deltas,
             "facts": dict(self.run_facts),
+            "epochs": self.epoch_records,
+            "first_violation_epoch": first_violation_epoch,
             "fired_faults": fired,
             "events": self.events,
             "elapsed_s": round(time.time() - t0, 3),
@@ -434,7 +510,7 @@ class ScenarioEngine:
         from ..utils import device_kind
 
         entry = {
-            "kind": "scenario",
+            "kind": "soak" if self.spec.soak else "scenario",
             "device_kind": device_kind(),
             "measured_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -448,6 +524,29 @@ class ScenarioEngine:
             "slo_failed": [r["name"] for r in report["slo"] if not r["ok"]],
             "elapsed_s": report["elapsed_s"],
         }
+        if self.spec.soak:
+            # the soak row's own facts: how far the run survived, the
+            # process's peak RSS, and the worst per-epoch verify p99
+            import resource
+
+            epochs = report.get("epochs", [])
+            survived = sum(
+                1 for rec in epochs
+                if all(g["ok"] for g in rec["slo"]
+                       if g["level"] == "fail")
+            )
+            entry["epochs_survived"] = survived
+            entry["epochs_total"] = len(epochs)
+            entry["peak_rss_kb"] = int(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            )
+            entry["worst_epoch_verify_p99_s"] = round(max(
+                (rec["metrics"].get("verify_p99_s", 0.0)
+                 for rec in epochs), default=0.0,
+            ), 4)
+            entry["ssz_cache_bytes_max"] = report["facts"].get(
+                "ssz_cache_bytes_max", 0
+            )
         try:
             with open(self.history_path, "a") as f:
                 f.write(json.dumps(entry) + "\n")
@@ -461,11 +560,9 @@ def run_scenario(spec_or_name, out_path: str | None = None,
     return its JSON-shaped report."""
     spec = spec_or_name
     if isinstance(spec, str):
-        if spec not in SCENARIOS:
-            raise ValueError(
-                f"unknown scenario {spec!r}; have {sorted(SCENARIOS)}"
-            )
-        spec = SCENARIOS[spec]
+        # registry names first, then the committed regression corpus
+        # (tests/fixtures/scenarios) — parse_scenario_arg does both
+        spec = parse_scenario_arg(spec)
     return ScenarioEngine(
         spec, out_path=out_path, history_path=history_path
     ).run()

@@ -32,11 +32,20 @@ search (run it via its registered scenario instead).
 
 from __future__ import annotations
 
+import json
+import os
 import random
+import time
 from dataclasses import dataclass, field, replace
 
 from .minimize import MinimizeResult, minimize, render_spec
-from .spec import SCENARIOS, ScenarioSpec
+from .spec import (
+    SCENARIOS,
+    ScenarioSpec,
+    fixture_scenario_dir,
+    spec_from_json,
+    spec_to_json,
+)
 
 # ---------------------------------------------------------------------------
 # The mutation surface.  Keep these literal: analysis/registry_lint.py
@@ -101,6 +110,7 @@ class Violation:
     fingerprint: str
     minimized: MinimizeResult | None = None
     rendered: str = ""               # ready-to-register registry entry
+    registered: str = ""             # fixture path the finding landed in
 
 
 @dataclass
@@ -110,6 +120,7 @@ class SearchResult:
     novel_fingerprints: int = 0
     minimization_steps: int = 0
     corpus_names: list = field(default_factory=list)
+    sweeps: int = 1                  # >1 only in continuous mode
 
     def to_dict(self) -> dict:
         return {
@@ -117,6 +128,7 @@ class SearchResult:
             "violations_found": len(self.violations),
             "novel_fingerprints": self.novel_fingerprints,
             "minimization_steps": self.minimization_steps,
+            "sweeps": self.sweeps,
             "violations": [
                 {
                     "name": v.spec.name,
@@ -129,6 +141,7 @@ class SearchResult:
                         v.minimized.removed if v.minimized else []
                     ),
                     "rendered": v.rendered,
+                    "registered": v.registered,
                 }
                 for v in self.violations
             ],
@@ -300,9 +313,12 @@ class ScenarioSearch:
         weights = [1.0 + self._fitness.get(s.name, 0.0) for s in self.corpus]
         return self.rng.choices(self.corpus, weights=weights, k=1)[0]
 
-    def run(self) -> SearchResult:
+    def run(self, deadline: float | None = None,
+            clock=time.monotonic) -> SearchResult:
         res = self.result
         while res.candidates_run < self.config.budget:
+            if deadline is not None and clock() >= deadline:
+                break
             parent = self._pick_parent()
             cand = self.mutate(parent, res.candidates_run)
             report = self.runner(cand)
@@ -343,3 +359,89 @@ class ScenarioSearch:
 def run_search(config: SearchConfig, runner=None, log=None) -> SearchResult:
     """One budgeted search session (the tools/scenario_search.py core)."""
     return ScenarioSearch(config, runner=runner, log=log).run()
+
+
+# ---------------------------------------------------------------------------
+# Continuous mode: wall-clock-budgeted sweeps feeding the committed
+# regression corpus (tests/fixtures/scenarios/).
+# ---------------------------------------------------------------------------
+
+
+def register_violation(violation: Violation,
+                       register_dir: str | None = None) -> str | None:
+    """Land one ddmin-minimized finding in the regression corpus.
+
+    The minimal spec is renamed to its registry name
+    (``regress-<gates>-<seed>``), round-tripped through
+    ``spec_to_json``/``spec_from_json`` (a fixture that can't rebuild
+    its spec must never be committed), and written as
+    ``<register_dir>/<name>.json`` — the exact file
+    ``parse_scenario_arg`` resolves, so the finding replays standalone
+    via ``--scenario <name>``.  Dedup is by name: an already-registered
+    finding (same gates, same minimal seed) is left untouched and
+    returns None.
+    """
+    if violation.minimized is None:
+        return None
+    minimal = violation.minimized.spec
+    reg_name = f"regress-{'-'.join(violation.failed)}-{minimal.seed}"
+    doc = spec_to_json(replace(minimal, name=reg_name))
+    spec_from_json(doc)  # validate the round-trip BEFORE touching disk
+    out_dir = register_dir or fixture_scenario_dir()
+    path = os.path.join(out_dir, f"{reg_name}.json")
+    if os.path.exists(path):
+        return None
+    os.makedirs(out_dir, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    violation.registered = path
+    return path
+
+
+def run_continuous(config: SearchConfig, budget_seconds: float,
+                   runner=None, log=None, register_dir: str | None = None,
+                   clock=time.monotonic) -> SearchResult:
+    """Wall-clock-budgeted search: repeated sweeps until the budget is
+    spent, each under a seed derived from ``config.seed`` (so a given
+    ``(seed, sweep)`` pair replays deterministically even though the
+    sweep COUNT depends on wall time), with every newly-minimized
+    violation registered into the regression corpus via
+    :func:`register_violation`.
+
+    Distinct-by-gates dedup carries across sweeps: a gate combination
+    already minimized in an earlier sweep is recorded but not
+    re-minimized (and by construction not re-registered — the fixture
+    name is keyed on the failing gates).
+    """
+    emit = log or (lambda msg: None)
+    deadline = clock() + max(0.0, budget_seconds)
+    combined = SearchResult(sweeps=0)
+    seen_gates: set[tuple] = set()
+    while True:
+        sweep = combined.sweeps
+        cfg = replace(config, seed=config.seed + sweep * 1_000_003)
+        search = ScenarioSearch(cfg, runner=runner, log=log)
+        # skip re-minimizing gate combinations earlier sweeps landed
+        for gates in seen_gates:
+            search.result.violations.append(
+                Violation(spec=search.corpus[0], failed=gates,
+                          fingerprint="")
+            )
+        placeholders = len(search.result.violations)
+        res = search.run(deadline=deadline, clock=clock)
+        combined.sweeps += 1
+        combined.candidates_run += res.candidates_run
+        combined.novel_fingerprints += res.novel_fingerprints
+        combined.minimization_steps += res.minimization_steps
+        combined.corpus_names = res.corpus_names
+        for v in res.violations[placeholders:]:
+            combined.violations.append(v)
+            seen_gates.add(v.failed)
+            if v.minimized is not None:
+                path = register_violation(v, register_dir)
+                if path:
+                    emit(f"registered regression fixture: {path}")
+        if clock() >= deadline:
+            break
+    return combined

@@ -230,6 +230,46 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
              int(v), t["min_hostile_peers_banned"],
              "peer scoring must ban byzantine checkpoint servers")
 
+    # ---- saturation-soak gates (deposit saturation / storms / soak) ----
+
+    if t.get("max_deposit_queue_depth") is not None:
+        v = run.get("deposit_queue_depth_max", 0)
+        gate("deposit_queue_depth", v <= t["max_deposit_queue_depth"],
+             int(v), t["max_deposit_queue_depth"],
+             "worst per-epoch deposit backlog (voted deposit_count - "
+             "drained index) — the drain must keep pace with the "
+             "over-rate inflow")
+
+    if t.get("min_deposits_applied") is not None:
+        v = run.get("deposits_applied", 0)
+        gate("deposit_drain", v >= t["min_deposits_applied"], int(v),
+             t["min_deposits_applied"],
+             "the eth1 voting + block-packing drain must stay live "
+             "under saturation")
+
+    if t.get("max_ssz_cache_bytes") is not None:
+        v = run.get("ssz_cache_bytes_max", 0)
+        gate("ssz_cache_bytes", v <= t["max_ssz_cache_bytes"], int(v),
+             t["max_ssz_cache_bytes"],
+             "worst per-epoch growth of the SSZ/state cache byte "
+             "footprint since run start — the eviction budget must "
+             "bound it across epochs")
+
+    if t.get("max_pool_estimated_verify_cost") is not None:
+        v = run.get("pool_estimated_verify_cost_max", 0)
+        gate("pool_verify_cost", v <= t["max_pool_estimated_verify_cost"],
+             int(v), t["max_pool_estimated_verify_cost"],
+             "worst per-epoch estimated marginal verify cost of the "
+             "naive pool — near-duplicate aggregation storms inflate "
+             "this superlinearly unless admission sheds them")
+
+    if t.get("min_storm_shed_rate") is not None:
+        v = run.get("storm_shed_rate", 0.0)
+        gate("storm_shed", v >= t["min_storm_shed_rate"], round(v, 4),
+             t["min_storm_shed_rate"],
+             "cost-based admission must shed the aggregation storm's "
+             "overage before it reaches the pools")
+
     # ---- verification-front-door tenancy gates (tenant-overload) -------
 
     if t.get("max_honest_deadline_miss_rate") is not None:
@@ -285,5 +325,52 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
              t["min_prewarm_loaded"],
              "every program the old node captured must deserialize and "
              "install on the standby")
+
+    return out
+
+
+#: the threshold keys evaluate_epoch localizes — per-epoch facts the
+#: engine snapshots at every epoch boundary, so a slow leak or a
+#: mid-run saturation blows the gate AT THE EPOCH IT STARTS
+#: (``first_violation_epoch`` in the report) instead of only at run end
+EPOCH_GATED_KEYS = (
+    "max_deposit_queue_depth",
+    "max_ssz_cache_bytes",
+    "max_pool_estimated_verify_cost",
+)
+
+
+def evaluate_epoch(thresholds: dict, facts: dict) -> list[SLOResult]:
+    """Gate one epoch's snapshot facts (a subset of the run-level gates
+    — see :data:`EPOCH_GATED_KEYS`).  The run-level ``evaluate`` gates
+    the worst epoch's value, so the verdict has one source of truth;
+    this localizes the violation to the epoch it first appears in."""
+    out: list[SLOResult] = []
+    t = thresholds
+
+    if t.get("max_deposit_queue_depth") is not None:
+        v = facts.get("deposit_queue_depth", 0)
+        out.append(SLOResult(
+            "deposit_queue_depth", v <= t["max_deposit_queue_depth"],
+            int(v), t["max_deposit_queue_depth"],
+            "deposit backlog at this epoch's boundary",
+        ))
+
+    if t.get("max_ssz_cache_bytes") is not None:
+        v = facts.get("ssz_cache_bytes", 0)
+        out.append(SLOResult(
+            "ssz_cache_bytes", v <= t["max_ssz_cache_bytes"], int(v),
+            t["max_ssz_cache_bytes"],
+            "SSZ/state cache byte growth since run start",
+        ))
+
+    if t.get("max_pool_estimated_verify_cost") is not None:
+        v = facts.get("pool_estimated_verify_cost", 0)
+        out.append(SLOResult(
+            "pool_verify_cost",
+            v <= t["max_pool_estimated_verify_cost"], int(v),
+            t["max_pool_estimated_verify_cost"],
+            "naive-pool estimated verify cost at this epoch's boundary",
+        ))
 
     return out

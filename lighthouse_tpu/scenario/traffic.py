@@ -26,6 +26,10 @@ class Shape:
     def on_attestations(self, engine, slot: int, atts: list) -> None:
         """Called after the honest committees attested at ``slot``."""
 
+    def on_epoch(self, engine, epoch: int, facts: dict) -> None:
+        """Contribute to the engine's per-epoch snapshot ``facts``
+        (taken at every epoch boundary, after the heal pass)."""
+
     def finalize(self, engine) -> None:
         """End-of-run bookkeeping into the engine report."""
 
@@ -117,6 +121,112 @@ class DepositQueue(Shape):
         state = engine.sim.nodes[0].chain.head_state()
         engine.run_facts["deposits_applied"] = (
             int(state.eth1_deposit_index) - self._base
+        )
+
+
+class DepositSaturation(Shape):
+    """Deposit-queue saturation: inflow pinned ABOVE the drain rate.
+
+    Unlike :class:`DepositQueue` (a fixed batch inserted once at
+    install), this shape keeps the eth1 contract LIVE for the whole run:
+    every slot it inserts ``inflow_per_slot`` new deposit logs (top-ups
+    to existing validators, so the transition's signature check stays
+    off the hot path) and one eth1 block snapshot capturing the tree's
+    count/root at that instant.  Voting herds onto snapshots that trail
+    the tip by ``eth1_follow_distance`` blocks, and blocks drain at most
+    ``max_deposits`` per slot against the *voted* snapshot — proofs are
+    generated against that historical tree (``DepositTree.proof(index,
+    count)``), which is what makes a growing tree safe.  With the
+    scenario's override of inflow > drain the backlog grows by design;
+    the SLO gates judge whether it stays inside budget and whether the
+    drain stays live.
+    """
+
+    name = "deposit-saturation"
+    inflow_per_slot = 6
+    topup_gwei = 1_000_000_000  # 1 ETH per top-up
+
+    def __init__(self):
+        self._svc = None
+        self._base = 0
+        self._inserted = 0
+        self.depth_max = 0
+
+    def install(self, engine) -> None:
+        from ..beacon.eth1 import Eth1Service
+
+        spec = engine.sim.spec
+        state = engine.sim.nodes[0].chain.head_state()
+        self._base = int(state.eth1_deposit_index)
+        self._svc = Eth1Service(spec)
+        # prime the block window so eth1_data_for_vote has a trailing
+        # candidate from the first voting period
+        self._insert_inflow(engine, slot=0)
+        for node in engine.sim.nodes:
+            node.chain.eth1 = self._svc
+        engine.note("deposit-saturation",
+                    inflow_per_slot=self.inflow_per_slot)
+
+    def _insert_inflow(self, engine, slot: int) -> None:
+        from ..beacon.eth1 import Eth1Block
+        from ..consensus.containers import DepositData
+
+        state = engine.sim.nodes[0].chain.head_state()
+        cache = self._svc.deposit_cache
+        for _ in range(self.inflow_per_slot):
+            v = state.validators[
+                self._inserted % engine.spec.n_validators
+            ]
+            cache.insert_log(
+                self._base + self._inserted,
+                DepositData(
+                    pubkey=bytes(v.pubkey),
+                    withdrawal_credentials=bytes(
+                        v.withdrawal_credentials
+                    ),
+                    amount=self.topup_gwei,
+                ),
+            )
+            self._inserted += 1
+        self._svc.insert_block(
+            Eth1Block(
+                number=slot + 1,
+                hash=b"\xe1" + slot.to_bytes(8, "little") + bytes(23),
+                timestamp=slot,
+                deposit_count=cache.count(),
+                deposit_root=cache.deposit_root(),
+            )
+        )
+
+    def on_attestations(self, engine, slot: int, atts: list) -> None:
+        self._insert_inflow(engine, slot)
+
+    def _queue_depth(self, engine) -> int:
+        state = engine.sim.nodes[0].chain.head_state()
+        return max(
+            0,
+            int(state.eth1_data.deposit_count)
+            - int(state.eth1_deposit_index),
+        )
+
+    def on_epoch(self, engine, epoch: int, facts: dict) -> None:
+        depth = self._queue_depth(engine)
+        self.depth_max = max(self.depth_max, depth)
+        facts["deposit_queue_depth"] = depth
+        facts["deposits_applied"] = (
+            int(engine.sim.nodes[0].chain.head_state().eth1_deposit_index)
+            - self._base
+        )
+        facts["deposits_queued"] = self._inserted
+
+    def finalize(self, engine) -> None:
+        state = engine.sim.nodes[0].chain.head_state()
+        engine.run_facts["deposits_applied"] = (
+            int(state.eth1_deposit_index) - self._base
+        )
+        engine.run_facts["deposits_queued"] = self._inserted
+        engine.run_facts["deposit_queue_depth_max"] = max(
+            self.depth_max, self._queue_depth(engine)
         )
 
 
@@ -232,8 +342,8 @@ class ExitFlood(Shape):
 
 SHAPES = {
     cls.name: cls
-    for cls in (AttestationFlood, DepositQueue, ProposerReorg, Equivocation,
-                EquivocationStorm, ExitFlood)
+    for cls in (AttestationFlood, DepositQueue, DepositSaturation,
+                ProposerReorg, Equivocation, EquivocationStorm, ExitFlood)
 }
 
 

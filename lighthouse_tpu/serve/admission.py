@@ -41,6 +41,40 @@ PRIORITY_CLASSES = {
 assert all(k in PRIORITY_ORDER for k in PRIORITY_CLASSES.values())
 
 
+def _message_key(s) -> object:
+    """The dedup identity of one signature set's message (best effort —
+    the cost model must price ANY payload shape without raising)."""
+    msg = getattr(s, "message", None)
+    if msg is None and isinstance(s, (tuple, list)) and s:
+        msg = s[0]
+    if msg is None:
+        msg = s
+    try:
+        return bytes(msg)
+    except Exception:
+        return repr(msg)
+
+
+def estimated_verify_cost(sets) -> float:
+    """Marginal batch-verify cost of a payload, in set-equivalents.
+
+    A batch verifier amortizes *distinct* messages; near-duplicate
+    aggregates over the same message (committee-overlap storms with
+    bit-twiddled participation sets) defeat both dedup and aggregation,
+    so each further copy of a message inside one payload prices
+    superlinearly: the k-th set carrying the same message costs k.  A
+    payload of n distinct messages still costs exactly n, so honest
+    traffic is admitted at face value.
+    """
+    seen: dict = {}
+    cost = 0.0
+    for s in sets:
+        key = _message_key(s)
+        seen[key] = seen.get(key, 0) + 1
+        cost += seen[key]
+    return cost
+
+
 @dataclass
 class TenantPolicy:
     """One tenant's admission contract."""
@@ -78,10 +112,16 @@ class AdmissionController:
 
     def __init__(self, policies: dict[str, TenantPolicy] | None = None,
                  default_policy: TenantPolicy | None = None,
-                 breaker=None, now=time.monotonic):
+                 breaker=None, now=time.monotonic, cost_model=None):
         self.policies = dict(policies or {})
         self.default_policy = default_policy or TenantPolicy()
         self.breaker = breaker
+        #: optional ``sets -> float`` pricing a submission in
+        #: set-equivalents for the token bucket (the queue-depth gate
+        #: stays in raw sets).  :func:`estimated_verify_cost` makes
+        #: near-duplicate aggregation storms pay their superlinear
+        #: verify cost up front instead of being admitted by set count.
+        self.cost_model = cost_model
         self._now = now
         self._lock = threading.Lock()
         self._buckets: dict[str, _Bucket] = {}
@@ -100,12 +140,21 @@ class AdmissionController:
         ``BeaconProcessor.degraded``)."""
         return self.breaker is not None and not self.breaker.is_closed
 
-    def admit(self, tenant: str, n_sets: int) -> tuple[bool, str]:
+    def admit(self, tenant: str, n_sets: int,
+              sets=None) -> tuple[bool, str]:
         """Decide one submission of ``n_sets`` sets: ``(True, "ok")`` or
         ``(False, reason)`` with reason in rate-limit / queue-full /
-        degraded."""
+        degraded.  When a ``cost_model`` is configured and the caller
+        passes the ``sets`` themselves, the token bucket is charged the
+        model's estimate instead of the raw set count."""
         pol = self.policy_for(tenant)
         now = self._now()
+        cost = float(n_sets)
+        if self.cost_model is not None and sets is not None:
+            try:
+                cost = max(cost, float(self.cost_model(sets)))
+            except Exception:  # the model must never turn into an outage
+                cost = float(n_sets)
         with self._lock:
             if self.degraded and pol.kind in DEGRADED_SHED_KINDS:
                 return self._shed(tenant, "degraded")
@@ -116,7 +165,7 @@ class AdmissionController:
                 b = self._buckets[tenant] = _Bucket(
                     tokens=pol.burst, stamp=now, policy=pol,
                 )
-            if not b.take(float(n_sets), now):
+            if not b.take(cost, now):
                 return self._shed(tenant, "rate-limit")
             self.queued[tenant] = self.queued.get(tenant, 0) + n_sets
             self.accepted[tenant] = self.accepted.get(tenant, 0) + 1

@@ -112,7 +112,7 @@ class VerifyService:
                  flush_margin: float = 0.02,
                  default_deadline_s: float = 0.25,
                  injector=None, now=time.monotonic,
-                 max_done: int = 4096):
+                 max_done: int = 4096, cost_model=None):
         from ..utils import faults as faults_mod
 
         self._verifier = verifier
@@ -124,7 +124,7 @@ class VerifyService:
         self.default_deadline_s = float(default_deadline_s)
         self.admission = AdmissionController(
             policies=policies, default_policy=default_policy,
-            breaker=breaker, now=now,
+            breaker=breaker, now=now, cost_model=cost_model,
         )
         self.batcher = DeadlineAwareBatcher(
             compiled_sizes, flush_margin=flush_margin, now=now,
@@ -178,7 +178,8 @@ class VerifyService:
                 M.SERVE_SHED.inc(labels=(tenant, "malformed"))
                 return SubmitResult(accepted=False, reason="malformed",
                                     tenant=tenant)
-            ok, reason = self.admission.admit(tenant, len(sets))
+            ok, reason = self.admission.admit(tenant, len(sets),
+                                              sets=sets)
             if not ok:
                 M.SERVE_SHED.inc(labels=(tenant, reason))
                 return SubmitResult(accepted=False, reason=reason,

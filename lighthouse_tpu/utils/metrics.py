@@ -516,6 +516,38 @@ SERVE_E2E_LATENCY = Histogram(
 )
 
 
+# ---------------------------------------------------------------------------
+# Saturation-soak surface (scenario soaks at mainnet validator counts):
+# the SSZ/state cache byte budget (consensus/ssz.py + committees.py — the
+# caches the 1M-validator copy-on-write registry trick leans on), the eth1
+# deposit queue backlog (chain.py block production), and the naive
+# aggregation pool's estimated batch-verify cost (the committee-overlap
+# storm's superlinear blowup signal, arXiv:2302.00418).
+# ---------------------------------------------------------------------------
+
+SSZ_CACHE_BYTES = Gauge(
+    "ssz_cache_bytes",
+    "Approximate bytes pinned by the SSZ root/serialize caches and the "
+    "active-indices caches (keys + pinned values), budget-evicted",
+)
+SSZ_CACHE_EVICTIONS = Counter(
+    "ssz_cache_evictions_total",
+    "SSZ/state cache entries evicted (capacity cap or byte-budget bound)",
+)
+DEPOSIT_QUEUE_DEPTH = Gauge(
+    "deposit_queue_depth",
+    "Eth1 deposits voted in but not yet drained on-chain "
+    "(effective eth1_data.deposit_count - state.eth1_deposit_index) at "
+    "the last block production",
+)
+POOL_ESTIMATED_VERIFY_COST = Gauge(
+    "pool_estimated_verify_cost",
+    "Estimated marginal batch-verify cost of the naive aggregation pool "
+    "(resident signatures across groups — superlinear under "
+    "committee-overlap aggregation storms)",
+)
+
+
 def render() -> str:
     """Prometheus text exposition of every registered metric."""
     out = []

@@ -8,3 +8,14 @@ SCENARIOS = {
     "smoke-fixture": object(),
     "soak-fixture": object(),
 }
+
+
+# Fixture twin of the spec module's fixture-corpus schema: the
+# scenario-fixture family AST-parses these literals to validate the
+# committed JSON corpus (allowed fields + registerable SLO keys).
+DEFAULT_SLO: dict = {
+    "max_widget_latency": None,
+    "min_frobs": None,
+}
+
+_SPEC_JSON_FIELDS = ("name", "seed", "slo")

@@ -295,3 +295,123 @@ def test_budgeted_search_minimizes_and_reproduces_standalone():
     # still fails the same gate
     report = ScenarioEngine(minimal).run()
     assert "device_retries" in failing_gates(report)
+
+
+# ---------------------------------------------------------------------------
+# Continuous mode: wall-clock sweeps feeding the fixture corpus
+# ---------------------------------------------------------------------------
+
+from lighthouse_tpu.scenario.search import (
+    Violation,
+    register_violation,
+    run_continuous,
+)
+from lighthouse_tpu.scenario.spec import spec_from_json
+
+
+class _FakeClock:
+    """Deterministic wall clock: each call advances by ``step``."""
+
+    def __init__(self, step=1.0):
+        self.now = 0.0
+        self.step = step
+
+    def __call__(self):
+        t = self.now
+        self.now += self.step
+        return t
+
+
+class TestContinuousSearch:
+    def _config(self, **kw):
+        kw.setdefault("seed", 5)
+        kw.setdefault("budget", 8)
+        kw.setdefault("corpus", ("smoke-weak",))
+        kw.setdefault("tracks", ("device-faults", "gossip-faults"))
+        kw.setdefault("shapes", ())
+        kw.setdefault("minimize_steps", 20)
+        return SearchConfig(**kw)
+
+    def test_registers_minimized_finding_as_replayable_fixture(
+            self, tmp_path, monkeypatch):
+        import lighthouse_tpu.scenario.search as search_mod
+
+        monkeypatch.setattr(
+            search_mod, "SCENARIOS",
+            {**SCENARIOS, "smoke-weak": WEAK_TWIN},
+        )
+        res = run_continuous(
+            self._config(), budget_seconds=100.0,
+            runner=_synthetic_runner, register_dir=str(tmp_path),
+            clock=_FakeClock(),
+        )
+        hits = [v for v in res.violations if v.registered]
+        assert hits, [v.failed for v in res.violations]
+        files = sorted(tmp_path.glob("*.json"))
+        assert files
+        # every registered fixture round-trips through spec_from_json
+        # and its name matches the file stem --scenario resolves by
+        import json as _json
+        for f in files:
+            spec = spec_from_json(_json.loads(f.read_text()))
+            assert spec.name == f.stem
+            assert spec.name.startswith("regress-")
+
+    def test_gate_dedup_carries_across_sweeps(self, tmp_path, monkeypatch):
+        import lighthouse_tpu.scenario.search as search_mod
+
+        monkeypatch.setattr(
+            search_mod, "SCENARIOS",
+            {**SCENARIOS, "smoke-weak": WEAK_TWIN},
+        )
+        res = run_continuous(
+            self._config(budget=4), budget_seconds=200.0,
+            runner=_synthetic_runner, register_dir=str(tmp_path),
+            clock=_FakeClock(),
+        )
+        assert res.sweeps > 1  # the budget really spanned sweeps
+        assert res.candidates_run > 4
+        # the planted violation has ONE gate combination; later sweeps
+        # must not re-minimize or re-register it
+        minimized = [v for v in res.violations if v.minimized is not None]
+        assert len(minimized) == len({v.failed for v in res.violations})
+        assert len(list(tmp_path.glob("*.json"))) == len(minimized)
+
+    def test_deadline_stops_mid_sweep(self):
+        calls = []
+
+        def runner(spec):
+            calls.append(spec)
+            return {"fingerprint": f"fp{len(calls)}", "slo": []}
+
+        res = run_continuous(
+            self._config(budget=1000, corpus=("smoke",)), budget_seconds=5.0,
+            runner=runner, clock=_FakeClock(step=1.0),
+        )
+        # clock hits the 5s deadline long before 1000 candidates
+        assert res.candidates_run < 1000
+        assert res.sweeps == 1
+
+    def test_register_violation_requires_minimized_and_dedups(
+            self, tmp_path):
+        v = Violation(spec=WEAK_TWIN, failed=("device_retries",),
+                      fingerprint="x")
+        assert register_violation(v, str(tmp_path)) is None  # no minimized
+
+        from lighthouse_tpu.scenario.minimize import MinimizeResult
+
+        minimal = replace(WEAK_TWIN, adversity=("device-faults",))
+        v = Violation(spec=WEAK_TWIN, failed=("device_retries",),
+                      fingerprint="x",
+                      minimized=MinimizeResult(minimal, 3, []))
+        path = register_violation(v, str(tmp_path))
+        assert path and path.endswith(
+            f"regress-device_retries-{minimal.seed}.json"
+        )
+        assert v.registered == path
+        # same gates + same minimal seed => already on disk => no-op
+        v2 = Violation(spec=WEAK_TWIN, failed=("device_retries",),
+                       fingerprint="y",
+                       minimized=MinimizeResult(minimal, 3, []))
+        assert register_violation(v2, str(tmp_path)) is None
+        assert len(list(tmp_path.glob("*.json"))) == 1

@@ -840,3 +840,42 @@ def test_chaos_sync_soak_under_lockcheck():
         static = static_lock_order([(rel, f.read())])
     assert ("SyncManager._tick_lock", "SyncManager._lock") in static
     rec.verify(static)
+
+
+# -- scenario-fixture corpus (the committed regression scenarios) --------
+
+
+def test_scenario_fixture_fires_on_every_seeded_shape(corpus_result):
+    vios = _by_rule(corpus_result)["scenario-fixture"]
+    symbols = {v.symbol for v in vios}
+    assert "broken" in symbols            # non-JSON fixture
+    assert "other-name" in symbols        # name != file stem
+    assert "seed" in symbols              # required field missing
+    assert "max_unregistered" in symbols  # SLO key not in DEFAULT_SLO
+    assert "frobnicate" in symbols        # field not in _SPEC_JSON_FIELDS
+    # the well-formed seeded fixture passes every check
+    assert not any("regress-fixture-good" in v.path for v in vios)
+
+
+def test_live_scenario_fixture_corpus_replays(live_result):
+    # every committed regression fixture under tests/fixtures/scenarios
+    # parses, matches its stem, names only registered SLO keys, and
+    # round-trips through the real parse_scenario_arg — zero waivers
+    assert not [
+        v for v in live_result.violations if v.rule == "scenario-fixture"
+    ]
+
+
+def test_scenario_fixture_schema_parses_spec_module():
+    from lighthouse_tpu.analysis.registry_lint import (
+        scenario_fixture_schema,
+    )
+    from lighthouse_tpu.scenario.spec import _SPEC_JSON_FIELDS, DEFAULT_SLO
+
+    path = os.path.join(REPO, "lighthouse_tpu", "scenario", "spec.py")
+    with open(path) as f:
+        fields, slo_keys = scenario_fixture_schema(f.read(), path)
+    # the AST view must bind the live literals exactly — a drifted
+    # schema would silently stop validating the corpus
+    assert fields == set(_SPEC_JSON_FIELDS)
+    assert slo_keys == set(DEFAULT_SLO)

@@ -104,7 +104,47 @@ def main(argv=None) -> int:
             print(f"FINGERPRINT DIVERGENCE over {args.repeat} runs: {fps}")
             return 2
         print(f"fingerprint stable over {args.repeat} runs: {fps[0]}")
+        # the fingerprint only covers fault/head/finality history; the
+        # per-epoch SLO snapshots must replay identically too, and a
+        # divergence names the first epoch that drifted
+        divergent = _first_divergent_epoch(reports)
+        if divergent is not None:
+            print(f"EPOCH SLO DIVERGENCE over {args.repeat} runs: "
+                  f"first divergent epoch {divergent}")
+            return 2
+        n_epochs = len(reports[0].get("epochs") or ())
+        if n_epochs:
+            print(f"per-epoch SLO snapshots stable over {args.repeat} "
+                  f"runs: {n_epochs} epochs")
     return 0 if all(r["pass"] for r in reports) else 1
+
+
+def _epoch_signature(report: dict) -> list:
+    """Comparable per-epoch digest: (epoch, gate verdicts, facts).
+    Tolerant of reports without epoch records (older engines, stubs)."""
+    out = []
+    for rec in report.get("epochs") or ():
+        gates = tuple(
+            (g.get("name"), bool(g.get("ok")))
+            for g in rec.get("slo") or ()
+        )
+        facts = tuple(sorted((rec.get("facts") or {}).items()))
+        out.append((rec.get("epoch"), gates, facts))
+    return out
+
+
+def _first_divergent_epoch(reports: list) -> int | None:
+    """First epoch whose SLO snapshot differs from run 1's, or None."""
+    base = _epoch_signature(reports[0])
+    for rep in reports[1:]:
+        sig = _epoch_signature(rep)
+        for a, b in zip(base, sig):
+            if a != b:
+                return a[0]
+        if len(base) != len(sig):
+            longer = base if len(base) > len(sig) else sig
+            return longer[min(len(base), len(sig))][0]
+    return None
 
 
 if __name__ == "__main__":

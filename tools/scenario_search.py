@@ -12,11 +12,19 @@ Exit status: 0 when the search completes with no violations, 3 when it
 found at least one (the interesting outcome — a regression scenario to
 register), non-zero argparse errors otherwise.
 
+Continuous mode (``--budget-seconds``) trades the candidate budget for a
+wall-clock one: sweeps keep launching under derived seeds until the
+budget is spent, and every ddmin-minimized violation is auto-registered
+as a JSON fixture in the committed regression corpus
+(``tests/fixtures/scenarios/`` by default) where ``--scenario <name>``
+replays it standalone.
+
 Usage:
     tools/pyrun tools/scenario_search.py --budget 32 --seed 7
     tools/pyrun tools/scenario_search.py --corpus smoke --corpus long-non-finality
     tools/pyrun tools/scenario_search.py --budget 8 --json /tmp/search.json
     tools/pyrun tools/scenario_search.py --tracks device-faults --no-history
+    tools/pyrun tools/scenario_search.py --budget-seconds 60 --corpus smoke
 """
 
 from __future__ import annotations
@@ -50,6 +58,15 @@ def main(argv=None) -> int:
     ap.add_argument("--minimize-steps", type=int, default=24, metavar="N",
                     help="oracle budget per violation (0 disables "
                          "minimization)")
+    ap.add_argument("--budget-seconds", type=float, default=None,
+                    metavar="S",
+                    help="continuous mode: run sweeps of --budget "
+                         "candidates under derived seeds until S seconds "
+                         "of wall clock are spent, registering minimized "
+                         "violations into the regression corpus")
+    ap.add_argument("--register-dir", metavar="DIR", default=None,
+                    help="fixture corpus directory for continuous-mode "
+                         "findings (default: tests/fixtures/scenarios)")
     ap.add_argument("--json", metavar="PATH",
                     help="write the full search result JSON to PATH")
     ap.add_argument("--no-history", action="store_true",
@@ -57,10 +74,16 @@ def main(argv=None) -> int:
                          "BENCH_HISTORY.jsonl")
     args = ap.parse_args(argv)
 
-    from lighthouse_tpu.scenario.search import SearchConfig, run_search
+    from lighthouse_tpu.scenario.search import (
+        SearchConfig,
+        run_continuous,
+        run_search,
+    )
 
     if args.budget < 1:
         ap.error("--budget must be >= 1")
+    if args.budget_seconds is not None and args.budget_seconds <= 0:
+        ap.error("--budget-seconds must be > 0")
     config = SearchConfig(
         seed=args.seed,
         budget=args.budget,
@@ -69,7 +92,13 @@ def main(argv=None) -> int:
         tracks=tuple(args.tracks) if args.tracks else None,
     )
     t0 = time.time()
-    result = run_search(config, log=print)
+    if args.budget_seconds is not None:
+        result = run_continuous(
+            config, args.budget_seconds, log=print,
+            register_dir=args.register_dir,
+        )
+    else:
+        result = run_search(config, log=print)
     elapsed = round(time.time() - t0, 3)
     out = result.to_dict()
     out["seed"] = args.seed
@@ -79,10 +108,12 @@ def main(argv=None) -> int:
           f"{len(result.violations)} violations, "
           f"{result.novel_fingerprints} novel fingerprints, "
           f"{result.minimization_steps} minimization steps, "
-          f"elapsed={elapsed}s")
+          f"{result.sweeps} sweeps, elapsed={elapsed}s")
     for v in result.violations:
         print(f"\nviolation: {v.spec.name} fails {list(v.failed)} "
               f"(fingerprint {v.fingerprint})")
+        if v.registered:
+            print(f"registered fixture: {v.registered}")
         if v.rendered:
             print("minimized registry entry (paste into "
                   "lighthouse_tpu/scenario/spec.py SCENARIOS):")
@@ -102,6 +133,8 @@ def main(argv=None) -> int:
             ),
             "seed": args.seed,
             "budget": args.budget,
+            "budget_seconds": args.budget_seconds,
+            "sweeps": result.sweeps,
             "corpus": list(config.corpus),
             "candidates_run": result.candidates_run,
             "violations_found": len(result.violations),
