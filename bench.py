@@ -185,6 +185,9 @@ def run_measurement(force_cpu: bool) -> None:
     if os.environ.get("BENCH_AUTOTUNE", "") == "1":
         result["autotune"] = _measure_autotune()
         _record_autotune_history(result)
+    if os.environ.get("BENCH_INTEGRITY", "") == "1":
+        result["integrity"] = _measure_integrity()
+        _record_integrity_history(result)
     # every jit.compile span recorded this run, with per-program
     # fingerprints — the compile-time attribution ROADMAP item 4 asks for
     from lighthouse_tpu.obs import TRACER
@@ -809,6 +812,126 @@ def _measure_serve(device_h2c: bool) -> dict:
         "sets_per_request": sets_per,
         "points": points,
     }
+
+
+def _measure_integrity() -> dict:
+    """BENCH_INTEGRITY=1: verdict-integrity canary overhead A/B.
+
+    Drives an :class:`IntegrityGuard` over a calibrated cost-model
+    verifier (``BENCH_INTEGRITY_CALL_MS`` fixed per-dispatch overhead +
+    ``BENCH_INTEGRITY_SET_US`` per set — the serve bench's idiom, so the
+    guard's *structural* cost is isolated from kernel throughput) at the
+    committee shape (``BENCH_INTEGRITY_SETS``, default 2048 = 16
+    committees x 128 signers) across a canary-count sweep
+    (``BENCH_INTEGRITY_K``, default ``0,1,2,4``; 0 is the unguarded
+    baseline).  Each canary is a single-set known-answer batch on a
+    prewarmed program, so its per-dispatch floor (default 1ms) is the
+    cached single-set call cost, not a full coalesced dispatch.  The
+    acceptance bar: overhead at the default K stays <=2% of the
+    committee-shape dispatch.  Feeds the kind="integrity" BENCH_HISTORY
+    rows."""
+    from lighthouse_tpu.beacon.processor import BatchOutcome
+    from lighthouse_tpu.integrity.corpus import DEFAULT_K, CanaryCorpus
+    from lighthouse_tpu.integrity.guard import IntegrityGuard
+
+    n_sets = int(os.environ.get("BENCH_INTEGRITY_SETS", "2048"))
+    iters = int(os.environ.get("BENCH_INTEGRITY_ITERS", "10"))
+    call_ms = float(os.environ.get("BENCH_INTEGRITY_CALL_MS", "1.0"))
+    set_us = float(os.environ.get("BENCH_INTEGRITY_SET_US", "100.0"))
+    ks = sorted({
+        int(k) for k in os.environ.get(
+            "BENCH_INTEGRITY_K", f"0,1,{DEFAULT_K},4"
+        ).split(",")
+    } | {0, DEFAULT_K})
+
+    cc = CanaryCorpus()
+    truth = {}
+    for e in cc.entries():
+        for s in e.sets:
+            truth[id(s)] = e.expected
+
+    class CostModelVerifier:
+        """Calibrated inner rung: answers the canaries honestly (their
+        known verdicts), everything else True, and charges the modelled
+        dispatch cost."""
+
+        def verify_batch(self, sets):
+            time.sleep(call_ms / 1e3 + set_us * len(sets) / 1e6)
+            return BatchOutcome(
+                [truth.get(id(s), True) for s in sets], 1
+            )
+
+    payload = [object() for _ in range(n_sets)]
+    points = []
+    for k in ks:
+        guard = IntegrityGuard(
+            CostModelVerifier(), None, corpus=cc, k=k, enabled=k > 0,
+        )
+        guard.verify_batch(payload)  # warm the corpus memo, untimed
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = guard.verify_batch(payload)
+            times.append(time.perf_counter() - t0)
+            assert len(out.verdicts) == n_sets
+        assert guard.distrusted == 0, "cost model failed its own canaries"
+        times.sort()
+        points.append({
+            "k": k,
+            "seconds_per_batch": times[len(times) // 2],
+            "canary_checks": guard.canary_checks,
+        })
+    base = points[0]["seconds_per_batch"]
+    for p in points:
+        p["overhead_pct"] = round(
+            (p["seconds_per_batch"] / base - 1.0) * 100.0, 3
+        )
+    at_default = next(p for p in points if p["k"] == DEFAULT_K)
+    out = {
+        "n_sets": n_sets,
+        "iters": iters,
+        "call_ms": call_ms,
+        "set_us": set_us,
+        "default_k": DEFAULT_K,
+        "points": points,
+        "overhead_at_default_pct": at_default["overhead_pct"],
+    }
+    print(
+        f"integrity: K={DEFAULT_K} overhead "
+        f"{at_default['overhead_pct']:.2f}% on {n_sets}-set committee "
+        f"shape (bar: <=2%)",
+        file=sys.stderr,
+    )
+    return out
+
+
+def _record_integrity_history(result: dict) -> None:
+    """Append a kind="integrity" row per canary-count operating point so
+    the guard's overhead curve is tracked in BENCH_HISTORY alongside the
+    serve rows.  Recorded for CPU children too (the cost-model sweep is
+    host-independent structural overhead); the device and shape fields
+    keep rows comparable only with their own kind."""
+    try:
+        g = result.get("integrity")
+        if not g:
+            return
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(_history_path(), "a") as f:
+            for p in g.get("points", ()):
+                row = {
+                    "kind": "integrity",
+                    "device": result.get("device"),
+                    "device_kind": result.get("device_kind") or _device_kind(),
+                    "n_sets": g.get("n_sets"),
+                    "call_ms": g.get("call_ms"),
+                    "set_us": g.get("set_us"),
+                    "default_k": g.get("default_k"),
+                    "measured_at": stamp,
+                }
+                row.update(p)
+                f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
 
 
 def _record_serve_history(result: dict) -> None:

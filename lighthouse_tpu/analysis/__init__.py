@@ -52,6 +52,7 @@ DEFAULT_NEVER_RAISE = (
     "lighthouse_tpu/ingest/engine.py::IngestEngine.marshal_sets",
     "lighthouse_tpu/parallel/pod.py::PodVerifier.verify_batch",
     "lighthouse_tpu/serve/service.py::VerifyService.tick",
+    "lighthouse_tpu/integrity/guard.py::IntegrityGuard.verify_batch",
 )
 
 ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr", "range")
@@ -98,6 +99,10 @@ class AuditConfig:
     # known proven arms, power-of-2 shapes, registered kernels)
     tune_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/autotune.py"
     fp_defs: str = "lighthouse_tpu/crypto/bls/jax_backend/fp.py"
+    # verdict-integrity layer: CANARY_CORPUS rows must be well-formed
+    # with a valid/invalid mix, and REQUIRED_CHAOS_KINDS must
+    # cross-reference the chaos kind registry both directions
+    integrity_defs: str = "lighthouse_tpu/integrity/corpus.py"
     docs: tuple = ("README.md", "STATUS.md")
     hot_path: dict = field(
         default_factory=lambda: dict(jaxpr_lint.DEFAULT_HOT_PATH)
@@ -252,6 +257,8 @@ def load_config(path: str) -> AuditConfig:
         cfg.tune_defs = a["tune_defs"]
     if "fp_defs" in a:
         cfg.fp_defs = a["fp_defs"]
+    if "integrity_defs" in a:
+        cfg.integrity_defs = a["integrity_defs"]
     if "docs" in a:
         cfg.docs = tuple(a["docs"])
     if "site_scan_exclude" in a:
@@ -393,6 +400,7 @@ def run_audit(
             tune_defs_path=cfg.tune_defs,
             fp_defs_path=cfg.fp_defs,
             scenario_fixtures=scenario_fixtures,
+            integrity_defs_path=cfg.integrity_defs,
         ))
         fam_t["registry"] = time.perf_counter() - t
 

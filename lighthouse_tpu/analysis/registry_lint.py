@@ -48,6 +48,14 @@ Three cross-reference families, all driven off the canonical registries:
   under the manifest signature, name a registered kernel (orphans are
   stale working sets the prewarm phase would waste boot time on), and
   carry the metadata fields ``prewarm`` keys on.
+* **integrity-corpus** — the verdict-integrity canary registry
+  (``CANARY_CORPUS`` in ``integrity/corpus.py``, AST-parsed literal)
+  must hold well-formed ``(entry_id, kind, note)`` rows with unique
+  ids and at least one valid AND one invalid canary, and
+  ``REQUIRED_CHAOS_KINDS`` must cross-reference the chaos kind
+  registry (``_KINDS`` in ``utils/faults.py``) both directions —
+  every claimed silent-fault kind armable, every registered
+  ``silent-*`` kind claimed.
 
 The docs cross-check covers ``*_total``, ``*_seconds`` and ``*_percent``
 metric tokens (counters, histograms and gauges).
@@ -1283,6 +1291,176 @@ def tune_plan_violations(files, tune_defs_path, fp_defs_path,
     return out
 
 
+def integrity_defs(src: str, path: str):
+    """AST-parse the verdict-integrity registries from
+    ``integrity/corpus.py``: the ``CANARY_CORPUS`` assign node (the
+    known-answer rows) and the ``REQUIRED_CHAOS_KINDS`` assign node (the
+    silent-fault kinds the canary layer claims to defend against).
+    Either is None when missing.  Pure AST — both must stay literals for
+    the audit to bind, exactly like ARM_TABLE / SPANS."""
+    tree = ast.parse(src, filename=path)
+    corpus = kinds = None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "CANARY_CORPUS" in names:
+            corpus = node
+        if "REQUIRED_CHAOS_KINDS" in names:
+            kinds = node
+    return corpus, kinds
+
+
+def _fault_kind_defs(src: str, path: str):
+    """The literal ``_KINDS`` tuple from ``utils/faults.py`` (the chaos
+    kind registry), or None when missing/non-literal."""
+    tree = ast.parse(src, filename=path)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "_KINDS" in names and isinstance(
+                node.value, (ast.Tuple, ast.List)):
+            return [
+                x.value for x in node.value.elts
+                if isinstance(x, ast.Constant) and isinstance(x.value, str)
+            ]
+    return None
+
+
+def integrity_violations(files, integrity_defs_path,
+                         faults_defs_path) -> list[Violation]:
+    """Verdict-integrity registry consistency (integrity/corpus.py):
+
+    * ``CANARY_CORPUS`` must be a literal tuple of 3-constant rows
+      ``(entry_id, kind, note)`` with kind in {valid, invalid}, unique
+      entry ids, and at least one row of EACH kind — a corpus without an
+      invalid canary can never catch a stuck-True device, and one
+      without a valid canary can never catch a stuck-False one.
+    * ``REQUIRED_CHAOS_KINDS`` must cross-reference the chaos kind
+      registry (``_KINDS`` in utils/faults.py) both directions: every
+      claimed kind must be armable, and every registered ``silent-*``
+      kind must be claimed — an unclaimed silent kind is corruption the
+      coverage contract silently stopped defending against.
+    """
+    files = dict(files)
+    out: list[Violation] = []
+    src = files.get(integrity_defs_path)
+    if src is None:
+        return out  # corpus without the integrity layer: skip the family
+    corpus, kinds = integrity_defs(src, integrity_defs_path)
+    if corpus is None or not isinstance(
+            corpus.value, (ast.Tuple, ast.List)):
+        out.append(Violation(
+            rule="integrity-corpus", path=integrity_defs_path,
+            line=0 if corpus is None else corpus.lineno,
+            symbol="CANARY_CORPUS",
+            message="CANARY_CORPUS missing or non-literal",
+        ))
+    else:
+        seen_ids: dict[str, int] = {}
+        found_kinds: set[str] = set()
+        for e in corpus.value.elts:
+            sym = f"CANARY_CORPUS[{len(seen_ids)}]"
+            if (
+                not isinstance(e, (ast.Tuple, ast.List))
+                or len(e.elts) != 3
+                or not all(
+                    isinstance(x, ast.Constant)
+                    and isinstance(x.value, str) for x in e.elts
+                )
+            ):
+                out.append(Violation(
+                    rule="integrity-corpus", path=integrity_defs_path,
+                    line=e.lineno, symbol=sym,
+                    message=(
+                        "canary row is not a literal (entry_id, kind, "
+                        "note) string triple"
+                    ),
+                ))
+                continue
+            entry_id, kind, _note = (x.value for x in e.elts)
+            if kind not in ("valid", "invalid"):
+                out.append(Violation(
+                    rule="integrity-corpus", path=integrity_defs_path,
+                    line=e.lineno, symbol=entry_id,
+                    message=(
+                        f"canary row {entry_id!r} has unknown kind "
+                        f"{kind!r} (want valid or invalid) — the "
+                        f"generator cannot materialise it"
+                    ),
+                ))
+                continue
+            if entry_id in seen_ids:
+                out.append(Violation(
+                    rule="integrity-corpus", path=integrity_defs_path,
+                    line=e.lineno, symbol=entry_id,
+                    message=(
+                        f"duplicate canary entry id {entry_id!r} (first "
+                        f"at line {seen_ids[entry_id]}) — ids key the "
+                        f"known-answer table"
+                    ),
+                ))
+                continue
+            seen_ids[entry_id] = e.lineno
+            found_kinds.add(kind)
+        for want in ("valid", "invalid"):
+            if seen_ids and want not in found_kinds:
+                out.append(Violation(
+                    rule="integrity-corpus", path=integrity_defs_path,
+                    line=corpus.lineno, symbol="CANARY_CORPUS",
+                    message=(
+                        f"corpus has no {want!r} canary — a one-sided "
+                        f"corpus cannot catch a device stuck on the "
+                        f"other verdict"
+                    ),
+                ))
+    claimed: list[tuple[str, int]] = []
+    if kinds is None or not isinstance(kinds.value, (ast.Tuple, ast.List)):
+        out.append(Violation(
+            rule="integrity-corpus", path=integrity_defs_path,
+            line=0 if kinds is None else kinds.lineno,
+            symbol="REQUIRED_CHAOS_KINDS",
+            message="REQUIRED_CHAOS_KINDS missing or non-literal",
+        ))
+    else:
+        for x in kinds.value.elts:
+            if isinstance(x, ast.Constant) and isinstance(x.value, str):
+                claimed.append((x.value, x.lineno))
+    faults_src = files.get(faults_defs_path)
+    if faults_src is None or not claimed:
+        return out
+    registered = _fault_kind_defs(faults_src, faults_defs_path)
+    if registered is None:
+        return out  # the fault-site family already covers a broken defs
+    for kind, line in claimed:
+        if kind not in registered:
+            out.append(Violation(
+                rule="integrity-corpus", path=integrity_defs_path,
+                line=line, symbol=kind,
+                message=(
+                    f"REQUIRED_CHAOS_KINDS claims {kind!r} which is not "
+                    f"a registered chaos kind in {faults_defs_path} — "
+                    f"the sdc scenarios could never arm it"
+                ),
+            ))
+    claimed_set = {k for k, _ in claimed}
+    for kind in registered:
+        if kind.startswith("silent-") and kind not in claimed_set:
+            out.append(Violation(
+                rule="integrity-corpus", path=integrity_defs_path,
+                line=0 if kinds is None else kinds.lineno,
+                symbol=kind,
+                message=(
+                    f"silent-corruption kind {kind!r} is registered in "
+                    f"{faults_defs_path} but not claimed by "
+                    f"REQUIRED_CHAOS_KINDS — the canary coverage "
+                    f"contract went stale"
+                ),
+            ))
+    return out
+
+
 def run(
     files, docs, metrics_defs_path, faults_defs_path,
     site_scan_exclude=("tests/",), spec_validator=None,
@@ -1292,6 +1470,7 @@ def run(
     adversity_defs_path=None, partition_defs_path=None,
     aot_defs_path=None, aot_backend_defs_path=None, aot_manifests=(),
     tune_defs_path=None, fp_defs_path=None, scenario_fixtures=(),
+    integrity_defs_path=None,
 ) -> list[Violation]:
     files = dict(files)
     out = metrics_violations(files, metrics_defs_path, docs)
@@ -1349,6 +1528,10 @@ def run(
             fp_defs_path
             or "lighthouse_tpu/crypto/bls/jax_backend/fp.py",
             aot_defs_path, aot_manifests,
+        ))
+    if integrity_defs_path is not None:
+        out.extend(integrity_violations(
+            files, integrity_defs_path, faults_defs_path,
         ))
     out.extend(serve_port_violations(docs))
     return out

@@ -507,6 +507,16 @@ class ResilientVerifier:
             return out.verdicts
         return self._cpu(items).verdicts
 
+    def cpu_batch(self, sets: list) -> BatchOutcome:
+        """Force the ladder's CPU-oracle rung for ``sets``.
+
+        The integrity guard re-verifies a *distrusted* dispatch through
+        this rung: the device already lied once, so routing the re-verify
+        back through it (as ``verify_batch`` would while the breaker is
+        closed) could launder the same wrong verdict.  The scalar oracle
+        is the trust floor."""
+        return self._cpu(list(sets))
+
     def _cpu(self, sets: list) -> BatchOutcome:
         """Degraded mode: the CPU oracle, with the SAME bisection
         attribution so poisoned batches still name their bad sets."""

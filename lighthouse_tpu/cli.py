@@ -101,6 +101,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "zero trials (requires --datadir; "
                          "LIGHTHOUSE_TPU_MXU overrides the plan when "
                          "set)")
+    bn.add_argument("--selfcheck", action="store_true",
+                    help="boot-time known-answer suite: run the "
+                         "verdict-integrity canary corpus through the "
+                         "scalar path AND every installed kernel batch "
+                         "shape of the active BLS backend (pairs with "
+                         "--prewarm, which installs the store's working "
+                         "set first), refusing to boot on any verdict "
+                         "mismatch — a silently-corrupting device fails "
+                         "the boot, never the chain")
     bn.add_argument("--upnp", action="store_true",
                     help="attempt UPnP port mapping for p2p/discovery "
                          "(best-effort; nat.rs analog)")
@@ -330,6 +339,24 @@ def run_bn(args) -> int:
         log_with(log, logging.WARNING,
                  "--prewarm/--tune needs --datadir (the store lives under "
                  "it); skipping")
+    if args.selfcheck:
+        # after prewarm so the kernel sweep covers the installed working
+        # set, before any listener so a lying device can never serve
+        from .integrity import run_selfcheck
+
+        t_chk = time.perf_counter()
+        chk = run_selfcheck()
+        log_with(log, logging.INFO, "Integrity selfcheck done",
+                 ok=chk.ok, checked=chk.checked,
+                 kernel_batches=",".join(map(str, chk.batch_sizes)) or "-",
+                 wall_s=round(time.perf_counter() - t_chk, 3))
+        if not chk.ok:
+            for line in chk.mismatches:
+                log_with(log, logging.ERROR, "Selfcheck mismatch",
+                         detail=line)
+            log_with(log, logging.ERROR,
+                     "Integrity selfcheck FAILED; refusing to boot")
+            return 1
     h = BeaconChainHarness(n_validators=args.validators, spec=spec, store=store)
     server = BeaconApiServer(h.chain, port=args.http_port)
     server.start()

@@ -1,0 +1,46 @@
+"""Verdict-integrity layer: canary sets, cross-arm audit, SDC quarantine.
+
+Every robustness tier below this one (breaker ladder, pod fault domains,
+crash recovery, byzantine sync) defends against *loud* failures — raised
+errors, timeouts, crashes.  This package defends the verdict itself
+against silent data corruption: a device that returns the wrong boolean
+without raising anything.
+
+Three cooperating pieces:
+
+``corpus``
+    Precomputed known-answer canary signature sets (mix of known-valid
+    and known-invalid), generated through the scalar oracle and rotated
+    per epoch.  The literal ``CANARY_CORPUS`` registry is audited by the
+    ``integrity`` registry-lint family.
+``guard``
+    :class:`~.guard.IntegrityGuard` — the never-raise choke point between
+    backend resolve and both consumers (beacon node block import and the
+    serve front end).  Canary-checks every dispatched batch before any
+    real verdict is released, samples accepted batches into the
+    cross-arm auditor, and feeds strikes into device trust/quarantine.
+``audit`` / ``trust``
+    :class:`~.audit.CrossArmAuditor` re-verifies sampled batches on an
+    independent autotuner arm (CPU scalar oracle as the floor) and
+    byte-compares verdicts; :class:`~.trust.TrustScore` turns canary and
+    audit strikes into per-device quarantine decisions wired into
+    ``PodVerifier``'s health exclusion.
+"""
+
+from .audit import CrossArmAuditor
+from .corpus import CANARY_CORPUS, DEFAULT_K, REQUIRED_CHAOS_KINDS, CanaryCorpus
+from .guard import IntegrityGuard
+from .selfcheck import SelfcheckReport, run_selfcheck
+from .trust import TrustScore
+
+__all__ = [
+    "CANARY_CORPUS",
+    "DEFAULT_K",
+    "REQUIRED_CHAOS_KINDS",
+    "CanaryCorpus",
+    "CrossArmAuditor",
+    "IntegrityGuard",
+    "SelfcheckReport",
+    "TrustScore",
+    "run_selfcheck",
+]

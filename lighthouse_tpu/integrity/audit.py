@@ -1,0 +1,98 @@
+"""Cross-arm audit sampler.
+
+A second opinion on verdicts the pipeline already accepted: a sampled
+fraction of batches is re-verified on an *independent* implementation
+and the per-set verdict vectors are byte-compared.  Independence comes
+from the autotuner's ``ARM_TABLE`` — e.g. a batch verified under the
+``vpu15`` field arm is audited under ``mxu13`` — with the scalar CPU
+oracle as the unconditional floor when no device arm is available (or
+the arm itself fails).  Any disagreement is a silent-data-corruption
+event; the guard, not the auditor, decides what to do about it.
+
+Per-set attribution on an AND-reduced backend reuses
+``verify_with_bisection`` so the reference vector has the same shape and
+semantics as the pipeline's own verdicts.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+
+from ..beacon.processor import verify_with_bisection
+from ..obs.tracer import TRACER
+
+log = logging.getLogger(__name__)
+
+
+class CrossArmAuditor:
+    """Sampled re-verification of accepted batches on an independent arm.
+
+    Parameters
+    ----------
+    cpu_verify:
+        ``sets -> bool`` scalar-oracle conjunction; the audit floor.
+    backend:
+        Optional device backend used for arm audits (needs
+        ``verify_signature_sets``).
+    arms:
+        Tuple of autotuner arm ids (e.g. ``("vpu15", "mxu13")``) to
+        rotate through.  Empty means CPU-floor only.
+    fraction:
+        Probability a given accepted batch is audited.  ``1.0`` audits
+        everything (scenario/regression mode); ``0.0`` disables.
+    """
+
+    def __init__(self, cpu_verify, *, backend=None, arms=(), fraction=0.0,
+                 rng=None):
+        self.cpu_verify = cpu_verify
+        self.backend = backend
+        self.arms = tuple(arms)
+        self.fraction = float(fraction)
+        self.rng = rng or random.Random(0x5DC0)
+        self._arm_rr = 0
+
+    def maybe_audit(self, sets) -> tuple[list[bool], str] | None:
+        """Sample this batch; return ``(reference_verdicts, mode)`` or None."""
+        if self.fraction <= 0.0:
+            return None
+        if self.fraction < 1.0 and self.rng.random() >= self.fraction:
+            return None
+        with TRACER.span("integrity.audit", n=len(sets)) as sp:
+            ref, mode = self.reference_verdicts(sets)
+            sp.add(mode=mode)
+            return ref, mode
+
+    def reference_verdicts(self, sets) -> tuple[list[bool], str]:
+        """Independent per-set verdicts: device arm first, CPU floor last."""
+        sets = list(sets)
+        if self.backend is not None and self.arms:
+            try:
+                return self._arm_verdicts(sets)
+            except Exception:
+                log.warning(
+                    "cross-arm audit fell back to the CPU oracle floor",
+                    exc_info=True,
+                )
+        out = verify_with_bisection(
+            lambda ss: bool(self.cpu_verify(list(ss))), sets
+        )
+        return list(out.verdicts), "cpu"
+
+    def _arm_verdicts(self, sets) -> tuple[list[bool], str]:
+        from ..crypto.bls.jax_backend import autotune
+        from ..crypto.bls.jax_backend import fp as F
+
+        arm_id = self.arms[self._arm_rr % len(self.arms)]
+        self._arm_rr += 1
+        arm = autotune.arm_by_id(arm_id)
+        setter = getattr(F, arm.toggle)
+        prev = setter(arm.value)
+        try:
+            out = verify_with_bisection(
+                lambda ss: bool(self.backend.verify_signature_sets(list(ss))),
+                sets,
+            )
+        finally:
+            setter(prev)
+        return list(out.verdicts), arm_id

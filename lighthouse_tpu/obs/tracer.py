@@ -74,6 +74,11 @@ SPANS: dict[str, str] = {
     # multi-tenant verification front door (serve/service.py)
     "serve.submit": "one tenant submission: admission through enqueue",
     "serve.dispatch": "one coalesced device batch: flush through verdicts",
+    # verdict-integrity layer (integrity/guard.py, integrity/selfcheck.py)
+    "integrity.canary": "canary known-answer sweep around one dispatch",
+    "integrity.audit": "cross-arm audit re-verify of a sampled batch",
+    "integrity.quarantine": "device trust quarantine (instant event)",
+    "integrity.selfcheck": "boot-time known-answer sweep over installed kernels",
 }
 
 

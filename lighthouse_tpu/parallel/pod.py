@@ -304,6 +304,10 @@ class PodVerifier:
         self._devices = list(devices) if devices is not None else None
         self.health: DeviceHealth | None = None
         self._health_lock = threading.Lock()
+        #: attached IntegrityGuard (integrity/guard.py), wired by
+        #: ``guard.attach_pod``: supplies canary batches for per-device
+        #: probes and receives readmission notifications
+        self.integrity = None
 
     # -- drop-in ladder surface (PipelinedVerifier's resilient slot) -------
 
@@ -381,6 +385,44 @@ class PodVerifier:
     def _ladder(self, sets: list) -> BatchOutcome:
         M.POD_FALLBACKS.inc()
         return self.resilient.verify_batch(sets)
+
+    # -- integrity surface (integrity/guard.py) -----------------------------
+
+    def healthy_devices(self) -> list[int]:
+        """Device indices currently in the mesh (guard attribution sweep)."""
+        return list(self._ensure_health().healthy())
+
+    def quarantine(self, dev: int) -> bool:
+        """Force ``dev`` out of the mesh on an integrity strike.  True
+        when this call newly excluded it.  Readmission goes through the
+        canary-only probe in :meth:`_probe_excluded` like any exclusion."""
+        if self._ensure_health().exclude(dev):
+            M.POD_EXCLUSIONS.inc()
+            return True
+        return False
+
+    def device_canary_probe(self, dev: int) -> bool:
+        """Canary-only probe batch on one device: every known-answer
+        verdict must match.  Used for SDC attribution (naming the lying
+        device) and as the readmission gate for quarantined devices.
+        Requires an attached guard; raises propagate to the caller's
+        probe fault domain."""
+        guard = self.integrity
+        if guard is None:
+            return True
+        for canary_sets, expected in guard.canary_batches():
+            job = self._prepare_canary(canary_sets)
+            if job is None:
+                return False
+            got = self._run_shard(job, dev, 0, job.total)
+            if bool(got) != expected:
+                return False
+        return True
+
+    def _prepare_canary(self, canary_sets: list) -> _PodJob | None:
+        if self.shard_verify is not None:
+            return _PodJob(sets=list(canary_sets), total=len(canary_sets))
+        return self._prepare_plain(list(canary_sets))
 
     def _pod_verify(self, sets: list) -> BatchOutcome:
         health = self._ensure_health()
@@ -681,11 +723,22 @@ class PodVerifier:
         width = max(1, job.total // mesh_width(len(self.devices())))
         for dev in ready:
             try:
-                self._run_shard(job, dev, 0, min(job.total, width))
+                if self.integrity is not None:
+                    # readmission requires the canary-only probe: the
+                    # device must produce *correct* known-answer verdicts,
+                    # not merely survive a dispatch
+                    if not self.device_canary_probe(dev):
+                        log.info("pod canary probe on device %d failed", dev)
+                        health.defer_probe(dev)
+                        continue
+                else:
+                    self._run_shard(job, dev, 0, min(job.total, width))
             except Exception as exc:  # noqa: BLE001 — probe fault domain
                 log.info("pod probe on device %d failed: %s", dev, exc)
                 health.defer_probe(dev)
                 continue
             health.rearm(dev)
             M.POD_REARMS.inc()
+            if self.integrity is not None:
+                self.integrity.readmit(dev)
             log.info("pod device %d re-armed after probe", dev)

@@ -921,12 +921,166 @@ class WarmStandbyHandoffTrack(Track):
             shutil.rmtree(self.store_dir, ignore_errors=True)
 
 
+class _TruthCheckedVerifier:
+    """Scenario-only measurement shim OUTSIDE the integrity guard: while
+    the silent-fault window is active it byte-compares every released
+    verdict against the scalar-oracle truth and counts wrong-accepts
+    (verdict True, truth False) — the ground truth behind the
+    ``max_sdc_wrong_accepts`` gate.  Wrong-rejects are fail-closed by
+    design and not counted.  Not a defense: it exists so the scenario
+    can *prove* what escaped, defended or not."""
+
+    def __init__(self, inner, track):
+        self.inner = inner
+        self.track = track
+
+    def verify_batch(self, sets):
+        sets = list(sets)
+        out = self.inner.verify_batch(sets)
+        if self.track.truth_active:
+            for v, s in zip(out.verdicts, sets):
+                if v and not bool(s.verify()):
+                    self.track.wrong_accepts += 1
+                    self.track.wrong_accepts_epoch += 1
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class SdcStormTrack(Track):
+    """Silent-data-corruption storm over a pod mesh.
+
+    At install the engine's verify path is lifted onto a list-mode
+    ``PodVerifier`` (``shards`` fault domains over the ladder's own
+    ``device_verify``) wrapped by an :class:`~...integrity.IntegrityGuard`
+    with ``k`` canary batches per dispatch, and finally by a
+    truth-checking shim that counts wrong-accepts against the scalar
+    oracle.  Over the slot window the ``pod.gather`` site is armed with
+    ``kind`` (default ``silent-stuck-true``): every shard verdict lies
+    True with probability ``p`` and *nothing raises* — the regime where
+    only the canary layer stands between a flipped conjunction and block
+    import.  ``canaries=0`` is the undefended twin: the guard passes the
+    pod's verdicts straight through and the truth check records what
+    escapes.
+
+    Every windowed slot also dispatches one *hostile traffic* batch — a
+    known valid/invalid mix drawn from a second canary corpus (distinct
+    seed, so the guard's own canaries share no bytes with it) — through
+    the full verify path.  That is what makes the twin falsifiable: the
+    engine's organic traffic is honest, so a stuck-True gather merely
+    re-confirms verdicts that were already True; the hostile batch is
+    the invalid signature a lying device would wave through."""
+
+    name = "sdc-storm"
+
+    def __init__(self, canaries="1", shards="4", k="2",
+                 kind="silent-stuck-true", p="1.0", start="9", end="17",
+                 threshold="2", audit="0.0", timeout="30.0"):
+        self.canaries = bool(int(canaries))
+        self.shards = int(shards)
+        self.k = int(k)
+        self.kind = kind
+        self.p = float(p)
+        self.start = int(start)
+        self.end = int(end)
+        self.threshold = int(threshold)
+        self.audit = float(audit)
+        self.timeout = float(timeout)
+        self.pod = None
+        self.guard = None
+        self.truth_active = False
+        self.wrong_accepts = 0
+        self.wrong_accepts_epoch = 0
+        self._traffic = ()
+
+    def install(self, engine) -> None:
+        import random as _random
+
+        from ..integrity.corpus import CanaryCorpus
+        from ..integrity.guard import IntegrityGuard
+        from ..parallel.pod import PodVerifier
+
+        inner = engine.verifier
+        self.pod = PodVerifier(
+            inner,
+            shard_verify=lambda sub: bool(inner.device_verify(sub)),
+            devices=list(range(self.shards)),
+            injector=engine.injector,
+            shard_timeout=self.timeout,
+            max_shard_retries=1,
+            backoff_base=0.0,
+            exclusion_threshold=2,
+            probe_after=1,
+        )
+        self.guard = IntegrityGuard(
+            self.pod, inner,
+            corpus=CanaryCorpus(seed=engine.spec.seed),
+            k=self.k,
+            enabled=self.canaries,
+            audit_fraction=self.audit,
+            rng=_random.Random(engine.spec.seed ^ 0x5DC),
+            strike_threshold=self.threshold,
+        )
+        self.guard.attach_pod(self.pod)
+        # hostile traffic: a known valid/invalid mix from a second corpus
+        # seed, dispatched each windowed slot (see class docstring)
+        self._traffic = tuple(
+            s for e in CanaryCorpus(seed=engine.spec.seed ^ 0x7AFF1C)
+            .entries(0) for s in e.sets
+        )
+        engine.verifier = _TruthCheckedVerifier(self.guard, self)
+
+    def on_slot(self, engine, slot: int) -> None:
+        if slot == self.start:
+            self.truth_active = True
+            engine.injector.arm("pod.gather", self.kind,
+                                probability=self.p)
+            engine.note("sdc-storm", slot=slot, armed=self.kind,
+                        p=self.p, shards=self.shards,
+                        canaries=self.canaries, k=self.k)
+        elif slot == self.end + 1:
+            engine.injector.disarm("pod.gather")
+            engine.note("sdc-storm", slot=slot, disarmed=self.kind)
+        if self.start <= slot <= self.end:
+            engine.verifier.verify_batch(list(self._traffic))
+
+    def on_epoch(self, engine, epoch: int, facts: dict) -> None:
+        facts["sdc_wrong_accepts"] = self.wrong_accepts_epoch
+        self.wrong_accepts_epoch = 0
+        # rotate the canary corpus at every epoch boundary, the same
+        # cadence the serve front end's rotate_epoch hook uses
+        self.guard.rotate(epoch + 1)
+
+    def finalize(self, engine) -> None:
+        engine.injector.disarm("pod.gather")
+        # the truth window stays open from the first armed slot to run
+        # end: a flipped verdict released after the disarm point still
+        # counts as an escape
+        self.truth_active = False
+        g = self.guard
+        injected = sum(
+            1 for _site, kind in engine.injector.fired_sequence()
+            if kind.startswith("silent") or kind == "corrupt-shard-result"
+        )
+        engine.run_facts["sdc_wrong_accepts"] = self.wrong_accepts
+        engine.run_facts["sdc_detected"] = g.sdc_events
+        engine.run_facts["sdc_quarantined"] = len(g.quarantined)
+        engine.run_facts["sdc_injected"] = injected
+        engine.run_facts["sdc_canary_checks"] = g.canary_checks
+        engine.run_facts["sdc_reladdered_sets"] = g.reladdered_sets
+        engine.note("sdc-storm-result", wrong_accepts=self.wrong_accepts,
+                    detected=g.sdc_events, quarantined=len(g.quarantined),
+                    injected=injected, reladdered=g.reladdered_sets)
+
+
 TRACKS = {
     cls.name: cls
     for cls in (GossipFaultTrack, DeviceFaultTrack, ByzantineSyncTrack,
                 KillRecoveryTrack, PodDeviceDropTrack, FinalityStallTrack,
                 HostileCheckpointTrack, TenantOverloadTrack,
-                AggregationStormTrack, WarmStandbyHandoffTrack)
+                AggregationStormTrack, WarmStandbyHandoffTrack,
+                SdcStormTrack)
 }
 
 

@@ -326,6 +326,31 @@ def evaluate(thresholds: dict, deltas: dict, run: dict) -> list[SLOResult]:
              "every program the old node captured must deserialize and "
              "install on the standby")
 
+    # ---- verdict-integrity gates (sdc-storm track) ---------------------
+
+    if t.get("max_sdc_wrong_accepts") is not None:
+        v = run.get("sdc_wrong_accepts", 0)
+        gate("sdc_wrong_accepts", v <= t["max_sdc_wrong_accepts"], int(v),
+             t["max_sdc_wrong_accepts"],
+             "flipped verdicts released to a consumer, counted against "
+             "the scalar-oracle truth — a wrong-accept here is a "
+             "consensus-safety escape, not a liveness blip")
+
+    if t.get("min_sdc_detected") is not None:
+        v = run.get("sdc_detected", 0)
+        gate("sdc_detected", v >= t["min_sdc_detected"], int(v),
+             t["min_sdc_detected"],
+             "canary mismatches + audit disagreements — every injected "
+             "silent flip must be caught before verdict release "
+             f"({run.get('sdc_injected', 0)} silent faults injected)")
+
+    if t.get("min_sdc_quarantined") is not None:
+        v = run.get("sdc_quarantined", 0)
+        gate("sdc_quarantined", v >= t["min_sdc_quarantined"], int(v),
+             t["min_sdc_quarantined"],
+             "devices the trust score pulled from the mesh — a lying "
+             "device must not keep serving shards")
+
     return out
 
 
@@ -337,6 +362,7 @@ EPOCH_GATED_KEYS = (
     "max_deposit_queue_depth",
     "max_ssz_cache_bytes",
     "max_pool_estimated_verify_cost",
+    "max_sdc_wrong_accepts",
 )
 
 
@@ -371,6 +397,15 @@ def evaluate_epoch(thresholds: dict, facts: dict) -> list[SLOResult]:
             v <= t["max_pool_estimated_verify_cost"], int(v),
             t["max_pool_estimated_verify_cost"],
             "naive-pool estimated verify cost at this epoch's boundary",
+        ))
+
+    if t.get("max_sdc_wrong_accepts") is not None:
+        v = facts.get("sdc_wrong_accepts", 0)
+        out.append(SLOResult(
+            "sdc_wrong_accepts", v <= t["max_sdc_wrong_accepts"], int(v),
+            t["max_sdc_wrong_accepts"],
+            "flipped verdicts released to a consumer during this epoch "
+            "(scalar-oracle truth check)",
         ))
 
     return out

@@ -84,6 +84,14 @@ DEFAULT_SLO: dict = {
     "max_ssz_cache_bytes": None,        # worst per-epoch cache growth
     "max_pool_estimated_verify_cost": None,  # worst per-epoch pool cost
     "min_storm_shed_rate": None,        # storm submissions shed / submitted
+    # verdict-integrity gates (None = not asserted): silent-data-
+    # corruption detection under the sdc-storm regime.  Wrong-accepts
+    # (a flipped verdict released to a consumer) are also gated PER
+    # EPOCH (slo.EPOCH_GATED_KEYS) so the undefended twin's report
+    # names the first epoch a silent flip escaped.
+    "max_sdc_wrong_accepts": None,      # flipped verdicts released (truth)
+    "min_sdc_detected": None,           # canary/audit SDC detections
+    "min_sdc_quarantined": None,        # devices quarantined by trust strikes
 }
 
 
@@ -448,6 +456,46 @@ SCENARIOS: dict[str, ScenarioSpec] = {
             "max_naive_pool_groups": 160,
             "max_pool_estimated_verify_cost": 1024,
             "max_honest_deadline_miss_rate": 0.02,
+            "require_crash_recovery": False,
+        },
+    ),
+    # Silent-data-corruption storm: mid-run, every pod-shard verdict
+    # gather starts lying True (the wrong-accept direction nothing below
+    # the integrity tier can see).  The canary layer must mark every
+    # corrupted dispatch distrusted before a verdict is released, the
+    # real sets re-ladder through the CPU-oracle rung, trust strikes
+    # quarantine the lying devices, and the truth-checked wrong-accept
+    # count stays zero.
+    "sdc-storm": ScenarioSpec(
+        name="sdc-storm",
+        seed=73,
+        n_nodes=3,
+        n_validators=16,
+        epochs=3,
+        adversity=("sdc-storm:canaries=1,shards=4,start=9,end=17",),
+        slo={
+            "max_sdc_wrong_accepts": 0,
+            "min_sdc_detected": 1,
+            "min_sdc_quarantined": 1,
+            "require_crash_recovery": False,
+        },
+    ),
+    # The same storm with the canary layer OFF: the pod's all-True
+    # short-circuit accepts the lying gathers wholesale and flipped
+    # verdicts reach the consumer — the per-epoch wrong-accept gate
+    # names the first epoch a silent flip escaped.  EXPECTED to fail;
+    # it proves the canaries (not luck) are what hold the line.
+    "sdc-storm-undefended": ScenarioSpec(
+        name="sdc-storm-undefended",
+        seed=73,
+        n_nodes=3,
+        n_validators=16,
+        epochs=3,
+        adversity=("sdc-storm:canaries=0,shards=4,start=9,end=17",),
+        slo={
+            "max_sdc_wrong_accepts": 0,
+            "min_sdc_detected": 1,
+            "min_sdc_quarantined": 1,
             "require_crash_recovery": False,
         },
     ),

@@ -153,6 +153,15 @@ class VerifyService:
         return cls(stack.verifier, breaker=stack.breaker,
                    injector=stack.injector, **kw)
 
+    def rotate_epoch(self, epoch: int) -> None:
+        """Epoch hook: rotate the verdict-integrity canary corpus when
+        the verifier is an :class:`~..integrity.guard.IntegrityGuard`
+        (no-op otherwise), so a tenant-facing stack never serves stale
+        canaries a lying device could have learned."""
+        rotate = getattr(self._verifier, "rotate", None)
+        if rotate is not None:
+            rotate(int(epoch))
+
     # -- ingress -----------------------------------------------------------
 
     def submit(self, tenant: str, sets, deadline_s: float | None = None,

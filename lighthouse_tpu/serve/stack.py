@@ -41,6 +41,9 @@ class VerifyStack:
     # the warm-boot report when the stack was built with prewarm=True
     # (crypto/bls/jax_backend/aot.PrewarmReport), else None
     prewarm_report: object | None = None
+    # the IntegrityGuard wrapping ``verifier`` when the verdict-integrity
+    # layer is on (integrity/guard.py), else None
+    integrity: object | None = None
 
 
 def _make_ingest_device_verify(ingest):
@@ -67,7 +70,8 @@ def _make_ingest_device_verify(ingest):
 
 def build_verify_stack(pubkey_cache=None, injector=None,
                        breaker=None, aot_store=None,
-                       prewarm=False) -> VerifyStack:
+                       prewarm=False, integrity="auto",
+                       canary_k=None, audit_fraction=0.0) -> VerifyStack:
     """Assemble the full verification ladder against the active backend.
 
     Parameters
@@ -96,6 +100,18 @@ def build_verify_stack(pubkey_cache=None, injector=None,
         counts the shapes), so the loaded programs are exactly the arms
         the tuned dispatcher will ask for.  The report lands on the
         returned stack's ``prewarm_report``.
+    integrity:
+        ``"auto"`` (default) turns the verdict-integrity guard on when a
+        device backend is active — canary known-answer batches around
+        every dispatch, fail-closed re-ladder on mismatch
+        (integrity/guard.py).  The scalar python backend *is* the oracle,
+        so auto leaves it unguarded.  Pass True/False to force.
+    canary_k:
+        Canary batches per dispatch (default
+        ``integrity.corpus.DEFAULT_K``).
+    audit_fraction:
+        Fraction of accepted batches re-verified by the cross-arm audit
+        sampler (0.0 disables sampling; the canary layer is unaffected).
     """
     from ..beacon.processor import CircuitBreaker, ResilientVerifier
     from ..crypto.bls import api as _bls_api
@@ -147,8 +163,22 @@ def build_verify_stack(pubkey_cache=None, injector=None,
         )
         if pod is not None:
             verifier = pod
+    guard = None
+    want_integrity = (ingest is not None) if integrity == "auto" else bool(integrity)
+    if want_integrity:
+        from ..integrity.corpus import DEFAULT_K
+        from ..integrity.guard import IntegrityGuard
+
+        guard = IntegrityGuard(
+            verifier, resilient,
+            k=DEFAULT_K if canary_k is None else int(canary_k),
+            audit_fraction=audit_fraction,
+        )
+        if pod is not None:
+            guard.attach_pod(pod)
+        verifier = guard
     return VerifyStack(
         breaker=breaker, verifier=verifier, resilient=resilient,
         ingest=ingest, pod=pod, injector=injector,
-        prewarm_report=prewarm_report,
+        prewarm_report=prewarm_report, integrity=guard,
     )

@@ -76,7 +76,20 @@ back — parallel/pod.py):
                            device; the pod's per-shard timeout is what
                            rescues the batch
 * ``corrupt-shard-result`` invert (or ``mutate``) the gathered shard
-                           verdict — a device returning garbage
+                           verdict — a device returning garbage; the
+                           ``:stuck-true`` arg selects the targeted
+                           ``False -> True`` lie instead of inversion
+
+Silent-corruption kinds (armed at the verdict-carrying sites
+``bls.device_verify`` and ``pod.gather`` — wrong-answer analogs for the
+integrity layer; they mutate a boolean verdict payload in place and never
+raise, so nothing below the canary/audit tier can notice them):
+
+* ``silent-flip``        invert a boolean verdict payload (non-boolean
+                         payloads pass through untouched) — bit rot or a
+                         mistuned arm inverting the batch conjunction
+* ``silent-stuck-true``  force a boolean verdict payload to True — the
+                         consensus-dangerous wrong-accept direction
 
 Serve front-door kinds (armed at the tenancy sites ``serve.submit``, the
 ingress of one tenant submission, and ``serve.dispatch``, around one
@@ -150,7 +163,8 @@ class NetworkFault(FaultError):
 _KINDS = ("error", "slow", "corrupt", "overflow", "crash", "io-error",
           "torn-write", "drop", "stall", "corrupt-chunk", "wrong-blocks",
           "extra-blocks", "shard-drop", "device-hang",
-          "corrupt-shard-result", "slow-client", "malformed-request")
+          "corrupt-shard-result", "slow-client", "malformed-request",
+          "silent-flip", "silent-stuck-true")
 
 # Canonical site registry.  Every literal site string fired anywhere in
 # the package must appear here (the static audit's fault-sites family
@@ -208,6 +222,18 @@ _NETWORK_MUTATORS = {
     "wrong-blocks": lambda chunks: list(reversed(list(chunks))),
     "extra-blocks": lambda chunks: list(chunks) + list(chunks)[-1:],
 }
+
+
+def _silent_flip(ok):
+    """Invert a boolean verdict payload; anything else passes through
+    (sites also fire with None payloads for pure raise/delay kinds)."""
+    return (not ok) if isinstance(ok, bool) else ok
+
+
+def _stuck_true(ok):
+    """Targeted ``False -> True`` verdict lie — the wrong-accept
+    direction a silently corrupting device is most dangerous in."""
+    return True if isinstance(ok, bool) else ok
 
 
 def _malform_submission(payload):
@@ -327,8 +353,15 @@ class FaultInjector:
             pod.dispatch=shard-dropx1
             pod.dispatch=device-hang:2.0
             pod.gather=corrupt-shard-result
+            pod.gather=corrupt-shard-result:stuck-true
+            pod.gather=silent-stuck-true
+            bls.device_verify=silent-flip
             serve.submit=slow-client:0.2
             serve.submit=malformed-requestx1
+
+        ``corrupt-shard-result:stuck-true`` selects the targeted
+        ``False -> True`` flip (wrong-accept) instead of the default
+        inversion.
         """
         site, _, rest = spec.partition("=")
         if not site or not rest:
@@ -349,8 +382,13 @@ class FaultInjector:
             else 0.0
         )
         fraction = float(arg) if (arg and kind == "torn-write") else 0.5
+        mutate = (
+            _stuck_true
+            if (kind == "corrupt-shard-result" and arg == "stuck-true")
+            else None
+        )
         self.arm(site.strip(), kind, delay=delay, times=times,
-                 fraction=fraction)
+                 fraction=fraction, mutate=mutate)
 
     # -- firing ------------------------------------------------------------
 
@@ -398,6 +436,10 @@ class FaultInjector:
             # default mutator inverts a boolean shard verdict
             fn = f.mutate or (lambda ok: not ok)
             return fn(payload)
+        if f.kind == "silent-flip":
+            return (f.mutate or _silent_flip)(payload)
+        if f.kind == "silent-stuck-true":
+            return (f.mutate or _stuck_true)(payload)
         if f.kind in _NETWORK_MUTATORS:
             fn = f.mutate or _NETWORK_MUTATORS[f.kind]
             return fn(payload)

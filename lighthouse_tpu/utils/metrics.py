@@ -548,6 +548,59 @@ POOL_ESTIMATED_VERIFY_COST = Gauge(
 )
 
 
+# ---------------------------------------------------------------------------
+# Verdict-integrity layer (integrity/guard.py): canary known-answer checks
+# around every dispatched batch, cross-arm audit sampling of accepted
+# batches, and the silent-data-corruption strike/quarantine pipeline that
+# keeps a lying device's verdicts away from block import and serve tenants.
+# ---------------------------------------------------------------------------
+
+INTEGRITY_CANARY_CHECKS = Counter(
+    "integrity_canary_checks_total",
+    "Canary known-answer sweeps around real dispatches, by result "
+    "(ok / mismatch)",
+    ("result",),
+)
+INTEGRITY_DISTRUSTED = Counter(
+    "integrity_distrusted_dispatches_total",
+    "Dispatches whose canary verdicts disagreed with the precomputed "
+    "expectation — the whole dispatch is discarded and re-laddered",
+)
+INTEGRITY_RELADDERED = Counter(
+    "integrity_reladdered_sets_total",
+    "Real signature sets re-verified through the CPU-oracle rung because "
+    "their original dispatch was distrusted or failed audit",
+)
+INTEGRITY_AUDITS = Counter(
+    "integrity_audits_total",
+    "Cross-arm audit re-verifications of accepted batches, by reference "
+    "mode (autotuner arm id or cpu floor)",
+    ("mode",),
+)
+INTEGRITY_SDC_EVENTS = Counter(
+    "integrity_sdc_events_total",
+    "Silent-data-corruption detections, by source (canary mismatch or "
+    "audit disagreement)",
+    ("source",),
+)
+INTEGRITY_TRUST_STRIKES = Counter(
+    "integrity_trust_strikes_total",
+    "Per-device trust strikes from failed canary probes during SDC "
+    "attribution",
+    ("device",),
+)
+INTEGRITY_QUARANTINES = Counter(
+    "integrity_quarantines_total",
+    "Devices quarantined out of the pod mesh after crossing the trust "
+    "strike threshold (readmission requires a canary-only probe)",
+)
+INTEGRITY_GUARD_BACKSTOPS = Counter(
+    "integrity_guard_backstops_total",
+    "IntegrityGuard.verify_batch never-raise backstop activations "
+    "(batch failed closed all-False)",
+)
+
+
 def render() -> str:
     """Prometheus text exposition of every registered metric."""
     out = []

@@ -1,0 +1,39 @@
+"""Fixture verdict-integrity registry (stands in for
+integrity/corpus.py) — seeded shapes for every integrity-corpus finding
+class, plus good shapes that must NOT be flagged.
+
+Seeded findings (11 total):
+
+* 2 malformed rows (wrong arity; non-string member)
+* 2 rows with unknown kinds (neither ``valid`` nor ``invalid``)
+* 2 duplicate entry ids
+* 1 one-sided corpus (no well-formed ``invalid`` row survives)
+* 2 claimed chaos kinds missing from the fixture ``_KINDS`` registry
+* 2 registered ``silent-*`` kinds left unclaimed (see sites_defs.py)
+"""
+
+CANARY_CORPUS = (
+    # good shape: well-formed valid rows (not flagged on their own)
+    ("fix-valid-a", "valid", "fixture canary, good shape"),
+    ("fix-valid-b", "valid", "fixture canary, good shape"),
+    # SEED: malformed — wrong arity (a pair, not a triple)
+    ("fix-short", "valid"),
+    # SEED: malformed — non-string member
+    ("fix-notstr", "valid", 3),
+    # SEED: unknown kinds — the generator cannot materialise these
+    ("fix-bogus", "bogus", "fixture canary, unknown kind"),
+    ("fix-maybe", "maybe", "fixture canary, unknown kind"),
+    # SEED: duplicate entry ids (each collides with a row above)
+    ("fix-valid-a", "valid", "fixture canary, duplicate id"),
+    ("fix-valid-b", "valid", "fixture canary, duplicate id"),
+    # NOTE no well-formed "invalid" row anywhere: the one-sided-corpus
+    # finding fires once for the missing invalid side (SEED)
+)
+
+REQUIRED_CHAOS_KINDS = (
+    # good shape: registered in the fixture _KINDS (sites_defs.py)
+    "silent-good",
+    # SEED: ghost claims — not registered anywhere, could never arm
+    "silent-ghost",
+    "silent-phantom",
+)

@@ -12,3 +12,15 @@ SITES = {
 }
 
 SITE_PREFIXES = ("fixture.dyn.",)
+
+# chaos kind registry (stands in for utils/faults.py _KINDS): the
+# integrity-corpus family cross-references REQUIRED_CHAOS_KINDS in
+# integrity_defs.py against this both directions.  "silent-good" is
+# claimed there (good shape); the two unclaimed silent-* kinds are
+# SEEDS for the stale-coverage-contract finding.
+_KINDS = (
+    "fixture-kind",
+    "silent-good",
+    "silent-unclaimed-a",
+    "silent-unclaimed-b",
+)

@@ -87,6 +87,37 @@ def test_bn_chaos_network_fault_kinds(capsys):
         faults.INJECTOR.disarm()
 
 
+def test_bn_selfcheck_passes_and_boots(capsys):
+    """`bn --selfcheck` runs the known-answer suite against the honest
+    backend and the node boots normally (exit 0)."""
+    rc = main([
+        "--spec", "minimal", "bn", "--validators", "16", "--http-port", "0",
+        "--slots", "1", "--auto-propose", "--selfcheck",
+    ])
+    assert rc == 0
+
+
+def test_bn_selfcheck_mismatch_refuses_boot(monkeypatch, capsys):
+    """A backend that lies about the invalid canaries fails the boot
+    with a non-zero exit before any listener opens."""
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    class StuckTrueBackend:
+        name = "stuck-true-stub"
+
+        def verify_signature_sets(self, sets):
+            return True
+
+    # the selfcheck resolves the active backend at call time; the canary
+    # generator's oracle uses cpu_backend() and stays honest
+    monkeypatch.setattr(bls_api, "get_backend", lambda: StuckTrueBackend())
+    rc = main([
+        "--spec", "minimal", "bn", "--validators", "16", "--http-port", "0",
+        "--slots", "1", "--auto-propose", "--selfcheck",
+    ])
+    assert rc == 1
+
+
 def test_wallet_and_validator_manager(capsys):
     import json as _json
 

@@ -1,0 +1,378 @@
+"""Verdict-integrity layer suite (integrity/).
+
+Locks down the silent-data-corruption defenses: (1) the canary corpus is
+oracle-true and rotates per epoch; (2) the clean path is a differential
+no-op — guard-wrapped verdicts are byte-identical to the bare ladder
+over a valid / tampered / aggregate-to-infinity mix (the mainnet-shape
+fingerprint pin in test_scenario.py covers the engine side: the
+scenario ladder is untouched unless an sdc track installs the guard);
+(3) a canary mismatch marks the dispatch distrusted and re-ladders
+through the CPU-oracle rung, never the lying inner path; (4) the
+cross-arm auditor turns a byte-level verdict disagreement into an SDC
+event and releases the independent reference vector; (5) the guard is
+registered never-raise and its backstop fails closed; (6) the boot-time
+selfcheck catches scalar- and kernel-path liars; (7) the sdc-storm
+scenario holds the zero-wrong-accept line while its undefended twin
+releases wrong accepts and fails the detection gates at a named epoch.
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon.processor import (
+    BatchOutcome,
+    CircuitBreaker,
+    ResilientVerifier,
+)
+from lighthouse_tpu.crypto.bls.api import (
+    SecretKey,
+    Signature,
+    SignatureSet,
+    cpu_backend,
+)
+from lighthouse_tpu.integrity import (
+    CANARY_CORPUS,
+    DEFAULT_K,
+    REQUIRED_CHAOS_KINDS,
+    CanaryCorpus,
+    CrossArmAuditor,
+    IntegrityGuard,
+    TrustScore,
+    run_selfcheck,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _mixed_sets():
+    """Valid, tampered-message, and aggregate-to-infinity sets — the
+    differential corpus the no-op proof byte-compares over."""
+    sets = []
+    for i in range(3):
+        sk = SecretKey(900 + i)
+        msg = bytes([i, 77]) * 16
+        sets.append(SignatureSet(sk.sign(msg), [sk.public_key()], msg))
+    sk = SecretKey(950)
+    sets.append(
+        SignatureSet(sk.sign(b"mm" * 16), [sk.public_key()], b"xx" * 16)
+    )
+    sets.append(SignatureSet(
+        Signature.infinity(), [SecretKey(960).public_key()], b"aa" * 16,
+    ))
+    return sets
+
+
+def _oracle(sets):
+    return [bool(s.verify()) for s in sets]
+
+
+def _real_resilient():
+    clock = [0.0]
+    verify = lambda s: cpu_backend().verify_signature_sets(s)  # noqa: E731
+    return ResilientVerifier(
+        device_verify=verify, cpu_verify=verify,
+        breaker=CircuitBreaker(now=lambda: clock[0]),
+        now=lambda: clock[0],
+    )
+
+
+class AllTrueVerifier:
+    """A silently lying inner rung: every verdict True, nothing raises."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def verify_batch(self, sets):
+        self.calls += 1
+        return BatchOutcome([True] * len(sets), 1)
+
+
+# ---------------------------------------------------------------------------
+# Corpus
+# ---------------------------------------------------------------------------
+
+
+class TestCanaryCorpus:
+    def test_entries_agree_with_the_scalar_oracle(self):
+        cc = CanaryCorpus(seed=5)
+        entries = cc.entries()
+        assert [e.entry_id for e in entries] == [
+            r[0] for r in CANARY_CORPUS
+        ]
+        for e in entries:
+            for s in e.sets:
+                assert bool(s.verify()) == e.expected
+
+    def test_rotation_changes_material_not_identity(self):
+        cc = CanaryCorpus(seed=5)
+        e0, e1 = cc.entries(0), cc.entries(1)
+        assert [e.entry_id for e in e0] == [e.entry_id for e in e1]
+        assert [e.expected for e in e0] == [e.expected for e in e1]
+        # keys + messages are (seed, epoch)-salted: the material differs
+        assert e0[0].sets[0].message != e1[0].sets[0].message
+
+    def test_batches_lead_with_an_invalid_canary(self):
+        # invalid-first: a stuck-True device is the dangerous polarity,
+        # so the first canary dispatched must be able to catch it
+        batches = CanaryCorpus(seed=5).batches(DEFAULT_K)
+        assert len(batches) == DEFAULT_K
+        assert batches[0][1] is False
+        assert {expected for _, expected in batches} == {True, False}
+
+    def test_required_kinds_are_armable(self):
+        from lighthouse_tpu.utils import faults
+
+        for kind in REQUIRED_CHAOS_KINDS:
+            assert kind in faults._KINDS
+
+
+# ---------------------------------------------------------------------------
+# Differential no-op proof (clean path)
+# ---------------------------------------------------------------------------
+
+
+class TestDifferentialNoop:
+    def test_guarded_verdicts_byte_identical_on_the_clean_path(self):
+        sets = _mixed_sets()
+        bare = _real_resilient()
+        guarded = IntegrityGuard(
+            _real_resilient(), _real_resilient(), corpus=CanaryCorpus(),
+        )
+        want = bare.verify_batch(list(sets)).verdicts
+        got = guarded.verify_batch(list(sets)).verdicts
+        assert got == want == _oracle(sets)
+        assert guarded.distrusted == 0 and guarded.sdc_events == 0
+        assert guarded.canary_checks == 1
+
+    def test_disabled_guard_is_pure_passthrough(self):
+        inner = AllTrueVerifier()
+        guard = IntegrityGuard(inner, None, k=0)
+        out = guard.verify_batch([object(), object()])
+        assert out.verdicts == [True, True]
+        assert inner.calls == 1 and guard.canary_checks == 0
+
+
+# ---------------------------------------------------------------------------
+# Distrust + re-ladder
+# ---------------------------------------------------------------------------
+
+
+class TestDistrust:
+    def test_canary_mismatch_reladders_through_the_cpu_rung(self):
+        sets = _mixed_sets()
+        lying = AllTrueVerifier()
+        resilient = _real_resilient()
+        guard = IntegrityGuard(lying, resilient, corpus=CanaryCorpus())
+        out = guard.verify_batch(list(sets))
+        # the lying inner said True for the invalid canary, so the whole
+        # dispatch is distrusted and the real sets re-verify on the CPU
+        # oracle — correct verdicts, not the liar's
+        assert out.verdicts == _oracle(sets)
+        assert guard.distrusted == 1 and guard.sdc_events == 1
+        assert guard.reladdered_sets == len(sets)
+        # the breaker heard about it: a lying device is a sick device
+        assert resilient.breaker.consecutive_failures >= 1
+        # the liar only ever saw the first canary batch, never the reals
+        assert lying.calls == 1
+
+    def test_backstop_fails_closed_and_never_raises(self):
+        class Exploding:
+            def verify_batch(self, sets):
+                raise RuntimeError("kaboom")
+
+        guard = IntegrityGuard(Exploding(), None, corpus=CanaryCorpus())
+        out = guard.verify_batch([object(), object(), object()])
+        assert out.verdicts == [False, False, False]
+        assert guard.guard_backstops == 1
+
+    def test_registered_in_the_never_raise_registry(self):
+        from lighthouse_tpu.analysis import DEFAULT_NEVER_RAISE
+
+        assert (
+            "lighthouse_tpu/integrity/guard.py::IntegrityGuard.verify_batch"
+            in DEFAULT_NEVER_RAISE
+        )
+
+
+# ---------------------------------------------------------------------------
+# Cross-arm audit
+# ---------------------------------------------------------------------------
+
+
+class TestCrossArmAudit:
+    def test_cpu_floor_disagreement_is_an_sdc_event(self):
+        sets = _mixed_sets()
+        auditor = CrossArmAuditor(
+            lambda s: cpu_backend().verify_signature_sets(s), fraction=1.0,
+        )
+        guard = IntegrityGuard(
+            AllTrueVerifier(), None, k=0, auditor=auditor,
+        )
+        out = guard.verify_batch(list(sets))
+        # the inner lied True on the tampered set; the audit's oracle
+        # reference vector is released instead
+        assert out.verdicts == _oracle(sets)
+        assert guard.audits == 1 and guard.sdc_events == 1
+        assert guard.reladdered_sets == len(sets)
+
+    def test_agreeing_audit_changes_nothing(self):
+        sets = _mixed_sets()[:3]  # all valid: the liar happens to agree
+        auditor = CrossArmAuditor(
+            lambda s: cpu_backend().verify_signature_sets(s), fraction=1.0,
+        )
+        guard = IntegrityGuard(
+            AllTrueVerifier(), None, k=0, auditor=auditor,
+        )
+        out = guard.verify_batch(list(sets))
+        assert out.verdicts == [True, True, True]
+        assert guard.audits == 1 and guard.sdc_events == 0
+
+    def test_fraction_zero_never_samples(self):
+        auditor = CrossArmAuditor(lambda s: True, fraction=0.0)
+        assert auditor.maybe_audit([object()]) is None
+
+
+# ---------------------------------------------------------------------------
+# Trust scoring
+# ---------------------------------------------------------------------------
+
+
+class TestTrustScore:
+    def test_strike_crosses_threshold_exactly_once(self):
+        t = TrustScore(strike_threshold=2)
+        assert t.strike(3) is False          # 1 strike: below threshold
+        assert t.strike(3) is True           # 2nd crosses it
+        assert t.strike(3) is False          # already quarantined
+        assert t.quarantined(3) and not t.quarantined(4)
+
+    def test_clear_readmits(self):
+        t = TrustScore(strike_threshold=1)
+        assert t.strike(0) is True
+        t.clear(0)
+        assert not t.quarantined(0) and t.score(0) == 0
+
+    def test_threshold_must_be_positive(self):
+        with pytest.raises(ValueError):
+            TrustScore(strike_threshold=0)
+
+
+# ---------------------------------------------------------------------------
+# Boot-time selfcheck
+# ---------------------------------------------------------------------------
+
+
+class TestSelfcheck:
+    def test_honest_backend_passes(self):
+        report = run_selfcheck(cpu_backend())
+        assert report.ok and report.checked == len(CANARY_CORPUS)
+
+    def test_scalar_liar_fails(self):
+        class StuckTrue:
+            name = "stuck-true"
+
+            def verify_signature_sets(self, sets):
+                return True
+
+        report = run_selfcheck(StuckTrue())
+        assert not report.ok
+        invalid = sum(1 for r in CANARY_CORPUS if r[1] == "invalid")
+        assert len(report.mismatches) == invalid
+
+    def test_kernel_path_liar_fails_per_installed_batch_size(self):
+        class KernelLiar:
+            """Honest scalar path, lying B=2 kernel — the regime the
+            selfcheck exists for (a prewarmed cached program gone bad)."""
+
+            name = "kernel-liar"
+            _kernels = {("agg", 2): object()}
+
+            def verify_signature_sets(self, sets):
+                return all(bool(s.verify()) for s in sets)
+
+            def marshal_sets(self, sets):
+                class MB:
+                    invalid = False
+                return MB()
+
+            def dispatch(self, mb):
+                return mb
+
+            def resolve(self, handle):
+                return True
+
+        report = run_selfcheck(KernelLiar())
+        assert report.batch_sizes == (2,)
+        assert not report.ok
+        assert all("B=2" in m for m in report.mismatches)
+
+
+# ---------------------------------------------------------------------------
+# Stack + serve wiring
+# ---------------------------------------------------------------------------
+
+
+class TestStackWiring:
+    def test_python_backend_auto_leaves_the_oracle_unguarded(self):
+        from lighthouse_tpu.serve.stack import build_verify_stack
+
+        stack = build_verify_stack()
+        # scalar python backend: no ingest split, the backend IS the
+        # oracle — auto wires no guard
+        if stack.ingest is None:
+            assert stack.integrity is None
+            assert stack.verifier is (stack.pod or stack.resilient)
+        else:
+            assert stack.integrity is stack.verifier
+
+    def test_forced_integrity_wraps_and_stays_correct(self):
+        from lighthouse_tpu.serve.stack import build_verify_stack
+
+        stack = build_verify_stack(integrity=True)
+        assert isinstance(stack.integrity, IntegrityGuard)
+        assert stack.verifier is stack.integrity
+        sets = _mixed_sets()
+        assert stack.verifier.verify_batch(sets).verdicts == _oracle(sets)
+
+    def test_serve_rotate_epoch_reaches_the_guard(self):
+        from lighthouse_tpu.serve.service import VerifyService
+        from lighthouse_tpu.serve.stack import build_verify_stack
+
+        stack = build_verify_stack(integrity=True)
+        svc = VerifyService(stack.verifier, breaker=stack.breaker)
+        assert stack.integrity.corpus.epoch == 0
+        svc.rotate_epoch(7)
+        assert stack.integrity.corpus.epoch == 7
+        # a plain verifier has no rotate: the hook is a no-op, not a crash
+        VerifyService(_real_resilient()).rotate_epoch(3)
+
+
+# ---------------------------------------------------------------------------
+# The sdc-storm scenario pair
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.scenario
+def test_sdc_storm_holds_the_zero_wrong_accept_line():
+    from lighthouse_tpu.scenario.engine import run_scenario
+
+    r = run_scenario("sdc-storm")
+    assert r["pass"], [s for s in r["slo"] if not s["ok"]]
+    assert r["facts"]["sdc_wrong_accepts"] == 0
+    assert r["facts"]["sdc_detected"] >= 1
+    assert r["facts"]["sdc_quarantined"] >= 1
+    assert r["facts"]["sdc_injected"] > 0
+    assert r["facts"]["sdc_canary_checks"] >= 1
+
+
+@pytest.mark.scenario
+def test_sdc_storm_undefended_twin_fails_the_detection_gates():
+    from lighthouse_tpu.scenario.engine import run_scenario
+
+    r = run_scenario("sdc-storm-undefended")
+    assert not r["pass"], "canaries off must release wrong accepts"
+    failed = {s["name"] for s in r["slo"] if not s["ok"]}
+    assert {"sdc_wrong_accepts", "sdc_detected", "sdc_quarantined"} <= failed
+    # the escape is epoch-localized: the per-epoch wrong-accept gate
+    # names the first epoch the hostile window bit
+    assert r["first_violation_epoch"] == 2
+    assert r["facts"]["sdc_wrong_accepts"] > 0
+    assert r["facts"]["sdc_detected"] == 0

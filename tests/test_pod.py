@@ -304,6 +304,62 @@ class TestCorruptShardResult:
         assert ("pod", 16) not in resilient.journal
 
 
+class _StubCorpus:
+    """Bool-harness canary corpus: the real BLS corpus can't flow through
+    the list-of-bools pod, so the known answers ARE bools.  Invalid-first,
+    like the real one — the stuck-true lie must be probeable."""
+
+    def batches(self, k=2):
+        return [([False], False), ([True], True)]
+
+    def rotate(self, epoch):
+        pass
+
+
+class TestSilentStuckTrueGap:
+    """The False->True verdict lie at pod.gather: without the integrity
+    guard the pod WRONG-ACCEPTS (the pinned gap this layer closes); with
+    the guard the canary catches it, the batch re-ladders to the CPU
+    oracle, and every lying device is quarantined."""
+
+    def test_unguarded_pod_wrong_accepts_the_stuck_true_lie(self):
+        inj = FaultInjector()
+        # the CLI-facing spec form: targeted False->True flip, unbounded
+        inj.arm_from_spec("pod.gather=corrupt-shard-result:stuck-true")
+        pod, _ = make_pod(injector=inj)
+        sets = [True] * 15 + [False]
+        out = pod.verify_batch(sets)
+        # every shard verdict comes back True, the conjunction holds, and
+        # the invalid set sails through: the wrong accept, pinned
+        assert out.verdicts == [True] * 16
+        assert out.verdicts != _oracle(sets)
+
+    def test_guarded_pod_catches_reladders_and_quarantines(self):
+        from lighthouse_tpu.integrity import IntegrityGuard
+
+        inj = FaultInjector()
+        inj.arm("pod.gather", "silent-stuck-true")
+        pod, resilient = make_pod(injector=inj)
+        guard = IntegrityGuard(
+            pod, resilient, corpus=_StubCorpus(), strike_threshold=1,
+        )
+        guard.attach_pod(pod)
+        sets = [True] * 15 + [False]
+        out = guard.verify_batch(sets)
+        # the invalid-first canary came back True: dispatch distrusted,
+        # real sets re-verified on the CPU oracle — correct verdicts out
+        assert out.verdicts == _oracle(sets)
+        assert guard.distrusted == 1 and guard.sdc_events == 1
+        assert guard.reladdered_sets == 16
+        # every device failed its canary probe and is out of the mesh
+        assert guard.quarantined == set(range(8))
+        assert pod.health.healthy() == []
+        assert resilient.breaker.consecutive_failures >= 1
+        # lie disarmed: the canary-only probe is the readmission gate
+        inj.disarm()
+        assert pod.device_canary_probe(0) is True
+
+
 class TestAllDevicesDown:
     def test_mesh_exhaustion_lands_on_the_cpu_ladder(self):
         inj = FaultInjector()

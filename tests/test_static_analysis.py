@@ -307,6 +307,86 @@ def test_tune_plan_live_registry_binds_proven_arms():
     assert {a.arm for a in autotune.proven_arms()} == set(arms)
 
 
+def test_integrity_corpus_fires_on_every_seeded_shape(corpus_result):
+    vios = _by_rule(corpus_result)["integrity-corpus"]
+    symbols = {v.symbol for v in vios}
+    msgs = [v.message for v in vios]
+    # malformed rows: wrong arity and a non-string member (2 shapes)
+    assert sum("string triple" in m for m in msgs) == 2
+    # unknown kinds the generator cannot materialise (2 shapes)
+    assert "fix-bogus" in symbols
+    assert "fix-maybe" in symbols
+    # duplicate entry ids (2 shapes)
+    assert sum("duplicate canary entry id" in m for m in msgs) == 2
+    # one-sided corpus: no well-formed invalid canary survives
+    assert any("no 'invalid' canary" in m for m in msgs)
+    # claimed-but-unregistered chaos kinds (2 shapes)
+    assert "silent-ghost" in symbols
+    assert "silent-phantom" in symbols
+    # registered silent-* kinds the coverage contract dropped (2 shapes)
+    assert "silent-unclaimed-a" in symbols
+    assert "silent-unclaimed-b" in symbols
+    # good shapes stay clean
+    assert "silent-good" not in symbols
+    assert len(vios) == 11
+
+
+def test_integrity_corpus_skipped_when_defs_absent():
+    # corpora without the integrity layer (older fixture corpora) run
+    # the other families without an integrity-corpus finding
+    from lighthouse_tpu.analysis import registry_lint
+
+    out = registry_lint.run(
+        [("a.py", "x = 1\n")], [],
+        metrics_defs_path="nope_metrics.py",
+        faults_defs_path="nope_faults.py",
+        integrity_defs_path="nope_integrity.py",
+    )
+    assert not [v for v in out if v.rule == "integrity-corpus"]
+    # a present-but-empty defs file reports both missing registries
+    direct = registry_lint.integrity_violations(
+        [("gone.py", "x = 1\n")], "gone.py", "nope_faults.py",
+    )
+    assert {v.symbol for v in direct} == {
+        "CANARY_CORPUS", "REQUIRED_CHAOS_KINDS",
+    }
+
+
+def test_integrity_corpus_live_registry_binds_runtime():
+    """The AST parse sees exactly the runtime canary corpus, every
+    claimed chaos kind is armable, and the live registries produce zero
+    findings — the contract the sdc scenarios lean on."""
+    from lighthouse_tpu.analysis.registry_lint import (
+        _fault_kind_defs,
+        integrity_defs,
+        integrity_violations,
+    )
+    from lighthouse_tpu.integrity import corpus as corpus_mod
+    from lighthouse_tpu.utils import faults as faults_mod
+
+    int_path = "lighthouse_tpu/integrity/corpus.py"
+    faults_path = "lighthouse_tpu/utils/faults.py"
+    srcs = {}
+    for path in (int_path, faults_path):
+        with open(os.path.join(REPO, path), encoding="utf-8") as f:
+            srcs[path] = f.read()
+    corpus_node, kinds_node = integrity_defs(srcs[int_path], int_path)
+    parsed_ids = [
+        e.elts[0].value for e in corpus_node.value.elts
+    ]
+    assert parsed_ids == [r[0] for r in corpus_mod.CANARY_CORPUS]
+    assert [
+        x.value for x in kinds_node.value.elts
+    ] == list(corpus_mod.REQUIRED_CHAOS_KINDS)
+    registered = _fault_kind_defs(srcs[faults_path], faults_path)
+    assert set(registered) == set(faults_mod._KINDS)
+    for kind in corpus_mod.REQUIRED_CHAOS_KINDS:
+        assert kind in faults_mod._KINDS
+    assert not integrity_violations(
+        list(srcs.items()), int_path, faults_path,
+    )
+
+
 def test_live_serve_port_docs_are_valid(live_result):
     # every concrete --serve-port example in README/docs must be a real
     # TCP port, same doc-example contract as --chaos / --scenario
