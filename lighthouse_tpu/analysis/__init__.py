@@ -1,6 +1,6 @@
 """Repo-wide static invariant analyzer.
 
-One entrypoint (``tools/pyrun tools/static_audit.py``) runs five lint
+One entrypoint (``tools/pyrun tools/static_audit.py``) runs six lint
 families over the package and emits a JSON report, failing on any
 unwaivered violation:
 
@@ -15,9 +15,14 @@ unwaivered violation:
   overflow/carry proofs for every registered field kernel, LFp bound
   algebra soundness, and the MXU-readiness report
   (``RANGE_REPORT.json``)
+* ``spmd_lint``     — SPMD soundness prover: re-stages the sharded
+  programs over an abstract mesh and proves collective legality,
+  verdict replication, pad absorption, registry-gather bounds, and
+  donation discipline
 
-The first four families are pure-AST and finish in seconds; ``range``
-traces kernels through jax and dominates the wall time — use
+The pure-AST families finish in seconds; ``range`` and ``spmd`` trace
+programs through jax and dominate the wall time (both replay cached
+verdicts from ``.range_proof_cache.json`` on an untouched tree) — use
 ``tools/static_audit.py --only lock,raise,registry,jaxpr`` (see
 ``AST_FAMILIES``) for the fast tier.
 
@@ -33,7 +38,14 @@ import os
 import time
 from dataclasses import dataclass, field
 
-from . import jaxpr_lint, lock_lint, raise_lint, range_lint, registry_lint
+from . import (
+    jaxpr_lint,
+    lock_lint,
+    raise_lint,
+    range_lint,
+    registry_lint,
+    spmd_lint,
+)
 from .report import Violation
 from .waivers import Waiver, apply_waivers, load_waivers, parse_toml_subset
 
@@ -41,7 +53,7 @@ __all__ = [
     "AuditConfig", "AuditResult", "Violation", "Waiver",
     "run_audit", "load_config", "discover_files", "load_waivers",
     "jaxpr_lint", "lock_lint", "raise_lint", "range_lint",
-    "registry_lint", "ALL_FAMILIES", "AST_FAMILIES",
+    "registry_lint", "spmd_lint", "ALL_FAMILIES", "AST_FAMILIES",
 ]
 
 DEFAULT_NEVER_RAISE = (
@@ -55,7 +67,7 @@ DEFAULT_NEVER_RAISE = (
     "lighthouse_tpu/integrity/guard.py::IntegrityGuard.verify_batch",
 )
 
-ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr", "range")
+ALL_FAMILIES = ("lock", "raise", "registry", "jaxpr", "range", "spmd")
 # the pure-AST tier: no jax import, finishes in seconds
 AST_FAMILIES = ("lock", "raise", "registry", "jaxpr")
 
@@ -122,8 +134,13 @@ class AuditConfig:
     range_only: tuple = ()
     # replay per-program range verdicts from .range_proof_cache.json
     # when the kernel sources are unchanged (False / CLI --no-cache
-    # forces fresh interpret-mode traces)
+    # forces fresh interpret-mode traces); the spmd family shares the
+    # flag and the cache file under its own fingerprint
     range_cache: bool = True
+    # spmd family: fixture registry override (python file exposing
+    # build_programs()/DECLARED_AXES; empty = the live staged-program
+    # registry traced out of parallel/partition.py + mesh.py)
+    spmd_defs: str = ""
 
 
 @dataclass
@@ -275,6 +292,8 @@ def load_config(path: str) -> AuditConfig:
         cfg.range_only = tuple(a["range_only"])
     if "range_cache" in a:
         cfg.range_cache = bool(a["range_cache"])
+    if "spmd_defs" in a:
+        cfg.spmd_defs = a["spmd_defs"]
     if "hot_path" in a:
         # entries are "relpath::fn" strings
         hp: dict[str, list] = {}
@@ -413,6 +432,11 @@ def run_audit(
         t = time.perf_counter()
         violations.extend(range_lint.run(root, cfg, only=cfg.range_only))
         fam_t["range"] = time.perf_counter() - t
+
+    if "spmd" in cfg.families:
+        t = time.perf_counter()
+        violations.extend(spmd_lint.run(root, cfg, files))
+        fam_t["spmd"] = time.perf_counter() - t
 
     violations.sort(key=lambda v: (v.path, v.line, v.rule, v.symbol))
     failing, waived = apply_waivers(violations, waivers)

@@ -1656,6 +1656,30 @@ _CACHE_VERSION = 1
 _CACHE_STATS = {"hits": 0, "misses": 0}
 
 
+def _fingerprint_deps(root: str) -> list:
+    """Repo-relative source files whose bytes feed ``_proof_fingerprint``.
+
+    Covers the kernel package AND the sharded-program sources
+    (``parallel/partition.py``/``mesh.py``): the spmd family keys its
+    cached theorem verdicts off the same fingerprint, so an edit to the
+    staged SPMD programs must invalidate them."""
+    deps = [
+        "lighthouse_tpu/analysis/range_lint.py",
+        "lighthouse_tpu/analysis/report.py",
+        "lighthouse_tpu/crypto/bls/params.py",
+        "lighthouse_tpu/parallel/partition.py",
+        "lighthouse_tpu/parallel/mesh.py",
+    ]
+    kdir = "lighthouse_tpu/crypto/bls/jax_backend"
+    full_kdir = os.path.join(root, kdir)
+    if os.path.isdir(full_kdir):
+        deps.extend(
+            f"{kdir}/{fn}" for fn in sorted(os.listdir(full_kdir))
+            if fn.endswith(".py")
+        )
+    return deps
+
+
 def _proof_fingerprint(root: str) -> str:
     """Content hash of everything a live program verdict depends on.
 
@@ -1673,19 +1697,7 @@ def _proof_fingerprint(root: str) -> str:
         f"v{_CACHE_VERSION}|jax {jax.__version__}|np {np.__version__}"
         .encode()
     )
-    deps = [
-        "lighthouse_tpu/analysis/range_lint.py",
-        "lighthouse_tpu/analysis/report.py",
-        "lighthouse_tpu/crypto/bls/params.py",
-    ]
-    kdir = "lighthouse_tpu/crypto/bls/jax_backend"
-    full_kdir = os.path.join(root, kdir)
-    if os.path.isdir(full_kdir):
-        deps.extend(
-            f"{kdir}/{fn}" for fn in sorted(os.listdir(full_kdir))
-            if fn.endswith(".py")
-        )
-    for rel in deps:
+    for rel in _fingerprint_deps(root):
         h.update(rel.encode())
         try:
             with open(os.path.join(root, rel), "rb") as f:
@@ -1748,6 +1760,7 @@ def generate(root: str, cfg, only: tuple = ()) -> tuple:
     cache_path = os.path.join(root, _CACHE_FILE)
     fingerprint = _proof_fingerprint(root) if use_cache else ""
     cached: dict = {}
+    disk: dict = {}
     if use_cache:
         try:
             with open(cache_path, encoding="utf-8") as f:
@@ -1755,7 +1768,7 @@ def generate(root: str, cfg, only: tuple = ()) -> tuple:
             if disk.get("fingerprint") == fingerprint:
                 cached = dict(disk.get("programs") or {})
         except (OSError, ValueError):
-            cached = {}
+            disk, cached = {}, {}
     dirty = False
     prog_reports: dict = {}
     for prog in programs:
@@ -1785,11 +1798,15 @@ def generate(root: str, cfg, only: tuple = ()) -> tuple:
         violations.extend(vios)
         prog_reports[prog.name] = rep
     if use_cache and dirty:
+        # the cache file is shared with the spmd family: carry its
+        # sections (spmd_fingerprint / spmd_programs) through unchanged
+        # — each family validates only its own fingerprint on read
+        doc = {k: v for k, v in disk.items() if k.startswith("spmd_")}
+        doc["fingerprint"] = fingerprint
+        doc["programs"] = cached
         try:
             with open(cache_path, "w", encoding="utf-8") as f:
-                json.dump(
-                    {"fingerprint": fingerprint, "programs": cached}, f
-                )
+                json.dump(doc, f)
         except OSError:
             pass   # unwritable cache just means the next run is cold too
     checks_out: list = []

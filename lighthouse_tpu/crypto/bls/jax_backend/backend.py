@@ -431,7 +431,11 @@ class JaxBackend:
             # per-batch buffers, and donation lets XLA alias them for
             # temporaries — required for double-buffered dispatch to
             # keep two batches resident without growing HBM. CPU/test
-            # backends ignore donation (XLA warns), so gate it.
+            # backends ignore donation (XLA warns), so gate it.  The
+            # gate itself is load-bearing and lint-enforced: the spmd
+            # audit family's donation lint (spmd-donate) fails on any
+            # non-empty donate_argnums outside a TPU-backend guard,
+            # and on reads of a donated buffer after the donating call.
             donate = ()
             if jax.default_backend() == "tpu":
                 donate = tuple(range(5 if self.device_h2c else 4))

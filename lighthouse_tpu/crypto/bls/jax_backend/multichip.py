@@ -116,8 +116,11 @@ def make_verify_sharded(mesh: Mesh, axis: str = "batch"):
     # inside-a-mesh flag through fp.py buys type checking and costs a
     # second code path in the hottest code; correctness is instead
     # pinned by the shard-vs-single bit-equality tests
-    # (test_multichip.py) and the poisoned-batch rejection in the
-    # driver's dryrun.
+    # (test_multichip.py), the poisoned-batch rejection in the
+    # driver's dryrun, and — statically — the spmd audit family
+    # (analysis/spmd_lint.py), whose own replication check proves the
+    # scan-with-replicated-carry pattern device-identical through
+    # exactly the typing gap check_vma trips over here.
     sharded = _shard_map(
         local_part,
         mesh=mesh,

@@ -113,7 +113,14 @@ def ring_reduce(tree, combine, axis: str = BATCH_AXIS):
     partial into its accumulator; after the loop every device holds the
     full product.  For non-commutative-friendly shapes prefer this over
     all_gather when the partials are large (one hop in flight instead of
-    an N-way gather)."""
+    an N-way gather).
+
+    Replication of the result is *proved*, not assumed: jax's own
+    check_rep/check_vma cannot see that N-1 uniform-ring hops of a
+    commutative fold cover every shard, so the spmd audit family
+    (``analysis/spmd_lint.py``, ``ring_reduce_w*`` programs) tracks the
+    offset set through the ppermute chain and fails the audit if the
+    fold ever comes up a hop short."""
     try:
         n = jax.lax.axis_size(axis)  # static: the mesh extent
     except AttributeError:

@@ -129,6 +129,19 @@ def _ps(*parts):
     return PS(*parts)
 
 
+def mesh_width(mesh) -> int:
+    """Device count of a mesh via the axis-size product, so device-less
+    tracing meshes (``jax.sharding.AbstractMesh``, which the spmd lint
+    stages programs over) work the same as real ones."""
+    shape = getattr(mesh, "shape", None)
+    if shape:
+        width = 1
+        for n in shape.values():
+            width *= int(n)
+        return width
+    return int(mesh.devices.size)
+
+
 # ---------------------------------------------------------------------------
 # Operand naming: marshalled tuple -> (name, leaf) pairs
 # ---------------------------------------------------------------------------
@@ -305,6 +318,18 @@ def _pad_tail(args, pad: int):
     )
 
 
+def _pad_slots(slots, pad: int):
+    """Pad a (B,) slot vector with duplicates of slot 0 — the same
+    dup-of-column-0 contract as the operand columns, so a pad lane's
+    gathered pubkey matches its (duplicated) operand column."""
+    import jax.numpy as jnp
+
+    slots = jnp.asarray(slots)
+    if pad <= 0:
+        return slots
+    return jnp.concatenate([slots, jnp.repeat(slots[:1], pad)])
+
+
 # ---------------------------------------------------------------------------
 # The sharded program
 # ---------------------------------------------------------------------------
@@ -339,7 +364,7 @@ class ShardedVerifyProgram:
         self.local_verify_fn = local_verify_fn
         self.pk_wrap = pk_wrap
         self.rules = rules
-        self.width = int(mesh.devices.size)
+        self.width = mesh_width(mesh)
         self._programs: dict = {}
 
     # -- stages -------------------------------------------------------------
@@ -388,13 +413,7 @@ class ShardedVerifyProgram:
     def dispatch_registry(self, registry, slots, rest_args):
         """pad -> shard -> execute_registry (async), one call — slots
         pad with duplicates of slot 0, matching the operand columns."""
-        import jax.numpy as jnp
-
-        pad = (-int(np.shape(slots)[0])) % self.width
-        if pad:
-            slots = jnp.concatenate(
-                [jnp.asarray(slots), jnp.repeat(jnp.asarray(slots)[:1],
-                                                pad)])
+        slots = _pad_slots(slots, (-int(np.shape(slots)[0])) % self.width)
         rest = self.pad_operands(tuple(rest_args))
         slots, rest = self._shard_registry_inputs(slots, rest)
         return self.execute_registry(registry, slots, rest)
@@ -451,35 +470,16 @@ class ShardedVerifyProgram:
         return args[3:] if deferred_pk else args
 
     def _build(self, args, deferred_pk: bool):
-        import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding
 
-        axis = self.axis
-        rest_specs = operand_partition_specs(
+        in_specs = program_in_specs(
             self._semantic_args(args, deferred_pk),
-            deferred_pk=deferred_pk, rules=self.rules, axis=axis,
+            deferred_pk=deferred_pk, rules=self.rules, axis=self.axis,
         )
-        if deferred_pk:
-            in_specs = (SPEC_TOKENS["registry"](2, axis),
-                        SPEC_TOKENS["registry"](2, axis),
-                        SPEC_TOKENS["batch"](1, axis)) + rest_specs
-        else:
-            in_specs = rest_specs
-
-        fn = self.local_verify_fn
-        pk_wrap = self.pk_wrap
-
-        if deferred_pk:
-            def local(reg_x, reg_y, slots, *rest):
-                x, y = _registry_gather_local(reg_x, reg_y, slots, axis)
-                ok = fn(pk_wrap(x, y), *rest)
-                return jax.lax.all_gather(jnp.reshape(ok, ()), axis)
-        else:
-            def local(*a):
-                ok = fn(*a)
-                return jax.lax.all_gather(jnp.reshape(ok, ()), axis)
-
+        local = staged_local(
+            self.local_verify_fn, axis=self.axis, deferred_pk=deferred_pk,
+            pk_wrap=self.pk_wrap,
+        )
         sharded = compat_shard_map(
             local, self.mesh, in_specs=in_specs, out_specs=_ps()
         )
@@ -489,6 +489,47 @@ class ShardedVerifyProgram:
         # the pjit path: explicit in_shardings pin the rule table's
         # placement so pre-sharded operands are never silently resharded
         return compat_jit_sharded(sharded, in_shardings=shardings)
+
+
+def program_in_specs(semantic_args, *, deferred_pk: bool,
+                     rules=PARTITION_RULES, axis: str = AXIS):
+    """The staged program's full in_specs tree: the rule-matched specs
+    for the marshalled operands, prefixed in registry mode by the
+    registry-mirror and slot-vector specs.  Shared by ``_build`` and by
+    the spmd lint, which re-stages the same program over an abstract
+    mesh — one constructor, one proof surface."""
+    rest_specs = operand_partition_specs(
+        semantic_args, deferred_pk=deferred_pk, rules=rules, axis=axis,
+    )
+    if deferred_pk:
+        return (SPEC_TOKENS["registry"](2, axis),
+                SPEC_TOKENS["registry"](2, axis),
+                SPEC_TOKENS["batch"](1, axis)) + rest_specs
+    return rest_specs
+
+
+def staged_local(fn, *, axis: str = AXIS, deferred_pk: bool = False,
+                 pk_wrap: Callable | None = None):
+    """The per-device body of the staged program: registry gather (in
+    deferred-pk mode), the local kernel, then the verdict all_gather.
+    This is the exact callable ``_build`` wraps in ``compat_shard_map``
+    — the spmd lint traces it rather than a paraphrase."""
+    import jax
+    import jax.numpy as jnp
+
+    if deferred_pk:
+        if pk_wrap is None:
+            raise ValueError("registry mode needs pk_wrap")
+
+        def local(reg_x, reg_y, slots, *rest):
+            x, y = _registry_gather_local(reg_x, reg_y, slots, axis)
+            ok = fn(pk_wrap(x, y), *rest)
+            return jax.lax.all_gather(jnp.reshape(ok, ()), axis)
+    else:
+        def local(*a):
+            ok = fn(*a)
+            return jax.lax.all_gather(jnp.reshape(ok, ()), axis)
+    return local
 
 
 def _registry_gather_local(reg_x, reg_y, slots_local, axis: str):

@@ -515,6 +515,12 @@ class PodVerifier:
                 and hasattr(self.backend, "local_verify_fn"))
 
     def _sharded_program(self, key: tuple):
+        # every program built here (full-pod, post-exclusion re-shard,
+        # canary/probe batch) stages through ShardedVerifyProgram, so
+        # the spmd audit family's theorem proofs — collective legality,
+        # verdict replication, pad absorption, gather bounds — cover
+        # these dispatches at their characteristic width/batch shapes
+        # (see analysis/spmd_lint.build_live_programs)
         prog = self._sharded_programs.get(key)
         if prog is None:
             import numpy as np

@@ -1,0 +1,160 @@
+"""Seeded SPMD-violation shapes for the ``spmd`` audit family.
+
+Each function is one staged-program body (or, for the donation class,
+one AST shape) exercising exactly one theorem-class failure:
+
+* ``bad_axis_psum`` / ``bad_axis_gather`` — collectives naming a mesh
+  axis missing from the declared registry
+* ``cond_psum_varying`` / ``cond_gather_varying`` — collectives under a
+  shard-varying conditional
+* ``gather_unmasked`` / ``gather_wrong_bound`` — registry-gather take
+  indices escaping the local shard
+* ``rep_axis_index_leak`` / ``rep_partial_ring`` — out_specs claiming
+  replication for a shard-varying value
+* ``sum_combine_verdict`` / ``prod_combine_verdict`` — non-idempotent
+  reductions on the verdict path (pad lanes double-count)
+* ``pad_zero_fill`` / ``pad_mean_fill`` — pad lanes that are not
+  duplicates of a real column
+* ``donate_ungated_literal`` / ``donate_ungated_flag`` — donation
+  outside the TPU-backend guard
+* ``read_after_donate_first`` / ``read_after_donate_second`` — donated
+  buffers read after the donating call
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+# -- collective legality: unregistered axes ---------------------------------
+
+
+def bad_axis_psum(x):
+    s = jax.lax.psum(x, "rows")
+    return jax.lax.all_gather(jnp.reshape(jnp.min(s), ()), "batch")
+
+
+def bad_axis_gather(x):
+    g = jax.lax.all_gather(x, "cols")
+    return jax.lax.all_gather(jnp.reshape(jnp.min(g), ()), "batch")
+
+
+# -- shard-varying divergence ------------------------------------------------
+
+
+def cond_psum_varying(x):
+    p = jax.lax.axis_index("batch") > 0
+    return jax.lax.cond(
+        p,
+        lambda: jax.lax.psum(jnp.float32(1.0), "batch"),
+        lambda: jnp.float32(0.0),
+    )
+
+
+def cond_gather_varying(x):
+    p = jnp.all(x > 0)
+    return jax.lax.cond(
+        p,
+        lambda: jnp.min(jax.lax.all_gather(x, "batch")),
+        lambda: jnp.float32(0.0),
+    )
+
+
+# -- out-of-bounds registry gather ------------------------------------------
+
+
+def gather_unmasked(reg, slots):
+    idx = jax.lax.axis_index("batch")
+    n_local = reg.shape[1]
+    base = (idx * n_local).astype(jnp.int32)
+    slots_all = jax.lax.all_gather(slots, "batch", tiled=True)
+    rel = slots_all.astype(jnp.int32) - base
+    cols = jax.lax.psum(jnp.take(reg, rel, axis=1), "batch")
+    return jax.lax.all_gather(jnp.reshape(jnp.min(cols), ()), "batch")
+
+
+def gather_wrong_bound(reg, slots):
+    idx = jax.lax.axis_index("batch")
+    n_local = reg.shape[1]
+    base = (idx * n_local).astype(jnp.int32)
+    slots_all = jax.lax.all_gather(slots, "batch", tiled=True)
+    rel = slots_all.astype(jnp.int32) - base
+    hit = (rel >= 0) & (rel < n_local + 2)   # off-by-two shard bound
+    safe = jnp.where(hit, rel, 0)
+    cols = jax.lax.psum(
+        jnp.take(reg, safe, axis=1) * hit.astype(reg.dtype), "batch"
+    )
+    return jax.lax.all_gather(jnp.reshape(jnp.min(cols), ()), "batch")
+
+
+# -- dead replication claims -------------------------------------------------
+
+
+def rep_axis_index_leak(x):
+    return jnp.min(x) * 0 + jax.lax.axis_index("batch")
+
+
+def rep_partial_ring(x):
+    n = 4
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc = x
+    inc = x
+    for _ in range(n - 2):   # one hop short: one shard never folded in
+        inc = jax.lax.ppermute(inc, "batch", perm=perm)
+        acc = acc + inc
+    return acc
+
+
+# -- non-idempotent verdict combines ----------------------------------------
+
+
+def sum_combine_verdict(x):
+    s = jnp.sum(x)
+    return jax.lax.all_gather(jnp.reshape(s, ()), "batch")
+
+
+def prod_combine_verdict(x):
+    s = jnp.prod(x)
+    return jax.lax.all_gather(jnp.reshape(s, ()), "batch")
+
+
+# -- non-absorbing pads ------------------------------------------------------
+
+
+def pad_zero_fill(a, pad):
+    z = jnp.zeros(a.shape[:-1] + (pad,), a.dtype)
+    return jnp.concatenate([a, z], axis=-1)
+
+
+def pad_mean_fill(a, pad):
+    m = jnp.mean(a, axis=-1, keepdims=True).astype(a.dtype)
+    return jnp.concatenate([a] + [m] * pad, axis=-1)
+
+
+# -- donation discipline (AST shapes; never executed) ------------------------
+
+
+def donate_ungated_literal(fn, args):
+    jitted = jax.jit(fn, donate_argnums=(0, 1))
+    return jitted(*args)
+
+
+def donate_ungated_flag(fn, args):
+    donate = (0,)
+    jitted = jax.jit(fn, donate_argnums=donate)
+    return jitted(*args)
+
+
+def read_after_donate_first(fn, a, b):
+    if jax.default_backend() == "tpu":
+        kern = jax.jit(fn, donate_argnums=(0,))
+        out = kern(a, b)
+        return out, a.sum()   # `a` was donated to kern
+    return None
+
+
+def read_after_donate_second(fn, a, b):
+    if jax.default_backend() == "tpu":
+        kern = jax.jit(fn, donate_argnums=(1,))
+        out = kern(a, b)
+        return out + b        # `b` was donated to kern
+    return None

@@ -1,17 +1,21 @@
 """Static invariant analyzer suite.
 
-Locks down five surfaces: (1) the live repo stays clean under the full
+Locks down six surfaces: (1) the live repo stays clean under the full
 audit (zero unwaivered findings, and the waiver file is honoured) —
 the fast AST tier runs in-module, the minutes-scale ``range`` kernel
 proofs under ``slow``; (2) the seeded corpus under
 ``tests/fixtures/lint/`` makes every lint family fire on at least two
-distinct violation shapes — including the two-lock deadlock cycle and
-the four range-family theorem classes; (3) the CLI exit codes and the
-waiver/stale-waiver mechanics; (4) one chaos sync soak runs under the
-runtime lockcheck sanitizer and the observed acquisition order is
-verified against the static lock-order graph; (5) the range family's
-live-tree proofs: strict/quasi output contracts and the exact LFp
-bound algebra hold on the real kernels.
+distinct violation shapes — including the two-lock deadlock cycle,
+the four range-family theorem classes, and the six spmd finding
+shapes; (3) the CLI exit codes (including ``--changed`` family
+scoping) and the waiver/stale-waiver mechanics; (4) one chaos sync
+soak runs under the runtime lockcheck sanitizer and the observed
+acquisition order is verified against the static lock-order graph;
+(5) the range family's live-tree proofs: strict/quasi output
+contracts and the exact LFp bound algebra hold on the real kernels;
+(6) the spmd family's live-tree proofs: the staged sharded programs
+pass all four SPMD theorem classes at zero waivers, with warm replay
+through the shared proof cache (runtime half in test_spmd_probe).
 """
 
 import json
@@ -24,11 +28,13 @@ import threading
 import pytest
 
 from lighthouse_tpu.analysis import (
+    ALL_FAMILIES,
     AST_FAMILIES,
     AuditConfig,
     load_config,
     range_lint,
     run_audit,
+    spmd_lint,
 )
 from lighthouse_tpu.analysis.lock_lint import static_lock_order
 from lighthouse_tpu.analysis.waivers import (
@@ -694,6 +700,185 @@ def test_range_report_drift_detector_unit(tmp_path, monkeypatch):
     assert drift and "drift" in drift[0].symbol
 
 
+# -- spmd family: seeded corpus fires shape by shape ----------------------
+
+
+def test_spmd_collective_fires_on_axis_and_divergence(corpus_result):
+    syms = sorted(v.symbol for v in _by_rule(corpus_result)["spmd-collective"])
+    assert syms == [
+        "fixture_bad_axis_gather:all_gather@cols",
+        "fixture_bad_axis_psum:psum@rows",
+        "fixture_cond_gather_varying:all_gather:diverging",
+        "fixture_cond_psum_varying:psum:diverging",
+    ]
+
+
+def test_spmd_replication_fires_on_leak_ring_and_cond(corpus_result):
+    syms = sorted(
+        v.symbol for v in _by_rule(corpus_result)["spmd-replication"]
+    )
+    assert syms == [
+        "fixture_cond_gather_varying:out0",
+        "fixture_cond_psum_varying:out0",
+        "fixture_rep_axis_index_leak:out0",
+        "fixture_rep_partial_ring:out0",
+    ]
+
+
+def test_spmd_bounds_fires_on_unmasked_and_wrong_bound(corpus_result):
+    found = _by_rule(corpus_result)["spmd-bounds"]
+    assert sorted(v.symbol.split("@")[0] for v in found) == [
+        "fixture_gather_unmasked:gather",
+        "fixture_gather_wrong_bound:gather",
+    ]
+    by_prog = {v.symbol.split(":")[0]: v.message for v in found}
+    # the unmasked take sees the full gathered slot range...
+    assert "[0, 11]" in by_prog["fixture_gather_unmasked"]
+    # ...the off-by-two mask narrows it, but not enough
+    assert "[0, 5]" in by_prog["fixture_gather_wrong_bound"]
+    for msg in by_prog.values():
+        assert "escapes the local shard bound [0, 3]" in msg
+
+
+def test_spmd_pad_fires_on_combines_and_fills(corpus_result):
+    found = _by_rule(corpus_result)["spmd-pad"]
+    combines = sorted(
+        v.symbol.split("@")[0] for v in found if "@" in v.symbol
+    )
+    assert combines == [
+        "fixture_prod_combine:reduce_prod",
+        "fixture_sum_combine:reduce_sum",
+    ]
+    cols = sorted(v.symbol for v in found if "@" not in v.symbol)
+    assert cols == (
+        [f"fixture_pad_mean_fill:col{j}" for j in (5, 6, 7)]
+        + [f"fixture_pad_zero_fill:col{j}" for j in (5, 6, 7)]
+    )
+
+
+def test_spmd_donate_fires_on_ungated_and_read_after(corpus_result):
+    found = _by_rule(corpus_result)["spmd-donate"]
+    assert sorted(v.symbol for v in found) == [
+        "read-after-donate", "read-after-donate",
+        "ungated-donation", "ungated-donation",
+    ]
+    reads = sorted(
+        v.message.split("'")[1] for v in found
+        if v.symbol == "read-after-donate"
+    )
+    assert reads == ["a", "b"]  # both donated buffers are caught
+
+
+def test_spmd_corpus_fires_every_program(corpus_result):
+    progs = {
+        v.symbol.split(":")[0]
+        for v in corpus_result.violations
+        if v.rule.startswith("spmd-") and v.symbol.startswith("fixture_")
+    }
+    assert len(progs) == 12  # every registered fixture program fired
+
+
+# -- spmd family: live-tree proofs + shared proof cache --------------------
+
+
+@pytest.fixture(scope="module")
+def live_spmd():
+    return run_audit(REPO, AuditConfig(families=("spmd",)), waivers=WAIVERS)
+
+
+def test_live_spmd_prover_is_clean_at_zero_waivers(live_spmd):
+    assert live_spmd.ok, "live spmd audit found findings:\n" + "\n".join(
+        str(v) for v in live_spmd.violations
+    )
+    assert not [w for w in live_spmd.waived
+                if w.rule.startswith("spmd-")], (
+        "the spmd family is a zero-waiver surface"
+    )
+
+
+def test_live_spmd_registry_covers_the_staged_surfaces():
+    names = {p.name for p in spmd_lint.build_live_programs()}
+    # flat + registry verify programs at three width/batch shapes,
+    # the pad stages, and the ring-reduce fold
+    for w, b, n in ((2, 5, 8), (4, 10, 16), (8, 13, 40)):
+        assert f"verify_flat_w{w}_b{b}" in names
+        assert f"verify_registry_w{w}_b{b}_n{n}" in names
+        assert f"pad_operands_w{w}_b{b}" in names
+        assert f"pad_slots_w{w}_b{b}" in names
+        assert f"ring_reduce_w{w}" in names
+    # non-divisible remainder coverage: 13 over 8 and 10 over 4
+    assert "verify_flat_w8_b13" in names
+    # the other dispatch consumers' characteristic shapes
+    assert "stream_chunk_w8_b64" in names
+    assert "pod_canary_w4_b4" in names
+
+
+def test_spmd_declared_axes_parse_from_mesh_source():
+    axes = spmd_lint._declared_axes_live(REPO)
+    assert "batch" in axes
+
+
+def test_spmd_proof_cache_warm_agrees_and_preserves_range_keys(
+        tmp_path, monkeypatch):
+    """Both traced families share .range_proof_cache.json under their
+    own fingerprints: a write from either side must preserve the
+    other's sections, and the spmd warm replay must be verdict-
+    identical to the cold trace."""
+    monkeypatch.setattr(range_lint, "_CACHE_FILE",
+                        str(tmp_path / "proofcache.json"))
+    range_lint.generate(REPO, AuditConfig(), only=("mxu_to13",))
+    v_cold = spmd_lint.generate(REPO, AuditConfig())
+    cold = dict(spmd_lint._CACHE_STATS)
+    assert cold["misses"] > 0 and cold["hits"] == 0
+    v_warm = spmd_lint.generate(REPO, AuditConfig())
+    assert dict(spmd_lint._CACHE_STATS) == {
+        "hits": cold["misses"], "misses": 0,
+    }
+    assert [v.to_dict() for v in v_cold] == [v.to_dict() for v in v_warm]
+    doc = json.loads((tmp_path / "proofcache.json").read_text())
+    assert "fingerprint" in doc and "programs" in doc  # range intact
+    assert "spmd_fingerprint" in doc and "spmd_programs" in doc
+    # the range side still warm-replays through the shared file
+    range_lint.generate(REPO, AuditConfig(), only=("mxu_to13",))
+    assert dict(range_lint._CACHE_STATS) == {"hits": 1, "misses": 0}
+
+
+def test_spmd_proof_cache_invalidates_on_prover_edit(tmp_path, monkeypatch):
+    monkeypatch.setattr(range_lint, "_CACHE_FILE",
+                        str(tmp_path / "proofcache.json"))
+    spmd_lint.generate(REPO, AuditConfig())
+    first = dict(spmd_lint._CACHE_STATS)
+    monkeypatch.setattr(spmd_lint, "_spmd_fingerprint",
+                        lambda root: "edited-prover")
+    spmd_lint.generate(REPO, AuditConfig())
+    assert spmd_lint._CACHE_STATS["hits"] == 0
+    assert spmd_lint._CACHE_STATS["misses"] == first["misses"]
+
+
+def test_spmd_cache_opt_out_never_touches_disk(tmp_path, monkeypatch):
+    monkeypatch.setattr(range_lint, "_CACHE_FILE",
+                        str(tmp_path / "proofcache.json"))
+    spmd_lint.generate(REPO, AuditConfig(range_cache=False))
+    assert not (tmp_path / "proofcache.json").exists()
+    assert spmd_lint._CACHE_STATS["hits"] == 0
+
+
+def test_range_fingerprint_covers_the_sharded_program_sources():
+    deps = range_lint._fingerprint_deps(REPO)
+    assert "lighthouse_tpu/parallel/partition.py" in deps
+    assert "lighthouse_tpu/parallel/mesh.py" in deps
+    assert any(d.endswith("jax_backend/fp.py") for d in deps)
+
+
+def test_spmd_fingerprint_tracks_prover_and_kernels(monkeypatch):
+    base = spmd_lint._spmd_fingerprint(REPO)
+    # an edit to anything under the range fingerprint (kernels, the
+    # partition/mesh sources) shifts the spmd fingerprint too
+    monkeypatch.setattr(range_lint, "_proof_fingerprint",
+                        lambda root: "kernel-edited")
+    assert spmd_lint._spmd_fingerprint(REPO) != base
+
+
 # -- CLI entrypoint ------------------------------------------------------
 
 
@@ -730,10 +915,56 @@ def test_cli_list_families_and_only_validation():
     proc = _run_cli("--list-families")
     assert proc.returncode == 0
     assert proc.stdout.split() == ["lock", "raise", "registry", "jaxpr",
-                                   "range"]
+                                   "range", "spmd"]
+    assert tuple(proc.stdout.split()) == ALL_FAMILIES
     proc = _run_cli("--only", "nonsense")
     assert proc.returncode == 2
     assert "unknown families" in proc.stderr
+
+
+def test_cli_changed_excludes_only():
+    proc = _run_cli("--changed", "--only", "spmd")
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr
+
+
+def _load_cli_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "static_audit_cli", os.path.join(REPO, "tools", "static_audit.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_changed_scoping_maps_paths_to_families():
+    sa = _load_cli_module()
+    # docs-only diff: nothing to audit
+    assert sa.families_for_paths([]) == ()
+    assert sa.families_for_paths(["README.md", "STATUS.md"]) == ()
+    # any python change gets the fast AST tier
+    assert sa.families_for_paths(["lighthouse_tpu/obs/metrics.py"]) == \
+        AST_FAMILIES
+    # kernel sources pull in both traced families (the spmd programs
+    # close over the kernels)
+    assert sa.families_for_paths(
+        ["lighthouse_tpu/crypto/bls/jax_backend/fp.py"]
+    ) == ALL_FAMILIES
+    # sharded-program sources pull in spmd but not range
+    fams = sa.families_for_paths(["lighthouse_tpu/parallel/partition.py"])
+    assert "spmd" in fams and "range" not in fams
+    # analyzer/tooling edits escalate to everything
+    assert sa.families_for_paths(
+        ["lighthouse_tpu/analysis/spmd_lint.py"]) == ALL_FAMILIES
+    assert sa.families_for_paths(["tools/bench.py"]) == ALL_FAMILIES
+
+
+def test_changed_paths_reads_this_repo():
+    sa = _load_cli_module()
+    paths = sa._changed_paths(REPO)
+    assert paths is None or isinstance(paths, list)
 
 
 # -- waivers + TOML subset ----------------------------------------------
